@@ -1,0 +1,72 @@
+"""apex_tpu — a TPU-native training-acceleration framework.
+
+A ground-up re-design of the capabilities of NVIDIA Apex (reference:
+/root/reference, see SURVEY.md) for TPUs: JAX/XLA for the compute path, Pallas
+for fused kernels, ``jax.sharding.Mesh`` + ``shard_map`` collectives over ICI
+for every flavor of parallelism, and functional (pytree-based) state instead
+of in-place tensor mutation.
+
+Subpackage map (reference parity noted per module):
+
+- ``apex_tpu.amp``          — mixed precision (ref: apex/amp, apex/fp16_utils)
+- ``apex_tpu.ops``          — fused ops / Pallas kernels (ref: csrc/, apex/normalization,
+                              apex/mlp, apex/fused_dense, apex/transformer/functional)
+- ``apex_tpu.optimizers``   — fused + distributed optimizers (ref: apex/optimizers,
+                              apex/contrib/optimizers)
+- ``apex_tpu.parallel``     — data/tensor/pipeline/sequence/context parallelism
+                              (ref: apex/parallel, apex/transformer)
+- ``apex_tpu.transformer``  — Megatron-style transformer building blocks
+                              (ref: apex/transformer)
+- ``apex_tpu.contrib``      — contrib zoo parity (ref: apex/contrib)
+- ``apex_tpu.models``       — flagship models (GPT, BERT, ResNet) used by the
+                              examples / benchmarks (ref: apex/examples, testing/standalone_*)
+"""
+
+import logging
+
+__version__ = "0.1.0"
+
+
+class RankInfoFormatter(logging.Formatter):
+    """Log formatter that prefixes records with JAX process/device info.
+
+    TPU-native analogue of the reference's rank-aware formatter
+    (ref: apex/__init__.py:31-43) — torch.distributed rank/world is replaced
+    by the JAX multi-controller process index.
+    """
+
+    def format(self, record):
+        try:
+            import jax
+
+            rank_info = f"[process {jax.process_index()}/{jax.process_count()}]"
+        except Exception:  # pragma: no cover - jax not initialized yet
+            rank_info = "[process ?/?]"
+        record.rank_info = rank_info
+        return super().format(record)
+
+
+_logger = logging.getLogger("apex_tpu")
+if not _logger.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(
+        RankInfoFormatter("%(asctime)s %(rank_info)s %(name)s %(levelname)s: %(message)s")
+    )
+    _logger.addHandler(_handler)
+    _logger.propagate = False
+
+
+def get_logger(name: str = "apex_tpu") -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def set_logging_level(level) -> None:
+    """Set the library-wide logging level (ref: transformer/log_util.py:10)."""
+    _logger.setLevel(level)
+
+
+def deprecated_warning(msg: str) -> None:
+    """Emit a deprecation warning once (ref: apex/__init__.py:62)."""
+    import warnings
+
+    warnings.warn(msg, FutureWarning, stacklevel=2)

@@ -1,0 +1,52 @@
+"""Automatic mixed precision for TPU.
+
+Reference parity: apex/amp (frontend.py O0-O3 opt levels, scaler.py dynamic
+LossScaler, handle.py scale_loss, _process_optimizer master weights) and the
+legacy apex/fp16_utils FP16_Optimizer.
+
+TPU-native design: there is no module graph to monkey-patch and no mutable
+optimizer object — amp is a *policy* plus *pure state*:
+
+- ``Policy`` (O0-O3) describes param/compute/output dtypes and the
+  keep-norms-fp32 rule; ``initialize`` applies it to a params pytree and an
+  optax transform, returning casted params + a wrapped transform that keeps
+  fp32 master weights and skips steps on overflow via ``lax.cond`` (fully
+  jittable — the reference does this with Python-side step patching, which
+  cannot exist under jit).
+- ``LossScaler`` is a pytree state machine with the reference's dynamic-scale
+  schedule (x2 after 2000 clean steps, /2 on overflow; amp/scaler.py:197-217).
+- bf16 is the default half dtype on TPU (fp16 remains available for parity
+  experiments).
+"""
+
+from apex_tpu.amp.policy import (
+    Policy,
+    O0,
+    O1,
+    O2,
+    O3,
+    opt_levels,
+    initialize,
+)
+from apex_tpu.amp.scaler import (
+    LossScaler,
+    LossScalerState,
+    scale_loss,
+    unscale_grads,
+)
+from apex_tpu.amp.grad_scaler import GradScaler
+
+__all__ = [
+    "Policy",
+    "O0",
+    "O1",
+    "O2",
+    "O3",
+    "opt_levels",
+    "initialize",
+    "LossScaler",
+    "LossScalerState",
+    "scale_loss",
+    "unscale_grads",
+    "GradScaler",
+]

@@ -1,0 +1,113 @@
+"""Master-weight + skip-on-overflow optimizer wrapper.
+
+Reference parity: apex/amp/_process_optimizer.py (lazy fp32-from-fp16 master
+weights, post-backward unscale, patched step/zero_grad) and
+fp16_utils/fp16_optimizer.py (FP16_Optimizer: step :275, backward :376,
+update_master_grads :439).
+
+TPU design: instead of patching a mutable optimizer object, ``AmpOptimizer``
+is a pure state machine over (master fp32 params, inner optax state, scaler
+state). The skip-on-overflow control flow is a ``lax.cond`` with donated
+state — the whole step stays inside one jit (hard part #4 in SURVEY.md §7).
+"""
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.policy import Policy
+from apex_tpu.amp.scaler import LossScalerState
+from apex_tpu.utils.pytree import tree_cast
+
+
+@flax.struct.dataclass
+class AmpOptimizerState:
+    master: Any  # fp32 master params (or None-like placeholder when disabled)
+    inner: Any  # optax state over master params
+    scaler: LossScalerState
+
+
+class AmpOptimizer:
+    """Wraps an optax GradientTransformation with amp semantics.
+
+    Usage::
+
+        params, amp_opt, policy = amp.initialize(params, tx, opt_level="O2")
+        state = amp_opt.init(params)
+        loss_fn = lambda p, batch: ...
+        # inside jitted step:
+        scaled_loss_fn = lambda p, b: amp_opt.scale_loss(loss_fn(p, b), state)
+        grads = jax.grad(scaled_loss_fn)(params, batch)
+        params, state, info = amp_opt.step(grads, state, params)
+    """
+
+    def __init__(self, tx: optax.GradientTransformation, policy: Policy, num_losses: int = 1):
+        self.tx = tx
+        self.policy = policy
+        # one scaler per loss (ref: _initialize.py:229-233 creates
+        # num_losses LossScalers); state holds the first; extra scalers can
+        # be created by callers via policy.make_scaler()
+        self.scaler = policy.make_scaler()
+        self.num_losses = num_losses
+
+    def init(self, params) -> AmpOptimizerState:
+        if self.policy.master_weights:
+            master = tree_cast(params, jnp.float32)
+        else:
+            master = params
+        return AmpOptimizerState(
+            master=master, inner=self.tx.init(master), scaler=self.scaler.init()
+        )
+
+    def scale_loss(self, loss, state: AmpOptimizerState):
+        return self.scaler.scale(state.scaler, loss)
+
+    def step(self, grads, state: AmpOptimizerState, params, found_inf_extra=None):
+        """One optimizer step: unscale, overflow-gate, update, recast.
+
+        Returns (new_params, new_state, info) where info has ``found_inf``
+        and ``loss_scale`` for logging parity with the reference's
+        "Gradient overflow, skipping step" messages (amp/handle.py:128-154).
+        """
+        # grads arrive in model dtype, shaped like params; promote to master
+        grads_f32 = tree_cast(grads, jnp.float32)
+        grads_f32, found_inf = self.scaler.unscale(state.scaler, grads_f32)
+        if found_inf_extra is not None:
+            found_inf = jnp.logical_or(found_inf, found_inf_extra)
+
+        def do_step(operand):
+            master, inner = operand
+            updates, new_inner = self.tx.update(grads_f32, inner, master)
+            new_master = optax.apply_updates(master, updates)
+            return new_master, new_inner
+
+        def skip_step(operand):
+            return operand
+
+        new_master, new_inner = jax.lax.cond(
+            found_inf, skip_step, do_step, (state.master, state.inner)
+        )
+        new_scaler = self.scaler.update(state.scaler, found_inf)
+        new_state = AmpOptimizerState(
+            master=new_master, inner=new_inner, scaler=new_scaler
+        )
+        if self.policy.master_weights:
+            # re-materialize model params from master in the model dtype(s)
+            new_params = jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), new_master, params
+            )
+        else:
+            new_params = new_master
+        info = {"found_inf": found_inf, "loss_scale": new_scaler.scale}
+        return new_params, new_state, info
+
+    # -- checkpointing parity (amp.state_dict, frontend.py:367-404) -------
+
+    def state_dict(self, state: AmpOptimizerState) -> dict:
+        return {"scaler": self.scaler.state_dict(state.scaler)}
+
+    def load_state_dict(self, state: AmpOptimizerState, d: dict) -> AmpOptimizerState:
+        return state.replace(scaler=self.scaler.load_state_dict(d["scaler"]))

@@ -1,0 +1,209 @@
+"""Opt-level policies O0-O3 and ``amp.initialize``.
+
+Reference parity: apex/amp/frontend.py — the ``Properties`` object and the
+four opt levels (frontend.py:104-193):
+
+- O0: fp32 everything (training baseline)
+- O1: per-op casting via namespace patching, params fp32, dynamic scale
+- O2: model cast to half, BN kept fp32, fp32 master weights, dynamic scale
+- O3: pure half (speed baseline)
+
+TPU design: there is no torch namespace to patch, so O1's cast lists become a
+*compute dtype* contract — parameters stay fp32 and ``wrap_apply`` casts
+inputs (and, inside flax models, the modules' ``dtype`` argument casts
+compute) to the half type; this is exactly the behavioral contract of the O1
+whitelist (GEMMs/convs in half, reductions in fp32 — our fused ops always
+accumulate fp32, see apex_tpu/ops). The default half dtype is bfloat16 (no
+loss scaling needed) with float16 available for parity.
+"""
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.utils.pytree import tree_map_with_path
+
+_NORM_TOKENS = ("norm", "bn", "batchnorm", "batch_stats")
+
+
+def default_keep_fp32_predicate(path: str) -> bool:
+    """True for params that stay fp32 under keep_batchnorm_fp32 (ref:
+    fp16_utils/fp16util.py:60-80 keeps BN modules fp32; here the functional
+    analogue keys off the param path — covers flax BatchNorm/LayerNorm/
+    RMSNorm/GroupNorm scale+bias)."""
+    low = path.lower()
+    return any(tok in low for tok in _NORM_TOKENS)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Resolved amp properties (ref: amp/frontend.py Properties)."""
+
+    opt_level: str
+    enabled: bool = True
+    cast_model_type: Optional[Any] = None  # dtype params are stored in
+    compute_dtype: Optional[Any] = None  # dtype compute runs in
+    keep_batchnorm_fp32: bool = False
+    master_weights: bool = False
+    loss_scale: Any = 1.0  # "dynamic" or float
+    keep_fp32_predicate: Callable[[str], bool] = default_keep_fp32_predicate
+
+    # -- casting helpers --------------------------------------------------
+
+    def cast_params(self, params):
+        """Cast a params pytree per the policy (ref: _initialize.py:178-184:
+        convert_network for O2, model.to(half) for O3)."""
+        if not self.enabled or self.cast_model_type is None:
+            return params
+        dtype = self.cast_model_type
+
+        def _cast(path, x):
+            x = jnp.asarray(x)
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                return x
+            if self.keep_batchnorm_fp32 and self.keep_fp32_predicate(path):
+                return x.astype(jnp.float32)
+            return x.astype(dtype)
+
+        return tree_map_with_path(_cast, params)
+
+    def cast_inputs(self, tree):
+        """Cast float inputs to the compute dtype (the input-caster closure
+        the reference patches onto model.forward, _initialize.py:192-203)."""
+        if not self.enabled or self.compute_dtype is None:
+            return tree
+        dt = self.compute_dtype
+
+        def _c(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dt)
+            return x
+
+        return jax.tree_util.tree_map(_c, tree)
+
+    def cast_outputs(self, tree):
+        """Cast float outputs back to fp32 (output-caster parity)."""
+        if not self.enabled or self.compute_dtype is None:
+            return tree
+
+        def _c(x):
+            x = jnp.asarray(x)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(jnp.float32)
+            return x
+
+        return jax.tree_util.tree_map(_c, tree)
+
+    def wrap_apply(self, apply_fn: Callable) -> Callable:
+        """Wrap a model apply function with input/output casting."""
+        if not self.enabled or self.compute_dtype is None:
+            return apply_fn
+
+        def wrapped(params, *args, **kwargs):
+            args = self.cast_inputs(args)
+            kwargs = self.cast_inputs(kwargs)
+            out = apply_fn(params, *args, **kwargs)
+            return self.cast_outputs(out)
+
+        return wrapped
+
+    def make_scaler(self, **kw) -> LossScaler:
+        return LossScaler(loss_scale=self.loss_scale, **kw)
+
+
+def _mk_level(opt_level, half_dtype):
+    if opt_level == "O0":
+        return Policy(
+            "O0",
+            cast_model_type=jnp.float32,
+            compute_dtype=None,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        )
+    if opt_level == "O1":
+        return Policy(
+            "O1",
+            cast_model_type=None,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=True,
+            master_weights=False,
+            loss_scale="dynamic" if half_dtype == jnp.float16 else 1.0,
+        )
+    if opt_level == "O2":
+        return Policy(
+            "O2",
+            cast_model_type=half_dtype,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=True,
+            master_weights=True,
+            loss_scale="dynamic" if half_dtype == jnp.float16 else 1.0,
+        )
+    if opt_level == "O3":
+        return Policy(
+            "O3",
+            cast_model_type=half_dtype,
+            compute_dtype=half_dtype,
+            keep_batchnorm_fp32=False,
+            master_weights=False,
+            loss_scale=1.0,
+        )
+    raise ValueError(f"Unexpected optimization level {opt_level!r}")
+
+
+def O0(half_dtype=jnp.bfloat16):
+    return _mk_level("O0", half_dtype)
+
+
+def O1(half_dtype=jnp.bfloat16):
+    return _mk_level("O1", half_dtype)
+
+
+def O2(half_dtype=jnp.bfloat16):
+    return _mk_level("O2", half_dtype)
+
+
+def O3(half_dtype=jnp.bfloat16):
+    return _mk_level("O3", half_dtype)
+
+
+opt_levels = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def initialize(
+    params=None,
+    tx=None,
+    opt_level: str = "O1",
+    half_dtype=jnp.bfloat16,
+    num_losses: int = 1,
+    **overrides,
+):
+    """TPU analogue of ``apex.amp.initialize`` (amp/frontend.py:197).
+
+    Takes a params pytree (the "model") and optionally an optax
+    GradientTransformation (the "optimizer"); returns
+    ``(casted_params, amp_optimizer_or_None, policy)`` where
+    ``amp_optimizer`` handles master weights + loss scaling + skip-on-overflow
+    (see apex_tpu.amp.optimizer.AmpOptimizer). Property overrides mirror the
+    reference's keyword overrides (cast_model_type, keep_batchnorm_fp32,
+    master_weights, loss_scale).
+    """
+    if opt_level not in opt_levels:
+        raise ValueError(
+            f"Unexpected optimization level {opt_level!r} (options: O0, O1, O2, O3)"
+        )
+    policy = opt_levels[opt_level](half_dtype)
+    if overrides:
+        policy = dataclasses.replace(policy, **overrides)
+
+    casted = policy.cast_params(params) if params is not None else None
+    amp_opt = None
+    if tx is not None:
+        from apex_tpu.amp.optimizer import AmpOptimizer
+
+        amp_opt = AmpOptimizer(tx, policy, num_losses=num_losses)
+    return casted, amp_opt, policy

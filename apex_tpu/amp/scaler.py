@@ -1,0 +1,138 @@
+"""Dynamic loss scaling.
+
+Reference parity: apex/amp/scaler.py (LossScaler — static + dynamic modes,
+unscale with fused overflow check, update_scale with x2-per-2000-clean /
+divide-by-2-on-overflow schedule, scaler.py:197-217) and
+fp16_utils/loss_scaler.py (LossScaler/DynamicLossScaler).
+
+TPU design: the scaler is a pytree state machine. Overflow checking is a
+fused ``isfinite`` reduction over the grad pytree (the reference launches
+multi_tensor kernels with a noop_flag buffer); the skip-step decision is a
+``lax.cond`` in the caller's jitted step instead of Python-side
+``optimizer.step`` patching (amp/handle.py:128-154), so the whole train step
+stays compiled. State round-trips through ``state_dict``/``load_state_dict``
+for checkpointing (ref: amp/frontend.py:367-404).
+"""
+
+from typing import Any, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_any_non_finite
+
+
+@flax.struct.dataclass
+class LossScalerState:
+    scale: jax.Array  # f32 scalar
+    growth_tracker: jax.Array  # i32 scalar: consecutive clean steps
+    # running count of skipped steps, for observability parity with
+    # _amp_state verbosity messages
+    skipped: jax.Array  # i32 scalar
+
+
+class LossScaler:
+    """Loss scaler with the reference's dynamic schedule.
+
+    ``loss_scale="dynamic"`` (default O1/O2 behavior) or a fixed float
+    (O3 / static mode). On TPU with bf16 the scaler is typically a no-op
+    (scale 1.0) but fp16 parity and overflow-robust training both keep it
+    first-class.
+    """
+
+    def __init__(
+        self,
+        loss_scale="dynamic",
+        init_scale: float = 2.0**16,
+        growth_factor: float = 2.0,
+        backoff_factor: float = 0.5,
+        growth_interval: int = 2000,
+        min_loss_scale: float = 1.0,
+        max_loss_scale: float = 2.0**24,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._static_scale = 1.0 if self.dynamic else float(loss_scale)
+        self.init_scale = init_scale if self.dynamic else self._static_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.asarray(self.init_scale, jnp.float32),
+            growth_tracker=jnp.asarray(0, jnp.int32),
+            skipped=jnp.asarray(0, jnp.int32),
+        )
+
+    # -- core ops ---------------------------------------------------------
+
+    def scale(self, state: LossScalerState, loss):
+        """loss * scale, in fp32 (ref: handle.py:113 yields loss.float()*scale)."""
+        return loss.astype(jnp.float32) * state.scale
+
+    def unscale(self, state: LossScalerState, grads) -> Tuple[Any, jax.Array]:
+        """grads / scale + overflow flag (ref: scaler.py:94 unscale)."""
+        inv = 1.0 / state.scale
+        found_inf = tree_any_non_finite(grads)
+        out = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads
+        )
+        return out, found_inf
+
+    def update(self, state: LossScalerState, found_inf) -> LossScalerState:
+        """Dynamic scale update (ref: scaler.py:197-217 update_scale)."""
+        if not self.dynamic:
+            return state.replace(
+                skipped=state.skipped + jnp.asarray(found_inf, jnp.int32)
+            )
+        found_inf = jnp.asarray(found_inf)
+        backed_off = jnp.maximum(
+            state.scale * self.backoff_factor, self.min_loss_scale
+        )
+        tracker = jnp.where(found_inf, 0, state.growth_tracker + 1)
+        grow = jnp.logical_and(~found_inf, tracker >= self.growth_interval)
+        scale = jnp.where(found_inf, backed_off, state.scale)
+        scale = jnp.where(
+            grow, jnp.minimum(scale * self.growth_factor, self.max_loss_scale), scale
+        )
+        tracker = jnp.where(grow, 0, tracker)
+        return LossScalerState(
+            scale=scale,
+            growth_tracker=tracker,
+            skipped=state.skipped + jnp.asarray(found_inf, jnp.int32),
+        )
+
+    # -- checkpointing (ref: amp/frontend.py:367-404) ---------------------
+
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {
+            "loss_scale": float(state.scale),
+            "unskipped": int(state.growth_tracker),
+            "skipped": int(state.skipped),
+            "dynamic": self.dynamic,
+        }
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            growth_tracker=jnp.asarray(d.get("unskipped", 0), jnp.int32),
+            skipped=jnp.asarray(d.get("skipped", 0), jnp.int32),
+        )
+
+
+_DEFAULT_SCALER = LossScaler()
+
+
+def scale_loss(loss, state: LossScalerState):
+    """Functional analogue of ``with amp.scale_loss(...)`` entry
+    (amp/handle.py:17): returns the scaled loss to differentiate."""
+    return _DEFAULT_SCALER.scale(state, loss)
+
+
+def unscale_grads(grads, state: LossScalerState):
+    """Functional unscale + overflow flag (the context-manager exit half of
+    the reference's scale_loss, amp/handle.py:117-127)."""
+    return _DEFAULT_SCALER.unscale(state, grads)

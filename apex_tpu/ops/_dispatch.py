@@ -1,0 +1,34 @@
+"""Implementation dispatch for fused ops.
+
+Every op in apex_tpu.ops has (a) a pure-jnp reference implementation that XLA
+already fuses well, and (b) optionally a Pallas TPU kernel for the cases where
+hand control of VMEM tiling wins. ``resolve_impl`` picks between them:
+
+- ``"auto"``   : Pallas on a real TPU backend, XLA elsewhere.
+- ``"pallas"`` : force Pallas (interpreted off-TPU — used by tests to
+                 exercise kernel code paths on the CPU mesh).
+- ``"xla"``    : force the jnp reference implementation.
+"""
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def resolve_impl(impl: str):
+    """Returns (use_pallas: bool, interpret: bool)."""
+    if impl == "auto":
+        impl = "pallas" if on_tpu() else "xla"
+    if impl == "pallas":
+        return True, not on_tpu()
+    if impl == "xla":
+        return False, False
+    raise ValueError(f"unknown impl {impl!r}; expected auto|pallas|xla")

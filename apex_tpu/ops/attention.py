@@ -1,0 +1,191 @@
+"""Flash attention — flagship Pallas kernel #2.
+
+Reference parity: supersedes both ``fmhalib`` (contrib/fmha — seq<=512,
+head_dim 64 MLPerf BERT kernel) and ``fast_multihead_attn``
+(contrib/multihead_attn — CUTLASS fused MHA): a single blockwise
+online-softmax attention kernel with no sequence-length cap.
+
+Design: forward is a Pallas kernel — grid over (batch*heads, q_blocks), K/V
+resident in VMEM per (b,h), online softmax accumulation in fp32, causal
+blocks skipped entirely via a data-dependent ``fori_loop`` bound. The
+backward recomputes attention from the saved logsumexp (standard
+flash-attention recompute strategy; saves O(S^2) activation memory in the
+forward). The backward itself is currently an XLA einsum chain — a Pallas
+backward kernel is the planned next optimization.
+
+Long-context across chips is handled one level up by
+``apex_tpu.parallel.ring_attention``, which calls the blockwise pieces here
+per ring step.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._dispatch import resolve_impl
+
+_NEG_INF = -1e30
+
+
+def _attn_ref(q, k, v, scale, causal, mask=None):
+    """Plain XLA attention; q,k,v: (B, H, S, D)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(cm, _NEG_INF, s)
+    if mask is not None:
+        s = jnp.where(mask, _NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    seq_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    num_kv = seq_k // bk
+    if causal:
+        # only blocks whose first col index <= last row index participate
+        hi = jax.lax.div((qi + 1) * bq + bk - 1, bk)
+        hi = jnp.minimum(hi, num_kv)
+    else:
+        hi = num_kv
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (BK, D)
+        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (BQ, BK)
+        if causal:
+            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(col > row, _NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    d = q_ref.shape[2]
+    init = (
+        jnp.zeros((bq, d), jnp.float32),
+        jnp.full((bq,), _NEG_INF, jnp.float32),
+        jnp.zeros((bq,), jnp.float32),
+    )
+    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, :] = m + jnp.log(l)
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    grid = (bh, sq // bq)
+    o, lse = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3)
+    return o, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q3, k3, v3, scale, causal, interpret, bq, bk):
+    o, _ = _flash_fwd_res(q3, k3, v3, scale, causal, interpret, bq, bk)
+    return o
+
+
+def _flash_fwd_res(q3, k3, v3, scale, causal, interpret, bq, bk):
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_bwd(scale, causal, interpret, bq, bk, res, do):
+    q3, k3, v3, o, lse = res
+    del interpret, bq, bk
+    qf = q3.astype(jnp.float32)
+    kf = k3.astype(jnp.float32)
+    vf = v3.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, SQ)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf, preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+        s = jnp.where(cm, _NEG_INF, s)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
+_flash.defvjp(_flash_fwd_res, _flash_bwd)
+
+
+def flash_attention(
+    q,
+    k,
+    v,
+    causal: bool = False,
+    scale: float = None,
+    mask=None,
+    impl: str = "auto",
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Multi-head attention; q,k,v: (batch, heads, seq, head_dim).
+
+    ``mask`` (True = masked out, broadcastable to (b, h, sq, sk)) forces the
+    XLA path; the Pallas kernel covers the unmasked / causal fast paths that
+    the reference's fmha/fast_multihead_attn accelerate.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    use_pallas, interpret = resolve_impl(impl)
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    pallas_ok = (
+        use_pallas
+        and mask is None
+        and sq % bq == 0
+        and sk % bk == 0
+        and (not causal or sq == sk)
+    )
+    if not pallas_ok:
+        return _attn_ref(q, k, v, scale, causal, mask)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    o = _flash(q3, k3, v3, scale, causal, interpret, bq, bk)
+    return o.reshape(b, h, sq, d)

@@ -1,0 +1,36 @@
+"""Fused dense (GEMM + bias [+ GeLU + GEMM]) layers.
+
+Reference parity: ``fused_dense_cuda`` (csrc/fused_dense.cpp:188-191,
+cublasLt epilogue fusion) and apex.fused_dense.{FusedDense,FusedDenseGeluDense}
+(fused_dense/fused_dense.py:8-96).
+
+On TPU the MXU + XLA fusion already executes bias/GeLU as epilogues of the
+matmul — these wrappers exist for API parity and to pin the preferred
+bf16-in/fp32-accumulate contract via ``preferred_element_type``.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_dense(x, weight, bias=None):
+    """y = x @ W^T + b with fp32 MXU accumulation.
+
+    ``weight`` is (out, in) like the reference's torch convention.
+    """
+    y = jax.lax.dot_general(
+        x,
+        weight,
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def fused_dense_gelu_dense(x, weight1, bias1, weight2, bias2):
+    """y = GeLU(x @ W1^T + b1) @ W2^T + b2 (ref: fused_dense.py:36-60)."""
+    h = fused_dense(x, weight1, bias1)
+    h = jax.nn.gelu(h.astype(jnp.float32), approximate=True).astype(x.dtype)
+    return fused_dense(h, weight2, bias2)

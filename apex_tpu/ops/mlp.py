@@ -1,0 +1,54 @@
+"""Fused MLP.
+
+Reference parity: ``mlp_cuda`` (csrc/mlp.cpp:163-164 — cuBLAS GEMM chain with
+fused bias/ReLU/sigmoid epilogues) and apex.mlp.MLP (mlp/mlp.py:33).
+
+The TPU version is a chain of MXU matmuls whose bias+activation epilogues XLA
+fuses; parameters live in a plain pytree so the whole chain sits in one jit.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_init(rng, mlp_sizes: Sequence[int], dtype=jnp.float32):
+    """Initialize weights/biases for layer sizes ``mlp_sizes`` (ref
+    mlp/mlp.py:41-53: uniform(-1/sqrt(fan_in), 1/sqrt(fan_in)))."""
+    params = {"weights": [], "biases": []}
+    for i in range(len(mlp_sizes) - 1):
+        fan_in, fan_out = mlp_sizes[i], mlp_sizes[i + 1]
+        rng, wk, bk = jax.random.split(rng, 3)
+        bound = 1.0 / jnp.sqrt(fan_in)
+        params["weights"].append(
+            jax.random.uniform(wk, (fan_out, fan_in), dtype, -bound, bound)
+        )
+        params["biases"].append(jax.random.uniform(bk, (fan_out,), dtype, -bound, bound))
+    return params
+
+
+def mlp_apply(params, x, activation: str = "relu"):
+    """Forward through the fused MLP chain (ref: mlp/mlp.py:56-76).
+
+    Hidden layers get ``activation``; the final layer is linear, matching the
+    reference (activation applied to all but the last GEMM).
+    """
+    act = _ACTIVATIONS[activation]
+    n = len(params["weights"])
+    h = x
+    for i, (w, b) in enumerate(zip(params["weights"], params["biases"])):
+        h = jax.lax.dot_general(
+            h, w, (((h.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        h = h + b.astype(jnp.float32)
+        if i < n - 1:
+            h = act(h)
+        h = h.astype(x.dtype)
+    return h

@@ -1,0 +1,153 @@
+"""Multi-tensor fused-update engine.
+
+Reference parity: ``apex_C.flatten/unflatten`` (csrc/flatten_unflatten.cpp:16-17)
+and the ``amp_C.multi_tensor_*`` kernel family driven by
+``multi_tensor_applier`` (apex/multi_tensor_apply/multi_tensor_apply.py:25-31,
+csrc/multi_tensor_apply.cuh:19-133).
+
+TPU-native design: instead of chunked CUDA kernel launches over lists of
+device pointers, we either
+
+1. operate directly on the pytree — XLA fuses elementwise math across leaves
+   inside one jit, which is exactly what multi_tensor_apply buys on GPU; or
+2. for the optimizer hot loop, flatten the pytree into one contiguous padded
+   1-D buffer per dtype (``FlatBuffer``) and run a single Pallas kernel over
+   it (see apex_tpu/optimizers/_fused_kernels.py).
+
+The overflow ``noop_flag`` becomes a pure ``isfinite`` reduction
+(``tree_any_non_finite``) that the caller threads through ``lax.cond``.
+"""
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils.pytree import tree_any_non_finite
+
+# Matches the reference chunk size used by multi_tensor_applier
+# (apex/multi_tensor_apply/__init__.py:5). On TPU this is the Pallas grid
+# chunk for flat-buffer kernels; it is a multiple of the (8,128) f32 tile.
+CHUNK_SIZE = 2048 * 32
+
+
+def flatten(tensors: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate 1-D views of ``tensors`` (ref: apex_C.flatten)."""
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten(flat: jax.Array, like: Sequence[jax.Array]) -> List[jax.Array]:
+    """Split ``flat`` back into tensors shaped like ``like`` (ref: apex_C.unflatten)."""
+    sizes = [int(np.prod(t.shape)) if t.ndim else 1 for t in like]
+    offsets = np.cumsum([0] + sizes)
+    return [
+        jnp.reshape(flat[offsets[i] : offsets[i + 1]], like[i].shape)
+        for i in range(len(like))
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static description of a flattened pytree: shapes/offsets/padding."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]  # start offset of each leaf in the flat buffer
+    total: int  # unpadded total element count
+    padded_total: int  # total rounded up to a multiple of CHUNK_SIZE
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.shapes)
+
+
+def flatten_pytree(tree: Any, dtype=None, chunk: int = CHUNK_SIZE):
+    """Flatten a pytree of arrays into one padded 1-D buffer + FlatSpec.
+
+    The pad-to-chunk means downstream Pallas kernels see a static grid with
+    no remainder handling (the reference handles remainders per-chunk in
+    multi_tensor_apply.cuh; padding is cheaper than dynamic shapes on TPU).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+    offsets = tuple(int(o) for o in np.cumsum([0] + sizes)[:-1])
+    total = int(sum(sizes))
+    padded_total = max(chunk, ((total + chunk - 1) // chunk) * chunk)
+    out_dtype = dtype or (dtypes[0] if dtypes else jnp.float32)
+    if leaves:
+        flat = jnp.concatenate([jnp.ravel(l).astype(out_dtype) for l in leaves])
+    else:
+        flat = jnp.zeros((0,), out_dtype)
+    flat = jnp.pad(flat, (0, padded_total - total))
+    spec = FlatSpec(treedef, shapes, dtypes, offsets, total, padded_total)
+    return flat, spec
+
+
+def unflatten_pytree(flat: jax.Array, spec: FlatSpec, cast_back: bool = True) -> Any:
+    leaves = []
+    for shape, dtype, offset in zip(spec.shapes, spec.dtypes, spec.offsets):
+        size = int(np.prod(shape)) if len(shape) else 1
+        leaf = jnp.reshape(flat[offset : offset + size], shape)
+        if cast_back:
+            leaf = leaf.astype(dtype)
+        leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# multi_tensor_* functional ops (ref: csrc/amp_C_frontend.cpp:192-225)
+# ---------------------------------------------------------------------------
+
+
+def multi_tensor_scale(tree: Any, scale) -> Tuple[Any, jax.Array]:
+    """out = tree * scale; returns (out, overflow_flag).
+
+    Ref: multi_tensor_scale_kernel.cu — copy-with-scale + noop_flag on
+    non-finite. XLA fuses the scale into neighbouring ops for free.
+    """
+    out = jax.tree_util.tree_map(lambda x: x * jnp.asarray(scale, x.dtype), tree)
+    return out, tree_any_non_finite(tree)
+
+
+def multi_tensor_axpby(a, b, x_tree: Any, y_tree: Any) -> Tuple[Any, jax.Array]:
+    """out = a*x + b*y; returns (out, overflow_flag) (ref: multi_tensor_axpby_kernel.cu)."""
+    out = jax.tree_util.tree_map(
+        lambda x, y: jnp.asarray(a, x.dtype) * x + jnp.asarray(b, x.dtype) * y,
+        x_tree,
+        y_tree,
+    )
+    flag = jnp.logical_or(tree_any_non_finite(x_tree), tree_any_non_finite(y_tree))
+    return out, flag
+
+
+def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
+    """Global L2 norm over all leaves, optionally per-leaf norms too.
+
+    Ref: multi_tensor_l2norm_kernel.cu (two-stage block reduction). On TPU a
+    tree-wide sum-of-squares is a handful of fused reductions.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        z = jnp.zeros((), jnp.float32)
+        return (z, jnp.zeros((0,), jnp.float32)) if per_tensor else z
+    sq = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves]
+    total = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+    if per_tensor:
+        return total, jnp.sqrt(jnp.stack(sq))
+    return total
+
+
+def multi_tensor_applier(op, noop_flag, tensor_lists, *args):
+    """Compatibility shim mirroring the reference call convention.
+
+    ``op`` is a function taking (noop_flag, tensor_lists, *args) and returning
+    (new_tensor_lists, new_noop_flag). Unlike the CUDA version nothing is
+    mutated; callers use the returned trees.
+    Ref: apex/multi_tensor_apply/multi_tensor_apply.py:25-31.
+    """
+    return op(noop_flag, tensor_lists, *args)

@@ -1,0 +1,49 @@
+"""Rotary position embedding.
+
+Reference parity: ``fused_rotary_positional_embedding``
+(csrc/megatron/fused_rotary_positional_embedding.cpp:126-133) and the autograd
+wrappers FusedRoPEFunc / FusedRoPECachedFunc
+(transformer/functional/fused_rope.py:19,80).
+
+On TPU the rotate-half + cos/sin multiply is a pure VPU elementwise chain that
+XLA fuses into the surrounding attention projections, so no Pallas kernel is
+needed; the "cached" variant is just precomputing cos/sin once per step
+(rope_frequencies), which jit hoists automatically.
+
+Layout follows the reference: ``t`` is (seq, batch, heads, head_dim) and
+``freqs`` is (seq, 1, 1, rot_dim).
+"""
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(dim: int, seq_len: int, base: float = 10000.0, dtype=jnp.float32):
+    """Build the (seq, 1, 1, dim) angle tensor (ref: RotaryEmbedding in
+    testing/standalone_transformer_lm.py; freqs duplicated across halves)."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # (seq, dim/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (seq, dim)
+    return emb[:, None, None, :].astype(dtype)
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(t, freqs):
+    """Apply RoPE to the first ``rot_dim`` channels of ``t``.
+
+    Matches the reference semantics (fused_rope.py:19-78): channels beyond
+    freqs.shape[-1] pass through; math in fp32, output keeps t.dtype.
+    """
+    rot_dim = freqs.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    f = freqs.astype(jnp.float32)
+    tr = t_rot.astype(jnp.float32)
+    out = tr * jnp.cos(f) + _rotate_half(tr) * jnp.sin(f)
+    out = out.astype(t.dtype)
+    if t_pass.shape[-1] == 0:
+        return out
+    return jnp.concatenate([out, t_pass], axis=-1)

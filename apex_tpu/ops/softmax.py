@@ -1,0 +1,120 @@
+"""Scaled (masked) softmax family.
+
+Reference parity: the four megatron softmax CUDA modules —
+``scaled_upper_triang_masked_softmax_cuda``, ``scaled_masked_softmax_cuda``,
+``generic_scaled_masked_softmax_cuda``, ``scaled_softmax_cuda``
+(csrc/megatron/*.cpp) and their autograd wrappers + the
+``FusedScaleMaskSoftmax`` dispatcher (transformer/functional/fused_softmax.py).
+
+On TPU, XLA fuses scale+mask+softmax into a single VPU pass out of the box,
+so these are jnp compositions with fp32 softmax math; the attention-fused
+variant (which on GPUs motivated fmha) is ``apex_tpu.ops.flash_attention``.
+The kernel-availability heuristics of the reference dispatcher (seq <= 2048,
+dims divisible by 4/8, fused-kernel only for fp16/bf16) are irrelevant here;
+``fused_scale_mask_softmax`` keeps the same call surface but always fuses.
+"""
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.enums import AttnMaskType
+
+# padding-mask fill matches the reference wrappers' -10000 semantics; the
+# causal mask uses a true -inf surrogate so future positions get exactly
+# zero probability regardless of logit scale (the reference kernel writes
+# exact zeros to the masked region).
+_MASK_VALUE = -10000.0
+_CAUSAL_MASK_VALUE = -1e30
+
+
+def _softmax_fp32(x, dtype):
+    xf = x.astype(jnp.float32)
+    xf = xf - jnp.max(xf, axis=-1, keepdims=True)
+    p = jnp.exp(xf)
+    return (p / jnp.sum(p, axis=-1, keepdims=True)).astype(dtype)
+
+
+def scaled_softmax(x, scale: float = 1.0):
+    """softmax(x * scale) (ref: scaled_softmax.cpp:68-73)."""
+    return _softmax_fp32(x * scale, x.dtype)
+
+
+def scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """softmax(mask_fill(x*scale)); ``mask`` is True where masked OUT.
+
+    Ref: scaled_masked_softmax.cpp:93-103 — mask shape broadcastable to x
+    (b, 1, sq, sk) against (b, np, sq, sk).
+    """
+    xf = x.astype(jnp.float32) * scale
+    if mask is not None:
+        xf = jnp.where(mask, _MASK_VALUE, xf)
+    return _softmax_fp32(xf, x.dtype)
+
+
+def generic_scaled_masked_softmax(x, mask, scale: float = 1.0):
+    """Arbitrary-size variant (ref: generic_scaled_masked_softmax.cpp:76-82).
+
+    On TPU there is no size specialization; identical to scaled_masked_softmax.
+    """
+    return scaled_masked_softmax(x, mask, scale)
+
+
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
+    """Causal softmax over the last two dims (sq, sk).
+
+    Ref: scaled_upper_triang_masked_softmax.cpp:66-71 — input (attn_batches,
+    sq, sk), upper triangle (key index > query index) masked out.
+    """
+    sq, sk = x.shape[-2], x.shape[-1]
+    row = jnp.arange(sq)[:, None]
+    col = jnp.arange(sk)[None, :]
+    causal = col > row + (sk - sq)
+    xf = jnp.where(causal, _CAUSAL_MASK_VALUE, x.astype(jnp.float32) * scale)
+    return _softmax_fp32(xf, x.dtype)
+
+
+class FusedScaleMaskSoftmax:
+    """Dispatcher mirroring transformer.functional.FusedScaleMaskSoftmax.
+
+    Args follow the reference constructor (fused_softmax.py:~160): the
+    ``*_fusion`` flags are accepted for API parity but fusion always happens
+    (XLA), and ``softmax_in_fp32`` is always honored internally.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        self.attn_mask_type = attn_mask_type
+        self.mask_func = mask_func
+        self.scale = 1.0 if scale is None else scale
+        del input_in_fp16, input_in_bf16, scaled_masked_softmax_fusion, softmax_in_fp32
+
+    def __call__(self, x, mask=None):
+        if self.attn_mask_type == AttnMaskType.causal:
+            b, np_, sq, sk = x.shape
+            out = scaled_upper_triang_masked_softmax(
+                x.reshape(b * np_, sq, sk), self.scale
+            )
+            return out.reshape(b, np_, sq, sk)
+        if mask is not None and self.mask_func is not None:
+            xf = self.mask_func(x.astype(jnp.float32) * self.scale, mask)
+            return _softmax_fp32(xf, x.dtype)
+        return scaled_masked_softmax(x, mask, self.scale)
+
+
+def fused_scale_mask_softmax(x, mask=None, scale: float = 1.0, causal: bool = False):
+    """Functional form of the dispatcher."""
+    if causal:
+        shape = x.shape
+        return scaled_upper_triang_masked_softmax(
+            x.reshape(-1, shape[-2], shape[-1]), scale
+        ).reshape(shape)
+    return scaled_masked_softmax(x, mask, scale)

@@ -1,0 +1,48 @@
+"""Fused optimizers.
+
+Reference parity: apex/optimizers (FusedAdam, FusedLAMB, FusedSGD,
+FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb — all backed by
+amp_C multi_tensor kernels) and apex/contrib/optimizers
+(DistributedFusedAdam = ZeRO-2, DistributedFusedLAMB).
+
+TPU design: every optimizer is an optax-compatible
+``GradientTransformation`` whose update math matches the reference kernels.
+The "fused" property holds by construction: the entire pytree update is one
+XLA fusion inside the caller's jitted step (what multi_tensor_apply buys on
+GPU with chunked launches). The flat-buffer Pallas path
+(apex_tpu/optimizers/_flat.py) additionally collapses many small parameters
+into one contiguous kernel for step-time wins on models with many leaves.
+ZeRO sharding (DistributedFusedAdam) is expressed as reduce-scatter /
+all-gather over the 'dp' mesh axis inside shard_map.
+"""
+
+from apex_tpu.optimizers.fused_adam import fused_adam, FusedAdam
+from apex_tpu.optimizers.fused_lamb import fused_lamb, FusedLAMB, FusedMixedPrecisionLamb
+from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD
+from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad
+from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad
+from apex_tpu.optimizers.larc import larc, LARC
+from apex_tpu.optimizers.clip_grad import clip_grad_norm
+from apex_tpu.optimizers.distributed_fused_adam import (
+    distributed_fused_adam,
+    DistributedFusedAdam,
+)
+
+__all__ = [
+    "fused_adam",
+    "FusedAdam",
+    "fused_lamb",
+    "FusedLAMB",
+    "FusedMixedPrecisionLamb",
+    "fused_sgd",
+    "FusedSGD",
+    "fused_novograd",
+    "FusedNovoGrad",
+    "fused_adagrad",
+    "FusedAdagrad",
+    "larc",
+    "LARC",
+    "clip_grad_norm",
+    "distributed_fused_adam",
+    "DistributedFusedAdam",
+]

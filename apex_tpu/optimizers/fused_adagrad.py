@@ -1,0 +1,67 @@
+"""Fused Adagrad.
+
+Reference parity: apex.optimizers.FusedAdagrad (optimizers/fused_adagrad.py)
+backed by amp_C.multi_tensor_adagrad: h += g^2; p -= lr * g / (sqrt(h)+eps),
+with "adagrad_w_mode"-style decoupled weight decay.
+"""
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.utils.pytree import tree_map_multi
+
+
+class FusedAdagradState(NamedTuple):
+    sum: Any  # accumulated squared gradients, fp32
+
+
+def fused_adagrad(
+    lr: float = 1e-2,
+    eps: float = 1e-10,
+    weight_decay: float = 0.0,
+    adagrad_w_mode: bool = False,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return FusedAdagradState(
+            sum=jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params
+            )
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adagrad requires params")
+
+        def _leaf(g, p, h):
+            gf = g.astype(jnp.float32)
+            if not adagrad_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)
+            h_new = h + gf * gf
+            upd = gf / (jnp.sqrt(h_new) + eps)
+            if adagrad_w_mode and weight_decay != 0.0:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return (-lr * upd).astype(p.dtype), h_new
+
+        updates, h = tree_map_multi(_leaf, 2, grads, params, state.sum)
+        return updates, FusedAdagradState(sum=h)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdagrad:
+    def __new__(
+        cls,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        set_grad_none: bool = True,
+        **_unused,
+    ):
+        del set_grad_none
+        return fused_adagrad(
+            lr=lr, eps=eps, weight_decay=weight_decay, adagrad_w_mode=adagrad_w_mode
+        )

@@ -1,0 +1,106 @@
+"""Fused Adam / AdamW.
+
+Reference parity: apex.optimizers.FusedAdam (optimizers/fused_adam.py:4,
+step :127) backed by amp_C.multi_tensor_adam (csrc/multi_tensor_adam.cu) —
+``adam_w_mode`` selects decoupled weight decay, ``bias_correction`` the
+1/(1-beta^t) terms. The CUDA "capturable" mode (GPU-resident lr/step for
+CUDA graphs) is inherent here: everything, including the step count, lives
+on device inside jit.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedAdamState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any  # first moment, fp32
+    exp_avg_sq: Any  # second moment, fp32
+
+
+def fused_adam(
+    lr: float = 1e-3,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    adam_w_mode: bool = True,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Optax transform matching amp_C.multi_tensor_adam semantics."""
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        zeros = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), t
+        )
+        return FusedAdamState(
+            step=jnp.zeros((), jnp.int32), exp_avg=zeros(params), exp_avg_sq=zeros(params)
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - beta1**stepf
+            bc2 = 1.0 - beta2**stepf
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def _g(g, p):
+            gf = g.astype(jnp.float32)
+            if not adam_w_mode and weight_decay != 0.0:
+                gf = gf + weight_decay * p.astype(jnp.float32)  # L2 mode (ADAM_MODE_1)
+            return gf
+
+        geff = jax.tree_util.tree_map(_g, grads, params)
+        m = jax.tree_util.tree_map(
+            lambda g, m: beta1 * m + (1.0 - beta1) * g, geff, state.exp_avg
+        )
+        v = jax.tree_util.tree_map(
+            lambda g, v: beta2 * v + (1.0 - beta2) * g * g, geff, state.exp_avg_sq
+        )
+
+        def _upd(p, m, v):
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                upd = upd + weight_decay * p.astype(jnp.float32)  # decoupled (ADAM_MODE_0)
+            return (-lr * upd).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(_upd, params, m, v)
+        return updates, FusedAdamState(step=step, exp_avg=m, exp_avg_sq=v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedAdam:
+    """Class-style wrapper mirroring the reference constructor signature."""
+
+    def __new__(
+        cls,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        capturable: bool = False,
+        master_weights: bool = False,
+        **_unused,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        del capturable, master_weights  # inherent under jit / see amp.AmpOptimizer
+        return fused_adam(
+            lr=lr,
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            adam_w_mode=adam_w_mode,
+            weight_decay=weight_decay,
+        )

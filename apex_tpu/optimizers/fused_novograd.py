@@ -1,0 +1,104 @@
+"""Fused NovoGrad.
+
+Reference parity: apex.optimizers.FusedNovoGrad (optimizers/fused_novograd.py)
+backed by amp_C.multi_tensor_novograd — Adam with a *layer-wise* (per-tensor
+scalar) second moment: v_t = beta2*v + (1-beta2)*||g||^2 (norm_type=2),
+m_t = beta1*m + (1-beta1)*(g/(sqrt(v_t)+eps) + wd*p), p -= lr*m_t.
+``init_zero`` selects v_0 = 0 vs v_0 = ||g_1||^2 (reference's two init modes).
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class FusedNovoGradState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any  # per-parameter first moment
+    exp_avg_sq: Any  # per-tensor scalar second moment
+
+
+def fused_novograd(
+    lr: float = 1e-3,
+    betas: Tuple[float, float] = (0.95, 0.98),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    grad_averaging: bool = True,
+    init_zero: bool = False,
+    norm_type: int = 2,
+    bias_correction: bool = True,
+) -> optax.GradientTransformation:
+    if norm_type != 2:
+        raise ValueError("only norm_type=2 is supported (matches reference default)")
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        m = jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        # -1 sentinel -> "uninitialized", replaced by ||g||^2 on first step
+        # unless init_zero (ref: fused_novograd.py v init modes)
+        v = jax.tree_util.tree_map(
+            lambda x: jnp.zeros((), jnp.float32) if init_zero else -jnp.ones((), jnp.float32),
+            params,
+        )
+        return FusedNovoGradState(step=jnp.zeros((), jnp.int32), exp_avg=m, exp_avg_sq=v)
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_novograd requires params")
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1**stepf if bias_correction else jnp.asarray(1.0)
+        bc2 = 1.0 - beta2**stepf if bias_correction else jnp.asarray(1.0)
+        grad_coeff = (1.0 - beta1) if grad_averaging else 1.0
+
+        def _v(g, v):
+            gn2 = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            v_boot = jnp.where(v < 0, gn2, v)  # first-step bootstrap
+            return jnp.where(v < 0, v_boot, beta2 * v + (1.0 - beta2) * gn2)
+
+        v = jax.tree_util.tree_map(_v, grads, state.exp_avg_sq)
+
+        def _m(g, p, m, v):
+            gf = g.astype(jnp.float32)
+            denom = jnp.sqrt(v / bc2) + eps
+            gscaled = gf / denom
+            if weight_decay != 0.0:
+                gscaled = gscaled + weight_decay * p.astype(jnp.float32)
+            return beta1 * m + grad_coeff * gscaled
+
+        m = jax.tree_util.tree_map(_m, grads, params, state.exp_avg, v)
+        updates = jax.tree_util.tree_map(
+            lambda p, m: (-lr * m / bc1).astype(p.dtype), params, m
+        )
+        return updates, FusedNovoGradState(step=step, exp_avg=m, exp_avg_sq=v)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class FusedNovoGrad:
+    def __new__(
+        cls,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.95, 0.98),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        grad_averaging: bool = True,
+        norm_type: int = 2,
+        init_zero: bool = False,
+        set_grad_none: bool = True,
+        **_unused,
+    ):
+        del set_grad_none
+        return fused_novograd(
+            lr=lr,
+            betas=betas,
+            eps=eps,
+            weight_decay=weight_decay,
+            grad_averaging=grad_averaging,
+            init_zero=init_zero,
+            norm_type=norm_type,
+            bias_correction=bias_correction,
+        )

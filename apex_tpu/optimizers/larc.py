@@ -1,0 +1,91 @@
+"""LARC — Layer-wise Adaptive Rate Clipping/Scaling.
+
+Reference parity: apex.parallel.LARC (parallel/LARC.py:5) — wraps any
+optimizer; before the inner step, each parameter's gradient is rescaled by
+the local adaptive lr
+
+    local_lr = trust_coefficient * ||p|| / (||g|| + weight_decay * ||p|| + eps)
+
+In ``clip`` mode local_lr is capped at the base lr (scale factor
+min(local_lr/lr, 1)); in scale mode the factor is local_lr/lr.
+
+TPU design: an optax gradient transform chained *before* the inner
+transform — identical composition semantics to the reference's
+optimizer-wrapper, but pure.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LARCState(NamedTuple):
+    pass
+
+
+def larc_scaling(
+    lr: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """The grad-rescaling stage of LARC, as a standalone transform."""
+
+    def init_fn(params):
+        del params
+        return LARCState()
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("larc requires params")
+
+        def _leaf(g, p):
+            gf = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(pf * pf))
+            g_norm = jnp.sqrt(jnp.sum(gf * gf))
+            local_lr = (
+                trust_coefficient * p_norm / (g_norm + weight_decay * p_norm + eps)
+            )
+            ok = (p_norm > 0) & (g_norm > 0)
+            if clip:
+                factor = jnp.where(ok, jnp.minimum(local_lr / lr, 1.0), 1.0)
+            else:
+                factor = jnp.where(ok, local_lr / lr, 1.0)
+            return (gf * factor).astype(g.dtype)
+
+        return jax.tree_util.tree_map(_leaf, grads, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def larc(
+    inner: optax.GradientTransformation,
+    lr: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """LARC wrapper: rescale grads layer-wise, then run ``inner``."""
+    return optax.chain(
+        larc_scaling(lr, trust_coefficient, clip, eps, weight_decay), inner
+    )
+
+
+class LARC:
+    """Class-style alias mirroring apex.parallel.LARC(optimizer, ...)."""
+
+    def __new__(
+        cls,
+        optimizer: optax.GradientTransformation,
+        lr: float = 1e-3,
+        trust_coefficient: float = 0.02,
+        clip: bool = True,
+        eps: float = 1e-8,
+        **_unused,
+    ):
+        return larc(optimizer, lr=lr, trust_coefficient=trust_coefficient, clip=clip, eps=eps)

@@ -1,0 +1,48 @@
+"""Parallelism: data/tensor/sequence/context/pipeline over a device mesh.
+
+Reference parity: apex/parallel (DDP, SyncBatchNorm, LARC) and
+apex/transformer (parallel_state, tensor_parallel, pipeline_parallel).
+See SURVEY.md §2.5 for the strategy checklist; all strategies here ride
+`jax.sharding.Mesh` axes ('dp','pp','cp','tp') with XLA collectives.
+"""
+
+from apex_tpu.parallel import parallel_state
+from apex_tpu.parallel.ddp import (
+    DistributedDataParallel,
+    Reducer,
+    all_reduce_gradients,
+    broadcast_params,
+)
+from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm, convert_syncbn_model
+from apex_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.parallel import mappings
+from apex_tpu.parallel import random
+from apex_tpu.parallel.utils import (
+    VocabUtility,
+    broadcast_data,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "parallel_state",
+    "DistributedDataParallel",
+    "Reducer",
+    "all_reduce_gradients",
+    "broadcast_params",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "vocab_parallel_cross_entropy",
+    "mappings",
+    "random",
+    "VocabUtility",
+    "broadcast_data",
+    "split_tensor_along_last_dim",
+]

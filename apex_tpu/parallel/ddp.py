@@ -1,0 +1,117 @@
+"""Data-parallel gradient synchronization.
+
+Reference parity: apex.parallel.DistributedDataParallel
+(parallel/distributed.py:131) and Reducer (:91). The reference implements
+bucketed, multi-stream, overlapped NCCL allreduce with dynamic bucket
+structure negotiation (:287-517) — roughly 600 lines of machinery whose
+*entire purpose* (overlap comm with backward compute, batch small tensors)
+is performed on TPU by XLA's collective scheduler given a single ``psum``
+in the compiled step. What remains semantically meaningful is preserved:
+
+- ``gradient_average`` / ``gradient_predivide_factor``: pre-divide by N
+  before the sum, post-divide by N/factor after (distributed.py:439-455),
+  which trades overflow headroom in fp16 grads;
+- ``allreduce_always_fp32``: cast grads to fp32 around the reduce;
+- param broadcast at init (distributed.py:257) — ``broadcast_params``.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def all_reduce_gradients(
+    grads: Any,
+    axis_name: str = "dp",
+    gradient_average: bool = True,
+    gradient_predivide_factor: float = 1.0,
+    allreduce_always_fp32: bool = False,
+) -> Any:
+    """psum-average a grad pytree over the data-parallel axis.
+
+    Call inside shard_map/pmap over ``axis_name`` after ``jax.grad``.
+    """
+    n = jax.lax.psum(1, axis_name)
+
+    def _one(g):
+        orig = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            g = g * (gradient_predivide_factor / n)
+        return g.astype(orig)
+
+    return jax.tree_util.tree_map(_one, grads)
+
+
+def broadcast_params(params: Any, axis_name: str = "dp") -> Any:
+    """Make rank-0's params authoritative on every DP rank (ref:
+    distributed.py:257 broadcasts at wrap time). Under shard_map:
+    implemented as an all-gather-pick; under plain SPMD params are already
+    replicated and this is identity."""
+
+    def _one(p):
+        gathered = jax.lax.all_gather(p, axis_name, axis=0)
+        return gathered[0]
+
+    return jax.tree_util.tree_map(_one, params)
+
+
+class DistributedDataParallel:
+    """Functional DDP wrapper.
+
+    Wraps a ``loss_fn(params, batch) -> loss`` so that ``grad_fn`` returns
+    DP-synchronized gradients. Unlike the reference there is no module to
+    wrap — the object just carries the reduction options and the axis.
+    """
+
+    def __init__(
+        self,
+        loss_fn: Optional[Callable] = None,
+        axis_name: str = "dp",
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+        allreduce_always_fp32: bool = False,
+    ):
+        self.loss_fn = loss_fn
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.allreduce_always_fp32 = allreduce_always_fp32
+
+    def reduce(self, grads: Any) -> Any:
+        return all_reduce_gradients(
+            grads,
+            self.axis_name,
+            self.gradient_average,
+            self.gradient_predivide_factor,
+            self.allreduce_always_fp32,
+        )
+
+    def value_and_grad(self, *args, **kwargs):
+        """jax.value_and_grad with the gradient allreduce fused in."""
+        vg = jax.value_and_grad(self.loss_fn, *args, **kwargs)
+
+        def wrapped(*a, **k):
+            val, grads = vg(*a, **k)
+            return val, self.reduce(grads)
+
+        return wrapped
+
+
+class Reducer:
+    """Manual-sync helper (ref: parallel/distributed.py:91): user calls
+    ``reduce`` explicitly, no implicit hooks."""
+
+    def __init__(self, axis_name: str = "dp"):
+        self.axis_name = axis_name
+
+    def reduce(self, tree: Any) -> Any:
+        n = jax.lax.psum(1, self.axis_name)
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, self.axis_name) / n, tree
+        )

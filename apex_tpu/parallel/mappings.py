@@ -1,0 +1,160 @@
+"""Tensor-parallel collective mappings (autograd-paired collectives).
+
+Reference parity: apex/transformer/tensor_parallel/mappings.py — the six
+autograd Functions that define Megatron TP/SP:
+
+| reference (mappings.py)                   | forward            | backward          |
+|-------------------------------------------|--------------------|-------------------|
+| _CopyToModelParallelRegion (:141)         | identity           | all-reduce        |
+| _ReduceFromModelParallelRegion (:159)     | all-reduce         | identity          |
+| _ScatterToModelParallelRegion (:177)      | split last dim     | all-gather        |
+| _GatherFromModelParallelRegion (:195)     | all-gather last    | split             |
+| _ScatterToSequenceParallelRegion (:213)   | split first dim    | all-gather        |
+| _GatherFromSequenceParallelRegion (:231)  | all-gather first   | reduce-scatter    |
+| _ReduceScatterToSequenceParallelRegion (:253) | reduce-scatter | all-gather        |
+
+TPU design: each is a ``jax.custom_vjp`` over ``lax`` collectives with a mesh
+axis name (default 'tp'), usable inside ``shard_map``. Callers (the TP
+layers) skip these entirely when the axis has size 1 — same fast path as the
+reference's world_size==1 shortcuts; over a size-1 shard_map axis the
+collectives themselves are also no-ops.
+"""
+
+import functools
+
+import jax
+
+# -- raw collectives (axis-name-parameterized) ------------------------------
+
+
+def _split_along_axis(x, axis_name: str, dim: int):
+    """Keep this rank's slice of dim (ref: utils.py split_tensor_along_last_dim)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    size = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
+
+
+def _all_gather_dim(x, axis_name: str, dim: int):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter_dim(x, axis_name: str, dim: int):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+# -- custom_vjp pairs -------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name="tp"):
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name="tp"):
+    return jax.lax.psum(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name="tp"):
+    return _split_along_axis(x, axis_name, -1)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along_axis(x, axis_name, -1), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, axis_name, g.ndim - 1),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name="tp"):
+    return _all_gather_dim(x, axis_name, x.ndim - 1)
+
+
+def _gather_fwd(x, axis_name):
+    return _all_gather_dim(x, axis_name, x.ndim - 1), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along_axis(g, axis_name, g.ndim - 1),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name="tp"):
+    return _split_along_axis(x, axis_name, 0)
+
+
+def _scatter_seq_fwd(x, axis_name):
+    return _split_along_axis(x, axis_name, 0), None
+
+
+def _scatter_seq_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, axis_name, 0),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_scatter_seq_fwd, _scatter_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name="tp", to_model_parallel=True):
+    return _all_gather_dim(x, axis_name, 0)
+
+
+def _gather_seq_fwd(x, axis_name, to_model_parallel):
+    return _all_gather_dim(x, axis_name, 0), None
+
+
+def _gather_seq_bwd(axis_name, to_model_parallel, _, g):
+    if to_model_parallel:
+        return (_reduce_scatter_dim(g, axis_name, 0),)
+    return (_split_along_axis(g, axis_name, 0),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gather_seq_fwd, _gather_seq_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name="tp"):
+    return _reduce_scatter_dim(x, axis_name, 0)
+
+
+def _rs_fwd(x, axis_name):
+    return _reduce_scatter_dim(x, axis_name, 0), None
+
+
+def _rs_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, axis_name, 0),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_fwd, _rs_bwd)

@@ -1,0 +1,201 @@
+"""Model-parallel state: the device mesh and its accessor API.
+
+Reference parity: apex/transformer/parallel_state.py (:155
+initialize_model_parallel, :266-407 group construction, :590-755 rank/world
+accessors, :761 destroy). The reference builds ~10 families of NCCL process
+groups (DP, TP, PP, model, embedding, position-embedding, amax, …); on TPU
+*all* of them collapse into named axes of one ``jax.sharding.Mesh``:
+
+    mesh axes = ('dp', 'pp', 'cp', 'tp')     # outermost -> innermost
+
+- 'tp' innermost so tensor-parallel collectives ride the fastest ICI links;
+- 'dp' outermost so data-parallel allreduce can cross DCN on multi-slice;
+- 'cp' (context/sequence-ring parallelism) sits between — an extension over
+  the reference (which has no CP; SURVEY.md §2.5).
+- Megatron sequence parallelism reuses the 'tp' axis (as in the reference,
+  mappings.py:213-272) and needs no axis of its own.
+- The backend-selection dimension (NCCL vs UCC vs IB/Socket hybrid,
+  parallel_state.py:108-153) does not exist: XLA compiles collectives onto
+  ICI/DCN from the mesh layout.
+
+Rank accessors return Python ints when the corresponding axis is unsharded
+and traced values (``lax.axis_index``) inside shard_map otherwise — matching
+how the reference's per-process ints generalize to SPMD.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_WORLD_SIZE: Optional[int] = None
+_VIRTUAL_PIPELINE_RANK: Optional[int] = None
+_PIPELINE_SPLIT_RANK: Optional[int] = None
+
+# canonical axis names
+DATA_AXIS = "dp"
+PIPELINE_AXIS = "pp"
+CONTEXT_AXIS = "cp"
+TENSOR_AXIS = "tp"
+AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    context_parallel_size: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the global mesh (ref: parallel_state.py:155).
+
+    ``devices`` defaults to ``jax.devices()``; data-parallel size is whatever
+    remains after tp*pp*cp, exactly like the reference computes
+    data_parallel_size = world_size // (tp*pp) (parallel_state.py:241).
+    """
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+    if devices is None:
+        devices = jax.devices()
+    world = len(devices)
+    tp, pp, cp = (
+        tensor_model_parallel_size,
+        pipeline_model_parallel_size,
+        context_parallel_size,
+    )
+    if world % (tp * pp * cp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tp ({tp}) x pp ({pp}) x cp ({cp})"
+        )
+    dp = world // (tp * pp * cp)
+    arr = np.asarray(devices).reshape(dp, pp, cp, tp)
+    _MESH = Mesh(arr, AXIS_ORDER)
+    _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size
+    _VIRTUAL_PIPELINE_RANK = 0 if virtual_pipeline_model_parallel_size else None
+    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError("model parallel mesh is not initialized")
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """(ref: parallel_state.py:761)"""
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PIPELINE_WORLD_SIZE = None
+    _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = None
+
+
+# -- world sizes ------------------------------------------------------------
+
+
+def _axis_size(name: str) -> int:
+    return int(get_mesh().shape[name])
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PIPELINE_AXIS)
+
+
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def get_model_parallel_world_size() -> int:
+    return get_tensor_model_parallel_world_size() * get_pipeline_model_parallel_world_size()
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_WORLD_SIZE
+
+
+# -- ranks ------------------------------------------------------------------
+
+
+def _axis_rank(name: str):
+    """Python 0 when the axis is trivial; traced ``lax.axis_index`` inside
+    shard_map over that axis; 0 otherwise (single-controller host view)."""
+    if _MESH is None or int(get_mesh().shape[name]) == 1:
+        return 0
+    try:
+        return jax.lax.axis_index(name)
+    except Exception:
+        return 0
+
+
+def get_tensor_model_parallel_rank():
+    return _axis_rank(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return _axis_rank(PIPELINE_AXIS)
+
+
+def get_context_parallel_rank():
+    return _axis_rank(CONTEXT_AXIS)
+
+
+def get_data_parallel_rank():
+    return _axis_rank(DATA_AXIS)
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PIPELINE_RANK
+    _VIRTUAL_PIPELINE_RANK = rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PIPELINE_SPLIT_RANK
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """(ref: parallel_state.py:649) — traced bool inside shard_map over pp."""
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != 0:
+            return False
+    r = get_pipeline_model_parallel_rank()
+    return r == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    """(ref: parallel_state.py:660)"""
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != (_VIRTUAL_PIPELINE_WORLD_SIZE - 1):
+            return False
+    r = get_pipeline_model_parallel_rank()
+    return r == get_pipeline_model_parallel_world_size() - 1
+
+
+# -- sharding helpers -------------------------------------------------------
+
+
+def named_sharding(*spec):
+    """NamedSharding over the global mesh for a PartitionSpec."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))

@@ -1,0 +1,78 @@
+"""Parallel RNG and activation checkpointing.
+
+Reference parity: apex/transformer/tensor_parallel/random.py —
+``CudaRNGStatesTracker`` (:124) forks CUDA RNG state per region,
+``model_parallel_cuda_manual_seed`` (:204) gives TP rank i the seed
+``seed + 2718 + tp_rank`` for model-parallel regions and the plain seed for
+data-parallel regions, and ``CheckpointFunction`` (:237) re-runs forward in
+backward with the RNG state restored.
+
+TPU design: JAX PRNG keys are pure values — the entire stateful tracker
+collapses into ``jax.random.fold_in``:
+
+- model-parallel region key  = fold_in(fold_in(key, 2718), tp_rank)
+- data-parallel region key   = key (same on all TP ranks)
+
+and activation checkpointing is ``jax.checkpoint`` (recompute with identical
+keys by construction — no fork/restore machinery needed; this is hard part
+"RNG exactness" solved by design).
+"""
+
+import functools
+from typing import Callable
+
+import jax
+
+from apex_tpu.parallel import parallel_state
+
+_MODEL_PARALLEL_OFFSET = 2718  # matches the reference's seed offset constant
+
+
+def model_parallel_rng_key(key, axis_name: str = "tp"):
+    """Key for model-parallel regions: distinct per TP rank.
+
+    (ref: random.py:204-236 — tensor-model-parallel seed = seed + 2718 + rank)
+    """
+    key = jax.random.fold_in(key, _MODEL_PARALLEL_OFFSET)
+    if parallel_state.model_parallel_is_initialized():
+        if parallel_state.get_tensor_model_parallel_world_size() > 1:
+            rank = jax.lax.axis_index(axis_name)
+            key = jax.random.fold_in(key, rank)
+    return key
+
+
+def data_parallel_rng_key(key):
+    """Key for data-parallel regions: identical on all TP ranks (ref:
+    random.py — default generator keeps the data-parallel seed)."""
+    return key
+
+
+def model_parallel_seed(seed: int, tp_rank: int) -> int:
+    """Host-side helper mirroring the reference's integer seed math, for
+    tests that compare against closed-form rank seeds."""
+    return seed + _MODEL_PARALLEL_OFFSET + tp_rank
+
+
+def checkpoint(fn: Callable = None, *, policy=None, prevent_cse: bool = True):
+    """Activation checkpointing (ref: tensor_parallel.random.checkpoint,
+    random.py:237 CheckpointFunction).
+
+    A thin alias of ``jax.checkpoint``: forward runs without saving
+    intermediates; backward recomputes. ``policy`` maps to
+    ``jax.checkpoint_policies`` (e.g. ``dots_saveable``) — the TPU analogue
+    of the reference's ``distribute_saved_activations`` memory knobs.
+    """
+    if fn is None:
+        return functools.partial(checkpoint, policy=policy, prevent_cse=prevent_cse)
+    return jax.checkpoint(fn, policy=policy, prevent_cse=prevent_cse)
+
+
+# saved-activation distribution (random.py:246-266 partitions saved tensors
+# across TP ranks): on TPU, save activations sequence-sharded instead
+def distribute_saved_activations_policy():
+    """Checkpoint policy that offloads nothing but marks only cheap
+    recomputes: save matmul outputs, recompute elementwise. The
+    sequence-sharded variant comes from running the checkpointed fn under
+    shard_map with SP enabled — saved residuals are then already 1/tp-sized,
+    which is what the reference's distribute_saved_activations achieves."""
+    return jax.checkpoint_policies.dots_saveable

@@ -1,0 +1,111 @@
+"""Synchronized BatchNorm over the data-parallel axis.
+
+Reference parity: apex.parallel.SyncBatchNorm — both the Python fallback
+(parallel/sync_batchnorm.py:9) and the optimized CUDA path
+(optimized_sync_batchnorm_kernel.py:10: ``syncbn.welford_mean_var`` per
+rank, all_gather of per-rank stats, ``welford_parallel`` combine :43) — and
+``convert_syncbn_model`` (parallel/__init__.py:21).
+
+TPU design: per-shard moments + a count-weighted psum combine (numerically
+the welford_parallel merge, expressed as two fused reductions):
+
+    N      = psum(n_i)
+    mean   = psum(n_i * m_i) / N
+    var    = psum(n_i * (v_i + m_i^2)) / N - mean^2
+
+which is exact for unequal per-shard counts (the reference's
+two_gpu_test_different_batch_size case — SURVEY.md hard part #6).
+Channel-last-ness is not a thing on TPU (XLA picks layouts).
+"""
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SyncBatchNorm(nn.Module):
+    """flax BatchNorm drop-in that reduces statistics over mesh axes.
+
+    ``axis_names``: mesh axes to sync over (default ('dp',)); pass () to
+    recover a local BatchNorm. Running stats live in the 'batch_stats'
+    collection like flax.linen.BatchNorm. ``momentum`` follows the torch
+    convention: new_running = (1 - momentum) * running + momentum * batch.
+    """
+
+    use_running_average: Optional[bool] = None
+    momentum: float = 0.1
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+    axis_names: Sequence[str] = ("dp",)
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average, use_running_average
+        )
+        features = x.shape[-1]
+        reduce_axes = tuple(range(x.ndim - 1))
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            n_local = jnp.asarray(
+                jnp.prod(jnp.asarray([x.shape[a] for a in reduce_axes])), jnp.float32
+            )
+            m_local = jnp.mean(xf, axis=reduce_axes)
+            v_local = jnp.mean(jnp.square(xf), axis=reduce_axes) - jnp.square(m_local)
+            n, m_sum, s_sum = n_local, m_local * n_local, (
+                v_local + jnp.square(m_local)
+            ) * n_local
+            for ax in self.axis_names:
+                try:
+                    n = jax.lax.psum(n, ax)
+                    m_sum = jax.lax.psum(m_sum, ax)
+                    s_sum = jax.lax.psum(s_sum, ax)
+                except NameError:  # axis not in scope -> local BN
+                    pass
+            mean = m_sum / n
+            var = s_sum / n - jnp.square(mean)
+
+            if not self.is_initializing():
+                ra_mean.value = (
+                    1.0 - self.momentum
+                ) * ra_mean.value + self.momentum * mean
+                # unbiased running var (torch SyncBN semantics)
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_var.value = (
+                    1.0 - self.momentum
+                ) * ra_var.value + self.momentum * unbiased
+
+        y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            scale = self.param("scale", nn.initializers.ones_init(), (features,), jnp.float32)
+            y = y * scale
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(), (features,), jnp.float32)
+            y = y + bias
+        return y.astype(self.dtype or x.dtype)
+
+
+def convert_syncbn_model(*args, **kwargs):
+    """The reference performs module surgery BN -> SyncBN
+    (parallel/__init__.py:21). flax modules are immutable; select
+    SyncBatchNorm at model-construction time instead (our models take a
+    ``norm`` factory — see apex_tpu.models.resnet)."""
+    raise NotImplementedError(
+        "flax modules are declarative: construct models with "
+        "apex_tpu.parallel.SyncBatchNorm directly (see apex_tpu.models.resnet "
+        "norm= argument) instead of post-hoc surgery."
+    )

@@ -1,0 +1,48 @@
+"""Tensor-parallel utilities.
+
+Reference parity: apex/transformer/tensor_parallel/utils.py
+(split_tensor_along_last_dim :22, VocabUtility :46) and
+tensor_parallel/data.py (broadcast_data :80).
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def split_tensor_along_last_dim(x, num_partitions: int) -> Sequence[jax.Array]:
+    """(ref: utils.py:22)"""
+    return jnp.split(x, num_partitions, axis=-1)
+
+
+class VocabUtility:
+    """Vocab range math (ref: utils.py:46)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        start = rank * per_partition_vocab_size
+        return start, start + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(
+        global_vocab_size: int, rank, world_size: int
+    ) -> Tuple:
+        per = global_vocab_size // world_size
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size
+        )
+
+
+def broadcast_data(keys, data, dtype=None):
+    """(ref: data.py:80) — broadcast batch data from TP rank 0.
+
+    Under single-controller SPMD every device already sees the same host
+    arrays, so this is an identity kept for API parity; multi-controller
+    setups get consistency from feeding identical per-process data (the
+    jax.distributed contract).
+    """
+    del dtype
+    return {k: data[k] for k in keys}

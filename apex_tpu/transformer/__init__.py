@@ -1,0 +1,5 @@
+"""Megatron-style transformer building blocks (ref: apex/transformer)."""
+
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+
+__all__ = ["AttnMaskType", "AttnType", "LayerType", "ModelType"]

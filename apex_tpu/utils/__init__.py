@@ -1,0 +1,13 @@
+from apex_tpu.utils.pytree import (
+    tree_cast,
+    tree_any_non_finite,
+    tree_zeros_like,
+    tree_map_with_path,
+)
+
+__all__ = [
+    "tree_cast",
+    "tree_any_non_finite",
+    "tree_zeros_like",
+    "tree_map_with_path",
+]

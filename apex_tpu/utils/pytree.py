@@ -1,0 +1,94 @@
+"""Pytree utilities shared across apex_tpu.
+
+These replace the tensor-list plumbing of the reference (apex_C flatten /
+multi_tensor lists) with pytree-native equivalents: on TPU, parameter
+collections are pytrees of jax.Arrays and XLA fuses elementwise work across
+leaves inside a single jit, so most of the reference's host-side bucketing
+machinery disappears.
+"""
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    """Cast every inexact (floating) leaf of ``tree`` to ``dtype``.
+
+    Integer / bool leaves are left untouched (matches the reference's
+    ``convert_network`` behavior of only touching float tensors,
+    ref: fp16_utils/fp16util.py:35-59).
+    """
+    if dtype is None:
+        return tree
+
+    def _cast(x):
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+def tree_any_non_finite(tree: Any) -> jax.Array:
+    """Return a scalar bool array: does any float leaf contain NaN/Inf?
+
+    TPU-native replacement for the reference's ``noop_flag`` buffer that the
+    CUDA multi_tensor kernels set on overflow (ref: csrc/multi_tensor_apply.cuh
+    noop_flag short-circuit). Here it is a pure reduction that XLA fuses into
+    whatever computation produced the leaves.
+    """
+    leaves = [
+        x
+        for x in jax.tree_util.tree_leaves(tree)
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact)
+    ]
+    if not leaves:
+        return jnp.asarray(False)
+    per_leaf = [jnp.logical_not(jnp.all(jnp.isfinite(x))) for x in leaves]
+    return jnp.any(jnp.stack(per_leaf))
+
+
+def tree_zeros_like(tree: Any, dtype=None) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x, dtype=dtype or jnp.asarray(x).dtype), tree
+    )
+
+
+def tree_map_multi(fn: Callable, n_out: int, *trees):
+    """tree_map for an ``fn`` returning ``n_out`` values: returns ``n_out``
+    trees, computing ``fn`` once per leaf (avoids the paired-tree_map
+    double-compute pattern in multi-state optimizer updates)."""
+    leaves, treedef = jax.tree_util.tree_flatten(trees[0])
+    rest = [jax.tree_util.tree_leaves(t) for t in trees[1:]]
+    results = [fn(*args) for args in zip(leaves, *rest)]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [r[i] for r in results])
+        for i in range(n_out)
+    )
+
+
+def tree_map_with_path(fn: Callable, tree: Any) -> Any:
+    """tree_map where ``fn(path_str, leaf)`` receives a '/'-joined key path.
+
+    Used by amp's keep-batchnorm-fp32 logic to select norm/bn parameters by
+    name (ref: fp16_utils/fp16util.py:60-80 selects BN modules by type; in a
+    functional pytree world the analogue is a path predicate).
+    """
+
+    def _fn(path, leaf):
+        keys = []
+        for p in path:
+            if hasattr(p, "key"):
+                keys.append(str(p.key))
+            elif hasattr(p, "idx"):
+                keys.append(str(p.idx))
+            elif hasattr(p, "name"):
+                keys.append(str(p.name))
+            else:  # pragma: no cover
+                keys.append(str(p))
+        return fn("/".join(keys), leaf)
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)

@@ -1,0 +1,44 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test topology (DistributedTestBase spawns
+world_size<=4 single-node processes; apex/transformer/testing/
+distributed_test_base.py:36-38) — here a single JAX process with 8 virtual
+CPU devices exercises every mesh/collective path, and Pallas kernels run in
+interpret mode.
+"""
+
+import os
+
+# Must be set before jax initializes its backends. Force-override: the outer
+# environment may point JAX_PLATFORMS at the real TPU (axon), and the axon
+# plugin's sitecustomize also overrides the jax config — tests always run on
+# the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_parallel_state():
+    yield
+    try:
+        from apex_tpu.parallel import parallel_state
+
+        parallel_state.destroy_model_parallel()
+    except Exception:
+        pass

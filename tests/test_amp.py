@@ -1,0 +1,181 @@
+"""Mixed-precision tests.
+
+Mirrors reference tests/L0/run_amp: opt-level properties, cast behavior,
+dynamic scaler schedule (incl. overflow), checkpoint round-trip, skip-step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optimizers import fused_adam
+
+
+class TestPolicies:
+    def test_opt_level_properties(self):
+        p0 = amp.O0()
+        assert p0.cast_model_type == jnp.float32 and not p0.master_weights
+        p1 = amp.O1()
+        assert p1.cast_model_type is None and p1.compute_dtype == jnp.bfloat16
+        p2 = amp.O2(jnp.float16)
+        assert p2.cast_model_type == jnp.float16
+        assert p2.master_weights and p2.keep_batchnorm_fp32
+        assert p2.loss_scale == "dynamic"
+        p3 = amp.O3(jnp.float16)
+        assert not p3.master_weights and not p3.keep_batchnorm_fp32
+
+    def test_bf16_o2_has_no_loss_scaling(self):
+        assert amp.O2(jnp.bfloat16).loss_scale == 1.0
+
+    def test_cast_params_keeps_norms_fp32(self):
+        params = {
+            "dense": {"kernel": jnp.ones((4, 4))},
+            "LayerNorm_0": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+            "step": jnp.asarray(3),  # int leaf untouched
+        }
+        out = amp.O2(jnp.bfloat16).cast_params(params)
+        assert out["dense"]["kernel"].dtype == jnp.bfloat16
+        assert out["LayerNorm_0"]["scale"].dtype == jnp.float32
+        assert out["step"].dtype == jnp.int32
+
+    def test_o3_casts_everything(self):
+        params = {"LayerNorm_0": {"scale": jnp.ones((4,))}}
+        out = amp.O3(jnp.bfloat16).cast_params(params)
+        assert out["LayerNorm_0"]["scale"].dtype == jnp.bfloat16
+
+    def test_wrap_apply_casts_args_and_kwargs(self):
+        policy = amp.O1(jnp.bfloat16)
+        seen = {}
+
+        def apply_fn(params, x, y=None):
+            seen["x"] = x.dtype
+            seen["y"] = y.dtype
+            return x
+
+        out = policy.wrap_apply(apply_fn)({}, jnp.ones((2,)), y=jnp.ones((2,)))
+        assert seen["x"] == jnp.bfloat16 and seen["y"] == jnp.bfloat16
+        assert out.dtype == jnp.float32  # outputs come back fp32
+
+    def test_initialize_bad_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.initialize(opt_level="O4")
+
+
+class TestLossScaler:
+    def test_dynamic_schedule(self):
+        s = amp.LossScaler(loss_scale="dynamic", init_scale=16.0, growth_interval=3)
+        st = s.init()
+        # 3 clean steps -> growth
+        for _ in range(3):
+            st = s.update(st, jnp.asarray(False))
+        assert float(st.scale) == 32.0
+        # overflow -> halve + reset tracker
+        st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 16.0
+        assert int(st.growth_tracker) == 0
+        assert int(st.skipped) == 1
+
+    def test_min_scale_clamp(self):
+        s = amp.LossScaler(loss_scale="dynamic", init_scale=2.0, min_loss_scale=1.0)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 1.0
+
+    def test_static_scale_never_changes(self):
+        s = amp.LossScaler(loss_scale=128.0)
+        st = s.init()
+        st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 128.0
+
+    def test_unscale_and_overflow_flag(self):
+        s = amp.LossScaler(loss_scale=4.0)
+        st = s.init()
+        grads = {"w": jnp.asarray([4.0, 8.0])}
+        out, inf = s.unscale(st, grads)
+        np.testing.assert_allclose(np.asarray(out["w"]), [1.0, 2.0])
+        assert not bool(inf)
+        grads = {"w": jnp.asarray([jnp.inf, 1.0])}
+        _, inf = s.unscale(st, grads)
+        assert bool(inf)
+
+    def test_state_dict_roundtrip(self):
+        s = amp.LossScaler(loss_scale="dynamic")
+        st = s.init()
+        st = s.update(st, jnp.asarray(True))
+        d = s.state_dict(st)
+        st2 = s.load_state_dict(d)
+        assert float(st2.scale) == float(st.scale)
+        assert int(st2.skipped) == int(st.skipped)
+
+
+class TestAmpOptimizer:
+    def _setup(self, opt_level="O2", half=jnp.float16):
+        params = {"w": jnp.ones((4,), jnp.float32), "b": jnp.zeros((2,), jnp.float32)}
+        tx = fused_adam(lr=0.1)
+        params, amp_opt, policy = amp.initialize(
+            params, tx, opt_level=opt_level, half_dtype=half
+        )
+        return params, amp_opt, policy
+
+    def test_o2_master_weights_fp32(self):
+        params, amp_opt, _ = self._setup()
+        assert params["w"].dtype == jnp.float16
+        state = amp_opt.init(params)
+        assert state.master["w"].dtype == jnp.float32
+
+    def test_step_updates_params(self):
+        params, amp_opt, _ = self._setup()
+        state = amp_opt.init(params)
+        # scaled grads must stay representable in fp16 (scale is 2**16)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1024.0, p.dtype), params
+        )
+        new_params, new_state, info = amp_opt.step(grads, state, params)
+        assert not bool(info["found_inf"])
+        assert float(new_params["w"][0]) < 1.0  # moved against the gradient
+        assert new_params["w"].dtype == jnp.float16
+
+    def test_overflow_skips_step_and_halves_scale(self):
+        params, amp_opt, _ = self._setup()
+        state = amp_opt.init(params)
+        scale0 = float(state.scaler.scale)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.inf, p.dtype), params
+        )
+        new_params, new_state, info = amp_opt.step(grads, state, params)
+        assert bool(info["found_inf"])
+        np.testing.assert_array_equal(
+            np.asarray(new_params["w"], np.float32), np.asarray(params["w"], np.float32)
+        )
+        assert float(new_state.scaler.scale) == scale0 / 2
+
+    def test_jitted_training_decreases_loss(self):
+        k = jax.random.PRNGKey(0)
+        params = {"w": jax.random.normal(k, (8, 1), jnp.float32)}
+        tx = fused_adam(lr=0.05)
+        params, amp_opt, policy = amp.initialize(params, tx, opt_level="O2")
+        state = amp_opt.init(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, 8))
+        y = x @ jnp.arange(8.0)[:, None]
+
+        def loss_fn(p):
+            pred = policy.cast_inputs(x) @ p["w"]
+            return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+        @jax.jit
+        def step(params, state):
+            loss, grads = jax.value_and_grad(
+                lambda p: amp_opt.scale_loss(loss_fn(p), state)
+            )(params)
+            params, state, _ = amp_opt.step(grads, state, params)
+            return params, state, loss
+
+        losses = []
+        for _ in range(60):
+            params, state, loss = step(params, state)
+            losses.append(float(loss) / float(state.scaler.scale))
+        assert losses[-1] < losses[0] * 0.5

@@ -1,0 +1,105 @@
+"""Fused LayerNorm/RMSNorm numeric parity tests.
+
+Mirrors reference tests/L0/run_fused_layer_norm/test_fused_layer_norm.py:
+fused implementation vs a plain reference, fwd and grads, multiple dtypes,
+including the Pallas kernel path (interpret mode on CPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import layer_norm, rms_norm
+
+
+def _ref_ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = ((xf - mean) ** 2).mean(-1, keepdims=True)
+    return ((xf - mean) / jnp.sqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _ref_rms(x, w, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    ms = (xf**2).mean(-1, keepdims=True)
+    return (xf / jnp.sqrt(ms + eps) * w).astype(x.dtype)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_layer_norm_forward(rng, impl, dtype):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    x = jax.random.normal(k1, (4, 12, 256), dtype)
+    w = jax.random.normal(k2, (256,), jnp.float32) * 0.1 + 1.0
+    b = jax.random.normal(k3, (256,), jnp.float32) * 0.1
+    out = layer_norm(x, w, b, impl=impl)
+    ref = _ref_ln(x, w, b)
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=tol, rtol=tol
+    )
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_layer_norm_grads(rng, impl):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    x = jax.random.normal(k1, (24, 128), jnp.float32)
+    w = jax.random.normal(k2, (128,), jnp.float32) * 0.1 + 1.0
+    b = jax.random.normal(k3, (128,), jnp.float32) * 0.1
+    ct = jax.random.normal(k4, (24, 128), jnp.float32)
+
+    def loss(fn):
+        return lambda x, w, b: jnp.sum(fn(x, w, b) * ct)
+
+    gx, gw, gb = jax.grad(loss(lambda x, w, b: layer_norm(x, w, b, impl=impl)), (0, 1, 2))(
+        x, w, b
+    )
+    rx, rw, rb = jax.grad(loss(_ref_ln), (0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(rb), atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_rms_norm_forward_and_grads(rng, impl):
+    k1, k2, k4 = jax.random.split(rng, 3)
+    x = jax.random.normal(k1, (24, 128), jnp.float32)
+    w = jax.random.normal(k2, (128,), jnp.float32) * 0.1 + 1.0
+    ct = jax.random.normal(k4, (24, 128), jnp.float32)
+    out = rms_norm(x, w, impl=impl)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref_rms(x, w)), atol=1e-5, rtol=1e-5
+    )
+    gx, gw = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w, impl=impl) * ct), (0, 1))(x, w)
+    rx, rw = jax.grad(lambda x, w: jnp.sum(_ref_rms(x, w) * ct), (0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), atol=1e-4, rtol=1e-4)
+
+
+def test_layer_norm_odd_hidden_falls_back(rng):
+    # hidden not a multiple of 128 lanes -> XLA path, still correct
+    x = jax.random.normal(rng, (7, 100), jnp.float32)
+    w = jnp.ones((100,))
+    b = jnp.zeros((100,))
+    out = layer_norm(x, w, b, impl="auto")
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_ref_ln(x, w, b)), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_layer_norm_non_affine(rng):
+    x = jax.random.normal(rng, (7, 64), jnp.float32)
+    out = layer_norm(x)
+    ref = _ref_ln(x, jnp.ones((64,)), jnp.zeros((64,)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_layer_norm_memory_efficient(rng):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    x = jax.random.normal(k1, (8, 128), jnp.float32)
+    w = jax.random.normal(k2, (128,)) * 0.1 + 1.0
+    b = jax.random.normal(k3, (128,)) * 0.1
+    a = layer_norm(x, w, b, memory_efficient=True, impl="xla")
+    bb = layer_norm(x, w, b, memory_efficient=False, impl="xla")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-6)

@@ -1,0 +1,112 @@
+"""Tests for the multi-tensor engine.
+
+Mirrors reference tests/L0/run_amp/test_multi_tensor_scale.py,
+test_multi_tensor_axpby.py, test_multi_tensor_l2norm.py: compare fused ops
+against manual math, including overflow injection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops import (
+    flatten,
+    unflatten,
+    flatten_pytree,
+    unflatten_pytree,
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+)
+from apex_tpu.utils import tree_any_non_finite
+
+
+def _tree(rng, dtype=jnp.float32):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "a": jax.random.normal(k1, (33, 17), dtype),
+        "b": {"c": jax.random.normal(k2, (128,), dtype)},
+        "d": jax.random.normal(k3, (5, 4, 3), dtype),
+    }
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    tensors = [jnp.arange(6.0).reshape(2, 3), jnp.ones((4,)), jnp.zeros((2, 2))]
+    flat = flatten(tensors)
+    assert flat.shape == (14,)
+    out = unflatten(flat, tensors)
+    for a, b in zip(out, tensors):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flatten_pytree_roundtrip(rng):
+    tree = _tree(rng)
+    flat, spec = flatten_pytree(tree)
+    assert flat.shape[0] % (2048 * 32) == 0  # padded to chunk
+    out = unflatten_pytree(flat, spec)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        out,
+        tree,
+    )
+
+
+@pytest.mark.parametrize("scale", [1.0, 0.25, 65536.0])
+def test_multi_tensor_scale(rng, scale):
+    tree = _tree(rng)
+    out, flag = multi_tensor_scale(tree, scale)
+    jax.tree_util.tree_map(
+        lambda o, t: np.testing.assert_allclose(
+            np.asarray(o), np.asarray(t) * scale, rtol=1e-6
+        ),
+        out,
+        tree,
+    )
+    assert not bool(flag)
+
+
+def test_multi_tensor_scale_overflow(rng):
+    tree = _tree(rng)
+    tree["a"] = tree["a"].at[0, 0].set(jnp.inf)
+    _, flag = multi_tensor_scale(tree, 2.0)
+    assert bool(flag)
+    tree["a"] = tree["a"].at[0, 0].set(jnp.nan)
+    _, flag = multi_tensor_scale(tree, 2.0)
+    assert bool(flag)
+
+
+def test_multi_tensor_axpby(rng):
+    x = _tree(rng)
+    y = _tree(jax.random.PRNGKey(1))
+    out, flag = multi_tensor_axpby(2.0, -0.5, x, y)
+    jax.tree_util.tree_map(
+        lambda o, a, b: np.testing.assert_allclose(
+            np.asarray(o), 2.0 * np.asarray(a) - 0.5 * np.asarray(b), rtol=1e-6
+        ),
+        out,
+        x,
+        y,
+    )
+    assert not bool(flag)
+
+
+def test_multi_tensor_l2norm(rng):
+    tree = _tree(rng)
+    total, per = multi_tensor_l2norm(tree, per_tensor=True)
+    leaves = jax.tree_util.tree_leaves(tree)
+    expected = np.sqrt(sum(float(jnp.sum(x.astype(jnp.float32) ** 2)) for x in leaves))
+    np.testing.assert_allclose(float(total), expected, rtol=1e-6)
+    assert per.shape == (len(leaves),)
+    np.testing.assert_allclose(
+        float(jnp.sqrt(jnp.sum(per**2))), expected, rtol=1e-6
+    )
+
+
+def test_tree_any_non_finite(rng):
+    tree = _tree(rng)
+    assert not bool(tree_any_non_finite(tree))
+    tree["b"]["c"] = tree["b"]["c"].at[3].set(-jnp.inf)
+    assert bool(tree_any_non_finite(tree))
+    # integer leaves are ignored
+    assert not bool(tree_any_non_finite({"i": jnp.arange(3)}))

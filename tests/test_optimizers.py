@@ -1,0 +1,192 @@
+"""Fused optimizer tests.
+
+Mirrors reference tests/L0/run_optimizers/test_fused_optimizer.py,
+test_adam.py, test_lamb.py: compare fused transforms against reference
+implementations (optax / manual math) with tolerances.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.optimizers import (
+    fused_adam,
+    fused_sgd,
+    fused_lamb,
+    fused_novograd,
+    fused_adagrad,
+    larc,
+    clip_grad_norm,
+)
+
+
+def _params(rng):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w": jax.random.normal(k1, (17, 5), jnp.float32),
+        "b": jax.random.normal(k2, (5,), jnp.float32),
+    }
+
+
+def _run(tx, params, grads_fn, steps=5):
+    state = tx.init(params)
+    for i in range(steps):
+        updates, state = tx.update(grads_fn(i, params), state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+class TestFusedAdam:
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_matches_optax_adamw(self, rng, wd):
+        params = _params(rng)
+        gkey = jax.random.PRNGKey(7)
+        grads_fn = lambda i, p: jax.tree_util.tree_map(
+            lambda x: jax.random.normal(jax.random.fold_in(gkey, i), x.shape), p
+        )
+        ours = _run(fused_adam(lr=1e-2, weight_decay=wd), dict(params), grads_fn)
+        ref_tx = (
+            optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=wd)
+            if wd
+            else optax.adam(1e-2, b1=0.9, b2=0.999, eps=1e-8)
+        )
+        ref = _run(ref_tx, dict(params), grads_fn)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5
+            ),
+            ours,
+            ref,
+        )
+
+    def test_l2_mode(self, rng):
+        # adam_w_mode=False folds wd into the gradient (L2), diverging from adamw
+        params = _params(rng)
+        grads_fn = lambda i, p: jax.tree_util.tree_map(jnp.ones_like, p)
+        l2 = _run(fused_adam(lr=1e-2, weight_decay=0.5, adam_w_mode=False), dict(params), grads_fn, 3)
+        dec = _run(fused_adam(lr=1e-2, weight_decay=0.5, adam_w_mode=True), dict(params), grads_fn, 3)
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), l2, dec
+        )
+        assert max(jax.tree_util.tree_leaves(diffs)) > 1e-5
+
+
+class TestFusedSGD:
+    @pytest.mark.parametrize("momentum,nesterov", [(0.0, False), (0.9, False), (0.9, True)])
+    def test_matches_torch_semantics(self, rng, momentum, nesterov):
+        # manual torch-style reference
+        params = _params(rng)
+        g = jax.tree_util.tree_map(jnp.ones_like, params)
+        tx = fused_sgd(lr=0.1, momentum=momentum, nesterov=nesterov)
+        state = tx.init(params)
+        p_ref = {k: np.asarray(v).copy() for k, v in params.items()}
+        buf = {k: None for k in params}
+        p = params
+        for _ in range(4):
+            updates, state = tx.update(g, state, p)
+            p = optax.apply_updates(p, updates)
+            for k in p_ref:
+                gk = np.ones_like(p_ref[k])
+                if momentum:
+                    buf[k] = gk if buf[k] is None else momentum * buf[k] + gk
+                    d = gk + momentum * buf[k] if nesterov else buf[k]
+                else:
+                    d = gk
+                p_ref[k] = p_ref[k] - 0.1 * d
+        for k in p_ref:
+            np.testing.assert_allclose(np.asarray(p[k]), p_ref[k], rtol=1e-5, atol=1e-6)
+
+
+class TestFusedLAMB:
+    def test_trust_ratio_scales_step(self, rng):
+        params = {"w": jnp.full((4, 4), 10.0)}
+        g = {"w": jnp.full((4, 4), 1e-3)}
+        tx = fused_lamb(lr=0.1, weight_decay=0.0, max_grad_norm=0.0)
+        state = tx.init(params)
+        updates, _ = tx.update(g, state, params)
+        # trust ratio ||p||/||u|| should scale the tiny update up
+        assert float(jnp.abs(updates["w"]).max()) > 1e-3
+
+    def test_grad_clipping_applied(self, rng):
+        params = _params(rng)
+        big = jax.tree_util.tree_map(lambda p: 100.0 * jnp.ones_like(p), params)
+        tx = fused_lamb(lr=0.1, max_grad_norm=1.0)
+        state = tx.init(params)
+        updates, _ = tx.update(big, state, params)
+        assert np.isfinite(
+            np.asarray(jax.tree_util.tree_leaves(updates)[0])
+        ).all()
+
+    def test_loss_decreases(self, rng):
+        params = {"w": jax.random.normal(rng, (8, 1))}
+        x = jax.random.normal(jax.random.PRNGKey(2), (32, 8))
+        y = x @ jnp.ones((8, 1))
+        tx = fused_lamb(lr=0.05)
+        state = tx.init(params)
+
+        def loss(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        l0 = float(loss(params))
+        for _ in range(40):
+            g = jax.grad(loss)(params)
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        assert float(loss(params)) < l0 * 0.5
+
+
+class TestFusedNovoGradAdagrad:
+    def test_novograd_loss_decreases(self, rng):
+        params = {"w": jax.random.normal(rng, (8, 1))}
+        x = jax.random.normal(jax.random.PRNGKey(2), (32, 8))
+        y = x @ jnp.ones((8, 1))
+        tx = fused_novograd(lr=0.3)
+        state = tx.init(params)
+
+        def loss(p):
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        l0 = float(loss(params))
+        for _ in range(40):
+            g = jax.grad(loss)(params)
+            updates, state = tx.update(g, state, params)
+            params = optax.apply_updates(params, updates)
+        assert float(loss(params)) < l0 * 0.5
+
+    def test_adagrad_matches_manual(self, rng):
+        params = {"w": jnp.ones((3,))}
+        g = {"w": jnp.asarray([1.0, 2.0, 3.0])}
+        tx = fused_adagrad(lr=0.1, eps=1e-10)
+        state = tx.init(params)
+        updates, state = tx.update(g, state, params)
+        expected = -0.1 * np.asarray([1.0, 2.0, 3.0]) / (
+            np.sqrt(np.asarray([1.0, 4.0, 9.0])) + 1e-10
+        )
+        np.testing.assert_allclose(np.asarray(updates["w"]), expected, rtol=1e-6)
+
+
+class TestLarcClip:
+    def test_larc_clips_effective_lr(self, rng):
+        params = {"w": jnp.full((4,), 1e-3)}  # tiny weights
+        g = {"w": jnp.full((4,), 10.0)}  # huge grads
+        tx = larc(fused_sgd(lr=1.0), lr=1.0, trust_coefficient=0.02)
+        state = tx.init(params)
+        updates, _ = tx.update(g, state, params)
+        # LARC should have shrunk the grads drastically
+        assert float(jnp.abs(updates["w"]).max()) < 1.0
+
+    def test_clip_grad_norm(self, rng):
+        grads = {"a": jnp.full((10,), 3.0), "b": jnp.full((10,), 4.0)}
+        clipped, norm = clip_grad_norm(grads, max_norm=1.0)
+        total = float(jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree_util.tree_leaves(clipped))))
+        np.testing.assert_allclose(float(norm), np.sqrt(90 + 160), rtol=1e-5)
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_clip_noop_below_threshold(self, rng):
+        grads = {"a": jnp.asarray([0.1, 0.2])}
+        clipped, _ = clip_grad_norm(grads, max_norm=10.0)
+        np.testing.assert_allclose(
+            np.asarray(clipped["a"]), np.asarray(grads["a"]), rtol=1e-6
+        )
