@@ -1,0 +1,7 @@
+"""Flagship models (ref: apex/transformer/testing/standalone_{gpt,bert}.py,
+examples/imagenet) re-built TPU-native on the apex_tpu transformer stack."""
+
+from apex_tpu.models.gpt import GPTModel, gpt_loss_fn
+from apex_tpu.models.bert import BertModel
+
+__all__ = ["GPTModel", "BertModel", "gpt_loss_fn"]

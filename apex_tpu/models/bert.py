@@ -1,0 +1,150 @@
+"""BERT model.
+
+Reference parity: apex/transformer/testing/standalone_bert.py — bidirectional
+(padding-mask) transformer with tokentype embeddings, an LM head (dense +
+gelu + LN + tied-embedding logits) and a binary (NSP) head off a tanh pooler.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import Embedding
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.parallel.layers import _tp_size
+from apex_tpu.parallel.mappings import gather_from_sequence_parallel_region
+from apex_tpu.transformer.config import TransformerConfig
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.layer import ParallelTransformer
+
+
+def bert_extended_attention_mask(attention_mask):
+    """(b, s) 1=keep → (b, 1, s, s) True=masked-out.
+
+    Ref: bert_extended_attention_mask in standalone_bert.py — attention_mask
+    is the padding indicator; the extended mask is the outer product inverted.
+    """
+    m = attention_mask.astype(bool)
+    ext = m[:, None, :] & m[:, :, None]  # (b, s, s)
+    return ~ext[:, None, :, :]
+
+
+class Pooler(nn.Module):
+    """Tanh pooler over the first token (ref: Pooler in
+    standalone_transformer_lm.py)."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states):  # (s, b, h)
+        first = hidden_states[0]  # (b, h)
+        d = nn.Dense(self.config.hidden_size, param_dtype=self.config.params_dtype)(
+            first
+        )
+        return jnp.tanh(d.astype(jnp.float32)).astype(hidden_states.dtype)
+
+
+class BertModel(nn.Module):
+    """BERT with LM + optional binary head.
+
+    Returns (lm_loss_or_logits, binary_logits) when ``add_binary_head``;
+    vocab logits stay tp-sharded for vocab_parallel_cross_entropy.
+    """
+
+    config: TransformerConfig
+    num_tokentypes: int = 2
+    add_binary_head: bool = True
+    pre_process: bool = True
+    post_process: bool = True
+    num_layers: Optional[int] = None
+
+    def setup(self):
+        cfg = self.config
+        if self.pre_process or (
+            self.post_process and cfg.share_embeddings_and_output_weights
+        ):
+            self.embedding = Embedding(
+                config=cfg, num_tokentypes=self.num_tokentypes, name="embedding"
+            )
+        self.transformer = ParallelTransformer(
+            config=cfg,
+            num_layers=self.num_layers,
+            post_layer_norm=self.post_process,
+            attn_mask_type=AttnMaskType.padding,
+            name="transformer",
+        )
+        if self.post_process:
+            self.lm_dense = nn.Dense(
+                cfg.hidden_size, param_dtype=cfg.params_dtype, name="lm_head_dense"
+            )
+            self.lm_norm_scale = self.param(
+                "lm_head_norm_scale", nn.initializers.ones_init(), (cfg.hidden_size,)
+            )
+            self.lm_norm_bias = self.param(
+                "lm_head_norm_bias", nn.initializers.zeros_init(), (cfg.hidden_size,)
+            )
+            if self.add_binary_head:
+                self.pooler = Pooler(config=cfg, name="pooler")
+                self.binary_head = nn.Dense(
+                    2, param_dtype=cfg.params_dtype, name="binary_head"
+                )
+
+    def __call__(
+        self,
+        tokens,
+        attention_mask=None,
+        tokentype_ids=None,
+        lm_labels=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        ext_mask = None
+        if attention_mask is not None:
+            ext_mask = bert_extended_attention_mask(attention_mask)
+        if self.pre_process:
+            h = self.embedding(
+                tokens, tokentype_ids=tokentype_ids, deterministic=deterministic
+            )
+        else:
+            h = tokens
+        h = self.transformer(
+            h, attention_mask=ext_mask, deterministic=deterministic
+        )
+        if not self.post_process:
+            return h
+
+        if cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1:
+            # pooler/LM head need the full sequence (token 0 lives on rank 0).
+            # to_model_parallel=False (backward = split): two heads consume
+            # this tensor — the binary head's cotangent is replicated over tp
+            # while the LM head's partial cotangent is psum'ed by attend()'s
+            # copy_to vjp — so the summed cotangent here is replicated and a
+            # reduce-scatter backward would double-count the binary path.
+            h = gather_from_sequence_parallel_region(
+                h, cfg.tensor_axis, to_model_parallel=False
+            )
+
+        binary_logits = None
+        if self.add_binary_head:
+            pooled = self.pooler(h)
+            binary_logits = self.binary_head(pooled).astype(jnp.float32)
+
+        lm = self.lm_dense(h)
+        lm = jax.nn.gelu(lm.astype(jnp.float32), approximate=True)
+        lm = layer_norm(
+            lm,
+            self.lm_norm_scale,
+            self.lm_norm_bias,
+            eps=cfg.layernorm_epsilon,
+        ).astype(h.dtype)
+        logits = self.embedding.word_embeddings.attend(lm)  # (s, b, v/tp)
+        logits = jnp.transpose(logits, (1, 0, 2))  # (b, s, v/tp)
+        if lm_labels is None:
+            return logits, binary_logits
+        losses = vocab_parallel_cross_entropy(
+            logits, lm_labels, axis_name=cfg.tensor_axis
+        )
+        return losses, binary_logits

@@ -1,0 +1,172 @@
+"""GPT language model.
+
+Reference parity: apex/transformer/testing/standalone_gpt.py (gpt_model
+over TransformerLanguageModel, standalone_transformer_lm.py) — vocab-parallel
+embedding + learned/rotary positions, causal ParallelTransformer, tied
+embedding logits, vocab-parallel cross entropy. ``pre_process``/``post_process``
+mirror the pipeline-stage flags of build_model (schedules/common.py:83-108).
+
+Layout: tokens are (batch, seq); hidden states run (seq, batch, hidden)
+through the stack (Megatron layout, so sequence-parallel mappings act on
+dim 0); loss is per-token (batch, seq) fp32.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.parallel.layers import VocabParallelEmbedding, _tp_size
+from apex_tpu.parallel.mappings import (
+    gather_from_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+)
+from apex_tpu.transformer.config import TransformerConfig
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.layer import ParallelTransformer, rotary_embedding_for
+
+
+class Embedding(nn.Module):
+    """Word + learned-position (+tokentype) embeddings with dropout.
+
+    Ref: Embedding in standalone_transformer_lm.py — VocabParallelEmbedding
+    plus a replicated position table; with sequence parallelism the output is
+    scattered along the sequence dim (mappings.py:213).
+    """
+
+    config: TransformerConfig
+    num_tokentypes: int = 0
+
+    def setup(self):
+        cfg = self.config
+        self.word_embeddings = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size,
+            embedding_dim=cfg.hidden_size,
+            axis_name=cfg.tensor_axis,
+            params_dtype=cfg.params_dtype,
+            name="word_embeddings",
+        )
+        if cfg.position_embedding_type == "learned":
+            self.position_embeddings = self.param(
+                "position_embeddings",
+                nn.initializers.normal(stddev=0.02),
+                (cfg.max_position_embeddings, cfg.hidden_size),
+                cfg.params_dtype,
+            )
+        if self.num_tokentypes > 0:
+            self.tokentype_embeddings = self.param(
+                "tokentype_embeddings",
+                nn.initializers.normal(stddev=0.02),
+                (self.num_tokentypes, cfg.hidden_size),
+                cfg.params_dtype,
+            )
+        # setup-based module: submodules must be declared here, not inline
+        self.dropout = nn.Dropout(rate=cfg.hidden_dropout)
+
+    def __call__(self, tokens, position_ids=None, tokentype_ids=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        h = self.word_embeddings(tokens)  # (b, s, h)
+        if cfg.position_embedding_type == "learned":
+            if position_ids is None:
+                position_ids = jnp.arange(tokens.shape[1])[None, :]
+            h = h + jnp.take(self.position_embeddings, position_ids, axis=0)
+        if tokentype_ids is not None:
+            h = h + jnp.take(self.tokentype_embeddings, tokentype_ids, axis=0)
+        h = jnp.transpose(h, (1, 0, 2))  # (s, b, h)
+        h = h.astype(cfg.compute_dtype)
+        if cfg.hidden_dropout > 0.0:
+            h = self.dropout(h, deterministic=deterministic)
+        if cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1:
+            h = scatter_to_sequence_parallel_region(h, cfg.tensor_axis)
+        return h
+
+
+class GPTModel(nn.Module):
+    """Causal LM over the parallel transformer stack.
+
+    ``num_layers`` overrides the stage-local depth for pipeline chunks;
+    when ``post_process`` and labels are given, returns per-token CE losses
+    (ref: post_language_model_processing in standalone_gpt.py), else logits
+    (vocab-sharded over tp) or, for intermediate stages, hidden states.
+    """
+
+    config: TransformerConfig
+    pre_process: bool = True
+    post_process: bool = True
+    num_layers: Optional[int] = None
+
+    def setup(self):
+        cfg = self.config
+        if self.pre_process or (
+            self.post_process and cfg.share_embeddings_and_output_weights
+        ):
+            self.embedding = Embedding(config=cfg, name="embedding")
+        self.transformer = ParallelTransformer(
+            config=cfg,
+            num_layers=self.num_layers,
+            post_layer_norm=self.post_process,
+            attn_mask_type=AttnMaskType.causal,
+            name="transformer",
+        )
+
+    def __call__(
+        self,
+        tokens,
+        position_ids=None,
+        attention_mask=None,
+        labels=None,
+        loss_mask=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        if self.pre_process:
+            h = self.embedding(tokens, position_ids, deterministic=deterministic)
+        else:
+            h = tokens  # already (s_local, b, h) hidden states from prev stage
+
+        rotary = None
+        if cfg.position_embedding_type == "rope":
+            seq = h.shape[0]
+            if cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1:
+                seq = seq * _tp_size(cfg.tensor_axis)
+            rotary = rotary_embedding_for(cfg, seq)
+
+        h = self.transformer(
+            h,
+            attention_mask=attention_mask,
+            rotary_pos_emb=rotary,
+            deterministic=deterministic,
+        )
+        if not self.post_process:
+            return h
+
+        sp_gathered = cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
+        if sp_gathered:
+            # to_model_parallel=True: backward is a single reduce-scatter —
+            # the reference's tensor_parallel_output_grad=True path
+            # (standalone_transformer_lm.py parallel_lm_logits).
+            h = gather_from_sequence_parallel_region(
+                h, cfg.tensor_axis, to_model_parallel=True
+            )
+        logits = self.embedding.word_embeddings.attend(
+            h, parallel_input=sp_gathered
+        )  # (s, b, v/tp) fp32
+        logits = jnp.transpose(logits, (1, 0, 2))  # (b, s, v/tp)
+        if labels is None:
+            return logits
+        losses = vocab_parallel_cross_entropy(
+            logits, labels, axis_name=cfg.tensor_axis
+        )
+        if loss_mask is not None:
+            losses = losses * loss_mask
+        return losses
+
+
+def gpt_loss_fn(losses, loss_mask=None):
+    """Mean loss over unmasked tokens (ref: loss_func in test_gpt_minimal.py)."""
+    if loss_mask is None:
+        return jnp.mean(losses)
+    m = loss_mask.astype(jnp.float32)
+    return jnp.sum(losses * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -18,7 +18,12 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 
-from apex_tpu.transformer.enums import AttnMaskType
+
+def _is_causal(attn_mask_type) -> bool:
+    """Accepts AttnMaskType or its string name; avoids importing
+    apex_tpu.transformer at module scope (cycle: transformer/__init__ ->
+    layer -> ops.softmax)."""
+    return getattr(attn_mask_type, "name", attn_mask_type) == "causal"
 
 # padding-mask fill matches the reference wrappers' -10000 semantics; the
 # causal mask uses a true -inf surrogate so future positions get exactly
@@ -86,7 +91,7 @@ class FusedScaleMaskSoftmax:
         self,
         input_in_fp16: bool = False,
         input_in_bf16: bool = False,
-        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        attn_mask_type="padding",
         scaled_masked_softmax_fusion: bool = True,
         mask_func: Optional[Callable] = None,
         softmax_in_fp32: bool = True,
@@ -98,7 +103,15 @@ class FusedScaleMaskSoftmax:
         del input_in_fp16, input_in_bf16, scaled_masked_softmax_fusion, softmax_in_fp32
 
     def __call__(self, x, mask=None):
-        if self.attn_mask_type == AttnMaskType.causal:
+        if _is_causal(self.attn_mask_type):
+            # ref wrappers assert mask is None on the causal kernel path
+            # (fused_softmax.py ScaledUpperTriangMasked*) — fail loudly
+            # instead of silently dropping a padding mask.
+            assert mask is None, (
+                "FusedScaleMaskSoftmax(attn_mask_type=causal) does not accept "
+                "an explicit mask; fold padding into the mask and use the "
+                "padding mask type instead"
+            )
             b, np_, sq, sk = x.shape
             out = scaled_upper_triang_masked_softmax(
                 x.reshape(b * np_, sq, sk), self.scale
@@ -113,6 +126,10 @@ class FusedScaleMaskSoftmax:
 def fused_scale_mask_softmax(x, mask=None, scale: float = 1.0, causal: bool = False):
     """Functional form of the dispatcher."""
     if causal:
+        assert mask is None, (
+            "fused_scale_mask_softmax(causal=True) does not accept an "
+            "explicit mask; fold padding into the mask and pass causal=False"
+        )
         shape = x.shape
         return scaled_upper_triang_masked_softmax(
             x.reshape(-1, shape[-2], shape[-1]), scale

@@ -37,8 +37,12 @@ def vocab_parallel_cross_entropy(
     else:
         rank = jax.lax.axis_index(axis_name)
         start = rank * vocab_local
-        # global max for stability (ref: allreduce MAX, cross_entropy.py:38)
-        gmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+        # global max for stability (ref: allreduce MAX, cross_entropy.py:38);
+        # the shift cancels analytically, so keep it out of the grad graph
+        # (pmax has no differentiation rule).
+        gmax = jax.lax.pmax(
+            jax.lax.stop_gradient(jnp.max(lf, axis=-1)), axis_name
+        )
         shifted = lf - gmax[..., None]
         sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
         lse = jnp.log(sum_exp) + gmax

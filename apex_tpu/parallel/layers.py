@@ -154,6 +154,11 @@ class RowParallelLinear(nn.Module):
                 y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
         if self.use_bias:
             bias = self.param("bias", self.bias_init, (self.output_size,), self.params_dtype)
+            if tp > 1 and self.sequence_parallel_enabled:
+                # bias grad under SP is a partial sum over the local sequence
+                # shard — identity-fwd/psum-bwd restores the full gradient
+                # (ref: sequence_parallel_enabled grad allreduce semantics)
+                bias = copy_to_tensor_model_parallel_region(bias, self.axis_name)
             y = y + bias.astype(y.dtype)
         return y
 
@@ -172,23 +177,48 @@ class VocabParallelEmbedding(nn.Module):
     params_dtype: jnp.dtype = jnp.float32
     embedding_init: Callable = nn.initializers.normal(stddev=1.0)
 
-    @nn.compact
-    def __call__(self, ids):
+    def setup(self):
         tp = _tp_size(self.axis_name)
         assert self.num_embeddings % tp == 0
-        vocab_local = self.num_embeddings // tp
-        table = self.param(
+        self.vocab_local = self.num_embeddings // tp
+        self.embedding = self.param(
             "embedding",
             tp_rank_init(self.embedding_init, self.axis_name),
-            (vocab_local, self.embedding_dim),
+            (self.vocab_local, self.embedding_dim),
             self.params_dtype,
         )
+
+    def __call__(self, ids):
+        table = self.embedding
+        tp = _tp_size(self.axis_name)
         if tp == 1:
             return jnp.take(table, ids, axis=0)
         rank = jax.lax.axis_index(self.axis_name)
-        start = rank * vocab_local
-        in_range = (ids >= start) & (ids < start + vocab_local)
-        local_ids = jnp.clip(ids - start, 0, vocab_local - 1)
+        start = rank * self.vocab_local
+        in_range = (ids >= start) & (ids < start + self.vocab_local)
+        local_ids = jnp.clip(ids - start, 0, self.vocab_local - 1)
         out = jnp.take(table, local_ids, axis=0)
         out = jnp.where(in_range[..., None], out, 0.0)
         return reduce_from_tensor_model_parallel_region(out, self.axis_name)
+
+    def attend(self, x, parallel_input: bool = False):
+        """Vocab-parallel logits against the (tied) embedding table.
+
+        Ref: parallel_lm_logits in testing/standalone_transformer_lm.py —
+        copy-to-TP-region (identity fwd / psum bwd) then X @ E^T, leaving
+        logits sharded along vocab for vocab_parallel_cross_entropy.
+        ``parallel_input=True`` skips the copy when the caller's gather
+        already carries the TP grad reduction (the reference's
+        ``tensor_parallel_output_grad=True`` path) — avoids a redundant
+        full psum of the hidden-grad in backward.
+        """
+        tp = _tp_size(self.axis_name)
+        if tp > 1 and not parallel_input:
+            x = copy_to_tensor_model_parallel_region(x, self.axis_name)
+        table = self.embedding.astype(x.dtype)
+        return jax.lax.dot_general(
+            x,
+            table,
+            (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )

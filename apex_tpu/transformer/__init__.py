@@ -1,5 +1,28 @@
 """Megatron-style transformer building blocks (ref: apex/transformer)."""
 
+from apex_tpu.transformer.config import TransformerConfig
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+from apex_tpu.transformer.layer import (
+    CoreAttention,
+    Norm,
+    ParallelAttention,
+    ParallelMLP,
+    ParallelTransformer,
+    ParallelTransformerLayer,
+    rotary_embedding_for,
+)
 
-__all__ = ["AttnMaskType", "AttnType", "LayerType", "ModelType"]
+__all__ = [
+    "AttnMaskType",
+    "AttnType",
+    "LayerType",
+    "ModelType",
+    "TransformerConfig",
+    "CoreAttention",
+    "Norm",
+    "ParallelAttention",
+    "ParallelMLP",
+    "ParallelTransformer",
+    "ParallelTransformerLayer",
+    "rotary_embedding_for",
+]

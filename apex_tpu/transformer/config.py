@@ -1,0 +1,74 @@
+"""Transformer configuration.
+
+The reference configures its Megatron-style transformer through the 188-flag
+argparse namespace (testing/arguments.py:23) plus constructor kwargs threaded
+through standalone_transformer_lm.py. Here the whole surface collapses into
+one frozen dataclass that is hashable (so flax modules can hold it as a
+static attribute) and carries the TPU-specific knobs (compute dtype, mesh
+axis names, attention impl) alongside the reference's architectural ones.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture + parallelism knobs for the Megatron-style stack.
+
+    Field provenance (reference): hidden_size/num_layers/num_attention_heads/
+    ffn_hidden_size/kv_channels mirror testing/arguments.py `_add_network_size_args`;
+    hidden_dropout/attention_dropout ditto; layernorm_epsilon,
+    apply_residual_connection_post_layernorm and fp32_residual_connection come
+    from the transformer-layer flags used by standalone_transformer_lm.py.
+    """
+
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    vocab_size: int = 0
+    max_position_embeddings: int = 0
+    ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden_size
+    kv_channels: Optional[int] = None  # defaults to hidden_size // heads
+
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layernorm_epsilon: float = 1e-5
+    normalization: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "geglu" | "relu" | "swiglu"
+    apply_residual_connection_post_layernorm: bool = False
+    fp32_residual_connection: bool = False
+    apply_query_key_layer_scaling: bool = False
+    # NOTE: softmax math is ALWAYS fp32 internally (ops/softmax.py,
+    # ops/attention.py) — the reference's attention_softmax_in_fp32 flag has
+    # no "off" position on TPU. The attention mask type is a property of the
+    # model (GPT=causal, BERT=padding) and is passed to the modules directly.
+
+    position_embedding_type: str = "learned"  # "learned" | "rope" | "none"
+    rotary_percent: float = 1.0
+
+    # parallelism
+    sequence_parallel: bool = False
+    tensor_axis: str = "tp"
+    recompute_granularity: Optional[str] = None  # None | "full" | "selective"
+
+    # dtypes: params live in fp32, compute in bf16 by default (TPU-native
+    # replacement for the reference's fp16 O2 regime)
+    params_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    # attention backend: "auto" → Pallas flash attention on TPU
+    attention_impl: str = "auto"
+
+    share_embeddings_and_output_weights: bool = True
+
+    def __post_init__(self):
+        if self.ffn_hidden_size is None:
+            object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
+        if self.kv_channels is None:
+            assert self.hidden_size % self.num_attention_heads == 0
+            object.__setattr__(
+                self, "kv_channels", self.hidden_size // self.num_attention_heads
+            )

@@ -1,0 +1,409 @@
+"""Megatron-style parallel transformer layer, TPU-native.
+
+Reference parity: the ParallelMLP / ParallelAttention / ParallelTransformerLayer
+stack in apex/transformer/testing/standalone_transformer_lm.py (the reference's
+canonical consumer of its TP/SP primitives), built on:
+- ColumnParallelLinear / RowParallelLinear (tensor_parallel/layers.py:460,645)
+- FusedScaleMaskSoftmax (functional/fused_softmax.py) → here a Pallas flash
+  attention (ops/attention.py) with a fused-softmax fallback for masked paths
+- FusedLayerNorm with sequence_parallel flags (transformer/layers/layer_norm.py:33)
+- fused RoPE (functional/fused_rope.py) → ops/rope.py
+- bias-GeLU fusion (the reference's bias_gelu_impl) → XLA epilogue fusion.
+
+Layout: hidden states are (seq, batch, hidden) exactly like Megatron, so the
+sequence-parallel scatter/gather mappings act on dim 0. All residual math can
+be forced to fp32 (``fp32_residual_connection``); matmuls accumulate in fp32
+on the MXU via ``preferred_element_type``.
+"""
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+from apex_tpu.ops.rope import apply_rotary_pos_emb, rope_frequencies
+from apex_tpu.ops.softmax import fused_scale_mask_softmax
+from apex_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    _tp_size,
+)
+from apex_tpu.parallel.mappings import copy_to_tensor_model_parallel_region
+from apex_tpu.transformer.config import TransformerConfig
+from apex_tpu.transformer.enums import AttnMaskType, AttnType
+
+
+class Norm(nn.Module):
+    """LayerNorm/RMSNorm with sequence-parallel gradient synchronization.
+
+    Ref: transformer/layers/layer_norm.py:26-51 marks LN params
+    ``sequence_parallel_enabled`` so Megatron allreduces their grads over TP
+    after backward — under SP each rank's scale/bias grad is a *partial* sum
+    over its sequence shard. The SPMD equivalent is routing the params
+    through ``copy_to_tensor_model_parallel_region`` (identity forward,
+    psum backward), which makes autodiff emit exactly that allreduce.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = x.shape[-1]
+        w = self.param("scale", nn.initializers.ones_init(), (h,), cfg.params_dtype)
+        sp = cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
+        if sp:
+            w = copy_to_tensor_model_parallel_region(w, cfg.tensor_axis)
+        if cfg.normalization == "rmsnorm":
+            return rms_norm(x, w.astype(jnp.float32), eps=cfg.layernorm_epsilon).astype(
+                x.dtype
+            )
+        b = self.param("bias", nn.initializers.zeros_init(), (h,), cfg.params_dtype)
+        if sp:
+            b = copy_to_tensor_model_parallel_region(b, cfg.tensor_axis)
+        return layer_norm(
+            x, w.astype(jnp.float32), b.astype(jnp.float32), eps=cfg.layernorm_epsilon
+        ).astype(x.dtype)
+
+
+def _activate(h, activation: str):
+    hf = h.astype(jnp.float32)
+    if activation == "gelu":
+        return jax.nn.gelu(hf, approximate=True).astype(h.dtype)
+    if activation == "relu":
+        return jax.nn.relu(hf).astype(h.dtype)
+    if activation in ("geglu", "swiglu"):
+        a, b = jnp.split(hf, 2, axis=-1)
+        gate = jax.nn.gelu(a, approximate=True) if activation == "geglu" else jax.nn.silu(a)
+        return (gate * b).astype(h.dtype)
+    raise ValueError(f"unknown activation {activation!r}")
+
+
+class ParallelMLP(nn.Module):
+    """Column(h→ffn) → activation → Row(ffn→h).
+
+    Ref: ParallelMLP in standalone_transformer_lm.py; the bias+GeLU fusion
+    (reference ``bias_gelu_impl`` custom autograd fn) is an XLA epilogue here.
+    Gated activations (geglu/swiglu) double the column projection width.
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states):
+        cfg = self.config
+        gated = cfg.activation in ("geglu", "swiglu")
+        width = cfg.ffn_hidden_size * (2 if gated else 1)
+        h = ColumnParallelLinear(
+            output_size=width,
+            gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            axis_name=cfg.tensor_axis,
+            params_dtype=cfg.params_dtype,
+            name="dense_h_to_4h",
+        )(hidden_states)
+        h = _activate(h, cfg.activation)
+        return RowParallelLinear(
+            output_size=cfg.hidden_size,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            axis_name=cfg.tensor_axis,
+            params_dtype=cfg.params_dtype,
+            name="dense_4h_to_h",
+        )(h)
+
+
+class CoreAttention(nn.Module):
+    """Unfused attention math for masked/dropout paths.
+
+    Ref: CoreAttention in standalone_transformer_lm.py — baddbmm +
+    FusedScaleMaskSoftmax + attention dropout + bmm. Used when flash
+    attention can't apply (arbitrary padding masks, attention dropout).
+    """
+
+    config: TransformerConfig
+    attn_mask_type: AttnMaskType
+
+    @nn.compact
+    def __call__(self, q, k, v, attention_mask, deterministic: bool = True):
+        # q,k,v: (b, np, s, hn)
+        cfg = self.config
+        norm = 1.0 / math.sqrt(cfg.kv_channels)
+        scale = norm
+        softmax_scale = 1.0
+        if cfg.apply_query_key_layer_scaling:
+            # ref: layer-number scaling folded into softmax scale
+            coeff = max(1, cfg.num_layers)
+            scale = norm / coeff
+            softmax_scale = coeff
+        s = jnp.einsum("bnqd,bnkd->bnqk", q, k, preferred_element_type=jnp.float32)
+        s = s * scale
+        causal = self.attn_mask_type == AttnMaskType.causal
+        if causal and attention_mask is not None:
+            # fold the padding mask into the causal one so the fused causal
+            # path still applies (ref: mask_func composition in CoreAttention)
+            sq, sk = s.shape[-2], s.shape[-1]
+            future = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+            attention_mask = jnp.logical_or(attention_mask, future)
+            causal = False
+        probs = fused_scale_mask_softmax(
+            s, attention_mask, scale=softmax_scale, causal=causal
+        )
+        if cfg.attention_dropout > 0.0 and not deterministic:
+            probs = nn.Dropout(rate=cfg.attention_dropout)(
+                probs, deterministic=deterministic
+            )
+        ctx = jnp.einsum(
+            "bnqk,bnkd->bnqd",
+            probs.astype(q.dtype),
+            v,
+            preferred_element_type=jnp.float32,
+        )
+        return ctx.astype(q.dtype)
+
+
+class ParallelAttention(nn.Module):
+    """TP multi-head attention with flash-attention core.
+
+    Ref: ParallelAttention in standalone_transformer_lm.py — fused QKV
+    ColumnParallelLinear (heads sharded over tp), core attention, Row
+    output projection. Cross-attention splits q from kv like the
+    reference's AttnType.cross_attn branch.
+    """
+
+    config: TransformerConfig
+    attn_type: AttnType = AttnType.self_attn
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        encoder_output=None,
+        rotary_pos_emb=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        s, b, _ = hidden_states.shape
+        tp = _tp_size(cfg.tensor_axis)
+        np_local = cfg.num_attention_heads // tp
+        hn = cfg.kv_channels
+
+        if self.attn_type == AttnType.self_attn:
+            qkv = ColumnParallelLinear(
+                output_size=3 * cfg.num_attention_heads * hn,
+                gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                name="query_key_value",
+            )(hidden_states)
+            sq = qkv.shape[0]
+            qkv = qkv.reshape(sq, b, np_local, 3 * hn)
+            q, k, v = jnp.split(qkv, 3, axis=-1)  # (s, b, np, hn)
+        else:
+            q = ColumnParallelLinear(
+                output_size=cfg.num_attention_heads * hn,
+                gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                name="query",
+            )(hidden_states)
+            kv = ColumnParallelLinear(
+                output_size=2 * cfg.num_attention_heads * hn,
+                gather_output=False,
+                # SP-sharded encoder output must be gathered for K/V too
+                # (ref: standalone_transformer_lm.py:412-419)
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                name="key_value",
+            )(encoder_output)
+            q = q.reshape(q.shape[0], b, np_local, hn)
+            kv = kv.reshape(kv.shape[0], b, np_local, 2 * hn)
+            k, v = jnp.split(kv, 2, axis=-1)
+
+        if rotary_pos_emb is not None:
+            q_pos_emb, k_pos_emb = rotary_pos_emb
+            q = apply_rotary_pos_emb(q, q_pos_emb)
+            k = apply_rotary_pos_emb(k, k_pos_emb)
+
+        # (s, b, np, hn) -> (b, np, s, hn)
+        qb = jnp.transpose(q, (1, 2, 0, 3))
+        kb = jnp.transpose(k, (1, 2, 0, 3))
+        vb = jnp.transpose(v, (1, 2, 0, 3))
+
+        causal = self.attn_mask_type == AttnMaskType.causal
+        # apply_query_key_layer_scaling cancels exactly (scores*norm/coeff
+        # then softmax_scale=coeff) in the always-fp32 softmax, so the flash
+        # path with scale=norm is semantically identical — no fallback needed.
+        use_flash = attention_mask is None and (
+            cfg.attention_dropout == 0.0 or deterministic
+        )
+        if use_flash:
+            ctx = flash_attention(
+                qb, kb, vb, causal=causal, impl=cfg.attention_impl
+            )
+        else:
+            ctx = CoreAttention(
+                config=cfg, attn_mask_type=self.attn_mask_type, name="core_attention"
+            )(qb, kb, vb, attention_mask, deterministic=deterministic)
+
+        # (b, np, s, hn) -> (s, b, np*hn)
+        ctx = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(ctx.shape[2], b, np_local * hn)
+        out = RowParallelLinear(
+            output_size=cfg.hidden_size,
+            input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            axis_name=cfg.tensor_axis,
+            params_dtype=cfg.params_dtype,
+            name="dense",
+        )(ctx)
+        return out
+
+
+class ParallelTransformerLayer(nn.Module):
+    """Pre-LN transformer block (ref: ParallelTransformerLayer in
+    standalone_transformer_lm.py): LN → attn → residual → LN → MLP → residual,
+    with optional post-LN residual taps and fp32 residual stream."""
+
+    config: TransformerConfig
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+    has_cross_attention: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        encoder_output=None,
+        enc_dec_attn_mask=None,
+        rotary_pos_emb=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        rdtype = jnp.float32 if cfg.fp32_residual_connection else hidden_states.dtype
+
+        ln_out = Norm(config=cfg, name="input_layernorm")(hidden_states)
+        attn_cls = ParallelAttention
+        if cfg.recompute_granularity == "selective":
+            # recompute only the attention block in backward (ref: Megatron
+            # --recompute-granularity selective; core-attention checkpoint).
+            # arg 0 is the module scope; ``deterministic`` (arg 5) is static.
+            attn_cls = nn.remat(
+                ParallelAttention, static_argnums=(5,), prevent_cse=False
+            )
+        attn_out = attn_cls(
+            config=cfg,
+            attn_type=AttnType.self_attn,
+            attn_mask_type=self.attn_mask_type,
+            name="self_attention",
+        )(
+            ln_out,
+            attention_mask,
+            None,
+            rotary_pos_emb,
+            deterministic,
+        )
+        residual = (
+            ln_out if cfg.apply_residual_connection_post_layernorm else hidden_states
+        )
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            attn_out = nn.Dropout(rate=cfg.hidden_dropout)(
+                attn_out, deterministic=deterministic
+            )
+        h = (residual.astype(rdtype) + attn_out.astype(rdtype)).astype(
+            hidden_states.dtype
+        )
+
+        if self.has_cross_attention:
+            ln_x = Norm(config=cfg, name="post_inter_attention_layernorm_pre")(h)
+            x_out = ParallelAttention(
+                config=cfg,
+                attn_type=AttnType.cross_attn,
+                attn_mask_type=AttnMaskType.padding,
+                name="inter_attention",
+            )(
+                ln_x,
+                attention_mask=enc_dec_attn_mask,
+                encoder_output=encoder_output,
+                deterministic=deterministic,
+            )
+            residual = ln_x if cfg.apply_residual_connection_post_layernorm else h
+            h = (residual.astype(rdtype) + x_out.astype(rdtype)).astype(
+                hidden_states.dtype
+            )
+
+        ln2 = Norm(config=cfg, name="post_attention_layernorm")(h)
+        mlp_out = ParallelMLP(config=cfg, name="mlp")(ln2)
+        residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            mlp_out = nn.Dropout(rate=cfg.hidden_dropout)(
+                mlp_out, deterministic=deterministic
+            )
+        return (residual.astype(rdtype) + mlp_out.astype(rdtype)).astype(
+            hidden_states.dtype
+        )
+
+
+class ParallelTransformer(nn.Module):
+    """Stack of layers + final LN, with activation recompute.
+
+    Ref: ParallelTransformer in standalone_transformer_lm.py; activation
+    checkpointing (tensor_parallel/random.py:237 CheckpointFunction) maps to
+    ``jax.checkpoint`` (``nn.remat``) around each layer when
+    ``recompute_granularity == "full"``. ``num_layers`` here is the LOCAL
+    stage depth — pipeline stages instantiate their own slice (ref:
+    build_model virtual chunks, schedules/common.py:30).
+    """
+
+    config: TransformerConfig
+    num_layers: Optional[int] = None
+    post_layer_norm: bool = True
+    attn_mask_type: AttnMaskType = AttnMaskType.causal
+
+    @nn.compact
+    def __call__(
+        self,
+        hidden_states,
+        attention_mask=None,
+        rotary_pos_emb=None,
+        deterministic: bool = True,
+    ):
+        cfg = self.config
+        n = self.num_layers if self.num_layers is not None else cfg.num_layers
+        layer_cls = ParallelTransformerLayer
+        if cfg.recompute_granularity == "full":
+            # arg 0 is the module scope; ``deterministic`` (arg 6) is static
+            layer_cls = nn.remat(
+                ParallelTransformerLayer,
+                static_argnums=(6,),
+                prevent_cse=False,
+            )
+        for i in range(n):
+            hidden_states = layer_cls(
+                config=cfg, attn_mask_type=self.attn_mask_type, name=f"layer_{i}"
+            )(
+                hidden_states,
+                attention_mask,
+                None,
+                None,
+                rotary_pos_emb,
+                deterministic,
+            )
+        if self.post_layer_norm:
+            hidden_states = Norm(config=cfg, name="final_layernorm")(hidden_states)
+        return hidden_states
+
+
+def rotary_embedding_for(config: TransformerConfig, seq_len: int, dtype=jnp.float32):
+    """Precompute (q_freqs, k_freqs) for ParallelAttention's rotary path."""
+    rot_dim = int(config.kv_channels * config.rotary_percent)
+    f = rope_frequencies(rot_dim, seq_len, dtype=dtype)
+    return f, f

@@ -1,0 +1,236 @@
+"""Transformer layer + GPT/BERT model tests (single device, tp=1).
+
+Mirrors the reference's L0 run_transformer tier: numeric sanity of the
+parallel layers against unfused compositions, and minimal end-to-end
+loss-decrease training (ref: tests/L0/run_transformer/test_gpt_minimal.py,
+test_bert_minimal.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.models import BertModel, GPTModel, gpt_loss_fn
+from apex_tpu.transformer import (
+    AttnMaskType,
+    ParallelTransformerLayer,
+    TransformerConfig,
+)
+
+VOCAB = 64
+
+
+def tiny_cfg(**kw):
+    defaults = dict(
+        num_layers=2,
+        hidden_size=32,
+        num_attention_heads=4,
+        vocab_size=VOCAB,
+        max_position_embeddings=32,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        compute_dtype=jnp.float32,
+    )
+    defaults.update(kw)
+    return TransformerConfig(**defaults)
+
+
+def data(key, b=2, s=16):
+    tokens = jax.random.randint(key, (b, s), 0, VOCAB)
+    labels = jnp.roll(tokens, -1, axis=1)
+    return tokens, labels
+
+
+class TestTransformerLayer:
+    def test_forward_shape_and_dtype(self, rng):
+        cfg = tiny_cfg(compute_dtype=jnp.bfloat16)
+        layer = ParallelTransformerLayer(config=cfg)
+        x = jax.random.normal(rng, (16, 2, cfg.hidden_size), jnp.bfloat16)
+        params = layer.init(rng, x)
+        y = layer.apply(params, x)
+        assert y.shape == x.shape and y.dtype == jnp.bfloat16
+
+    def test_flash_matches_core_attention(self, rng):
+        """Causal flash path == CoreAttention with an explicit causal mask."""
+        cfg = tiny_cfg()
+        layer = ParallelTransformerLayer(config=cfg, attn_mask_type=AttnMaskType.causal)
+        s = 16
+        x = jax.random.normal(rng, (s, 2, cfg.hidden_size), jnp.float32)
+        params = layer.init(rng, x)
+        y_flash = layer.apply(params, x)  # no mask -> flash path
+        keep = jnp.ones((2, s), jnp.int32)
+        # all-ones padding mask forces the CoreAttention path but masks nothing
+        mask = ~(keep[:, None, :].astype(bool) & keep[:, :, None].astype(bool))[:, None]
+        y_core = layer.apply(params, x, mask)
+        np.testing.assert_allclose(y_flash, y_core, rtol=2e-4, atol=2e-4)
+
+    def test_remat_matches_plain(self, rng):
+        cfg = tiny_cfg()
+        cfg_r = tiny_cfg(recompute_granularity="full")
+        from apex_tpu.transformer import ParallelTransformer
+
+        x = jax.random.normal(rng, (16, 2, cfg.hidden_size), jnp.float32)
+        m, mr = ParallelTransformer(config=cfg), ParallelTransformer(config=cfg_r)
+        params = m.init(rng, x)
+        np.testing.assert_allclose(
+            m.apply(params, x), mr.apply(params, x), rtol=1e-5, atol=1e-5
+        )
+
+    def test_selective_remat_matches_plain(self, rng):
+        cfg = tiny_cfg()
+        cfg_s = tiny_cfg(recompute_granularity="selective")
+        from apex_tpu.transformer import ParallelTransformer
+
+        x = jax.random.normal(rng, (16, 2, cfg.hidden_size), jnp.float32)
+        m, ms = ParallelTransformer(config=cfg), ParallelTransformer(config=cfg_s)
+        params = m.init(rng, x)
+
+        def loss(mod, p):
+            return jnp.sum(mod.apply(p, x) ** 2)
+
+        np.testing.assert_allclose(
+            loss(m, params), loss(ms, params), rtol=1e-6, atol=1e-6
+        )
+        g1 = jax.grad(lambda p: loss(m, p))(params)
+        g2 = jax.grad(lambda p: loss(ms, p))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+            g1,
+            g2,
+        )
+
+    @pytest.mark.parametrize("act", ["geglu", "swiglu"])
+    def test_gated_activations(self, rng, act):
+        cfg = tiny_cfg(activation=act)
+        layer = ParallelTransformerLayer(config=cfg)
+        x = jax.random.normal(rng, (8, 2, cfg.hidden_size), jnp.float32)
+        params = layer.init(rng, x)
+        assert layer.apply(params, x).shape == x.shape
+
+
+class TestGPT:
+    def test_forward_logits_and_loss(self, rng):
+        cfg = tiny_cfg()
+        model = GPTModel(config=cfg)
+        tokens, labels = data(rng)
+        params = model.init(rng, tokens)
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, VOCAB)
+        losses = model.apply(params, tokens, labels=labels)
+        assert losses.shape == (2, 16)
+        assert bool(jnp.all(jnp.isfinite(losses)))
+
+    def test_dropout_training_path(self, rng):
+        """deterministic=False with dropout>0 must run (regression: inline
+        Dropout in a setup()-based module crashed the training path)."""
+        cfg = tiny_cfg(hidden_dropout=0.1, attention_dropout=0.1)
+        model = GPTModel(config=cfg)
+        tokens, labels = data(rng)
+        params = model.init(rng, tokens)
+        losses = model.apply(
+            params,
+            tokens,
+            labels=labels,
+            deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(7)},
+        )
+        assert bool(jnp.all(jnp.isfinite(losses)))
+
+    def test_rope_forward(self, rng):
+        cfg = tiny_cfg(position_embedding_type="rope")
+        model = GPTModel(config=cfg)
+        tokens, _ = data(rng)
+        params = model.init(rng, tokens)
+        assert model.apply(params, tokens).shape == (2, 16, VOCAB)
+
+    def test_loss_decreases(self, rng):
+        """ref: test_gpt_minimal.py:146-218 asserts the training loss drops."""
+        cfg = tiny_cfg()
+        model = GPTModel(config=cfg)
+        tokens, labels = data(rng, b=4, s=16)
+        params = model.init(rng, tokens)
+        opt = optax.adam(1e-3)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                return gpt_loss_fn(model.apply(p, tokens, labels=labels))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        for _ in range(20):
+            params, opt_state, loss = step(params, opt_state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] - 0.5, losses
+
+    def test_pipeline_stage_slicing(self, rng):
+        """pre/post_process chunks compose to the full model (ref:
+        build_model pre/post flags, schedules/common.py:83-108)."""
+        cfg = tiny_cfg()
+        full = GPTModel(config=cfg)
+        first = GPTModel(config=cfg, post_process=False, num_layers=1)
+        last = GPTModel(config=cfg, pre_process=False, num_layers=1)
+        tokens, _ = data(rng)
+        params = full.init(rng, tokens)
+        p_first = {
+            "params": {
+                "embedding": params["params"]["embedding"],
+                "transformer": {
+                    "layer_0": params["params"]["transformer"]["layer_0"]
+                },
+            }
+        }
+        p_last = {
+            "params": {
+                "embedding": params["params"]["embedding"],
+                "transformer": {
+                    "layer_0": params["params"]["transformer"]["layer_1"],
+                    "final_layernorm": params["params"]["transformer"][
+                        "final_layernorm"
+                    ],
+                },
+            }
+        }
+        h = first.apply(p_first, tokens)
+        assert h.shape == (16, 2, cfg.hidden_size)
+        logits = last.apply(p_last, h)
+        np.testing.assert_allclose(
+            logits, full.apply(params, tokens), rtol=1e-5, atol=1e-5
+        )
+
+
+class TestBert:
+    def test_forward_and_heads(self, rng):
+        cfg = tiny_cfg()
+        model = BertModel(config=cfg)
+        tokens, labels = data(rng)
+        mask = jnp.ones_like(tokens)
+        tokentype = jnp.zeros_like(tokens)
+        params = model.init(rng, tokens, mask, tokentype)
+        logits, binary = model.apply(params, tokens, mask, tokentype)
+        assert logits.shape == (2, 16, VOCAB)
+        assert binary.shape == (2, 2)
+        losses, _ = model.apply(params, tokens, mask, tokentype, lm_labels=labels)
+        assert losses.shape == (2, 16)
+
+    def test_padding_mask_blocks_attention(self, rng):
+        """Masked-out positions must not influence kept positions' outputs."""
+        cfg = tiny_cfg()
+        model = BertModel(config=cfg, add_binary_head=False)
+        tokens, _ = data(rng)
+        mask = jnp.concatenate(
+            [jnp.ones((2, 8), jnp.int32), jnp.zeros((2, 8), jnp.int32)], axis=1
+        )
+        params = model.init(rng, tokens, mask)
+        logits1, _ = model.apply(params, tokens, mask)
+        tokens2 = tokens.at[:, 8:].set((tokens[:, 8:] + 7) % VOCAB)
+        logits2, _ = model.apply(params, tokens2, mask)
+        np.testing.assert_allclose(
+            logits1[:, :8], logits2[:, :8], rtol=1e-5, atol=1e-5
+        )
