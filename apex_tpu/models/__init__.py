@@ -3,5 +3,25 @@ examples/imagenet) re-built TPU-native on the apex_tpu transformer stack."""
 
 from apex_tpu.models.gpt import GPTModel, gpt_loss_fn
 from apex_tpu.models.bert import BertModel
+from apex_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+    cross_entropy_loss,
+)
 
-__all__ = ["GPTModel", "BertModel", "gpt_loss_fn"]
+__all__ = [
+    "GPTModel",
+    "BertModel",
+    "gpt_loss_fn",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "cross_entropy_loss",
+]

@@ -105,6 +105,8 @@ class BertModel(nn.Module):
         if attention_mask is not None:
             ext_mask = bert_extended_attention_mask(attention_mask)
         if self.pre_process:
+            if tokentype_ids is None and self.num_tokentypes > 0:
+                tokentype_ids = jnp.zeros_like(tokens)  # segment-0 default
             h = self.embedding(
                 tokens, tokentype_ids=tokentype_ids, deterministic=deterministic
             )

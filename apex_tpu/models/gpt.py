@@ -17,7 +17,11 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
-from apex_tpu.parallel.layers import VocabParallelEmbedding, _tp_size
+from apex_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    VocabParallelEmbedding,
+    _tp_size,
+)
 from apex_tpu.parallel.mappings import (
     gather_from_sequence_parallel_region,
     scatter_to_sequence_parallel_region,
@@ -45,6 +49,10 @@ class Embedding(nn.Module):
             embedding_dim=cfg.hidden_size,
             axis_name=cfg.tensor_axis,
             params_dtype=cfg.params_dtype,
+            # Megatron init_method_normal(init_method_std=0.02) — the
+            # reference's testing/arguments.py default; N(0,1) blows up the
+            # tied-logit scale (std ~ sqrt(hidden))
+            embedding_init=nn.initializers.normal(stddev=0.02),
             name="word_embeddings",
         )
         if cfg.position_embedding_type == "learned":
@@ -73,7 +81,17 @@ class Embedding(nn.Module):
                 position_ids = jnp.arange(tokens.shape[1])[None, :]
             h = h + jnp.take(self.position_embeddings, position_ids, axis=0)
         if tokentype_ids is not None:
+            if self.num_tokentypes <= 0:
+                raise ValueError(
+                    "tokentype_ids passed but num_tokentypes == 0 "
+                    "(ref: Megatron Embedding raises on this mismatch)"
+                )
             h = h + jnp.take(self.tokentype_embeddings, tokentype_ids, axis=0)
+        elif self.num_tokentypes > 0:
+            raise ValueError(
+                "num_tokentypes > 0 but no tokentype_ids passed — the "
+                "tokentype table would silently train as dead weight"
+            )
         h = jnp.transpose(h, (1, 0, 2))  # (s, b, h)
         h = h.astype(cfg.compute_dtype)
         if cfg.hidden_dropout > 0.0:
@@ -103,6 +121,17 @@ class GPTModel(nn.Module):
             self.post_process and cfg.share_embeddings_and_output_weights
         ):
             self.embedding = Embedding(config=cfg, name="embedding")
+        if self.post_process and not cfg.share_embeddings_and_output_weights:
+            # untied output head: vocab-parallel projection (ref: Megatron
+            # untie_embeddings_and_output_weights path in parallel_lm_logits)
+            self.output_layer = ColumnParallelLinear(
+                output_size=cfg.vocab_size,
+                use_bias=False,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                kernel_init=nn.initializers.normal(stddev=0.02),
+                name="output_layer",
+            )
         self.transformer = ParallelTransformer(
             config=cfg,
             num_layers=self.num_layers,
@@ -142,17 +171,24 @@ class GPTModel(nn.Module):
         if not self.post_process:
             return h
 
+        tied = cfg.share_embeddings_and_output_weights
         sp_gathered = cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
         if sp_gathered:
-            # to_model_parallel=True: backward is a single reduce-scatter —
-            # the reference's tensor_parallel_output_grad=True path
-            # (standalone_transformer_lm.py parallel_lm_logits).
+            # tied head: to_model_parallel=True — attend(parallel_input=True)
+            # leaves dh partial per tp rank and the gather backward is a
+            # single reduce-scatter (the reference's
+            # tensor_parallel_output_grad=True path). Untied head:
+            # ColumnParallelLinear's own copy_to performs the psum, so the
+            # gather backward must be a plain split.
             h = gather_from_sequence_parallel_region(
-                h, cfg.tensor_axis, to_model_parallel=True
+                h, cfg.tensor_axis, to_model_parallel=tied
             )
-        logits = self.embedding.word_embeddings.attend(
-            h, parallel_input=sp_gathered
-        )  # (s, b, v/tp) fp32
+        if tied:
+            logits = self.embedding.word_embeddings.attend(
+                h, parallel_input=sp_gathered
+            )  # (s, b, v/tp) fp32
+        else:
+            logits = self.output_layer(h).astype(jnp.float32)
         logits = jnp.transpose(logits, (1, 0, 2))  # (b, s, v/tp)
         if labels is None:
             return logits

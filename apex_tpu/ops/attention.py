@@ -30,14 +30,19 @@ from apex_tpu.ops._dispatch import resolve_impl
 _NEG_INF = -1e30
 
 
+def causal_mask(sq: int, sk: int):
+    """(sq, sk) bool mask, True = masked out. Bottom-right aligned for
+    rectangular scores (sk > sq ⇒ the query block sits at the end of the
+    key sequence — the KV-cache / blockwise convention)."""
+    return jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+
+
 def _attn_ref(q, k, v, scale, causal, mask=None):
     """Plain XLA attention; q,k,v: (B, H, S, D)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        cm = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
-        s = jnp.where(cm, _NEG_INF, s)
+        s = jnp.where(causal_mask(s.shape[-2], s.shape[-1]), _NEG_INF, s)
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
@@ -85,7 +90,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0, :] = m + jnp.log(l)
+    lse_ref[0, 0, :] = m + jnp.log(l)
 
 
 def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
@@ -98,7 +103,9 @@ def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            # lse carries a singleton middle dim so its block (1, 1, bq)
+            # satisfies the TPU (8, 128) tiling rule on the last two dims
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -108,11 +115,11 @@ def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
         ],
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ),
         interpret=interpret,
     )(q3, k3, v3)
-    return o, lse
+    return o, lse.reshape(bh, sq)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -136,9 +143,7 @@ def _flash_bwd(scale, causal, interpret, bq, bk, res, do):
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, SQ)
     s = jnp.einsum("bqd,bkd->bqk", qf, kf, preferred_element_type=jnp.float32) * scale
     if causal:
-        sq, sk = s.shape[-2], s.shape[-1]
-        cm = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
-        s = jnp.where(cm, _NEG_INF, s)
+        s = jnp.where(causal_mask(s.shape[-2], s.shape[-1]), _NEG_INF, s)
     p = jnp.exp(s - lse[..., None])
     dv = jnp.einsum("bqk,bqd->bkd", p, dof)
     dp = jnp.einsum("bqd,bkd->bqk", dof, vf)

@@ -18,7 +18,7 @@ two_gpu_test_different_batch_size case — SURVEY.md hard part #6).
 Channel-last-ness is not a thing on TPU (XLA picks layouts).
 """
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax
@@ -41,6 +41,7 @@ class SyncBatchNorm(nn.Module):
     use_bias: bool = True
     axis_names: Sequence[str] = ("dp",)
     dtype: Optional[jnp.dtype] = None
+    scale_init: Callable = nn.initializers.ones_init()
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -91,7 +92,7 @@ class SyncBatchNorm(nn.Module):
 
         y = (x.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + self.epsilon)
         if self.use_scale:
-            scale = self.param("scale", nn.initializers.ones_init(), (features,), jnp.float32)
+            scale = self.param("scale", self.scale_init, (features,), jnp.float32)
             y = y * scale
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros_init(), (features,), jnp.float32)

@@ -146,8 +146,9 @@ class CoreAttention(nn.Module):
         if causal and attention_mask is not None:
             # fold the padding mask into the causal one so the fused causal
             # path still applies (ref: mask_func composition in CoreAttention)
-            sq, sk = s.shape[-2], s.shape[-1]
-            future = jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
+            from apex_tpu.ops.attention import causal_mask
+
+            future = causal_mask(s.shape[-2], s.shape[-1])
             attention_mask = jnp.logical_or(attention_mask, future)
             causal = False
         probs = fused_scale_mask_softmax(

@@ -1,0 +1,96 @@
+"""apex_tpu headline benchmark.
+
+Metric (BASELINE.md): ImageNet ResNet-50 imgs/sec/chip under amp O2.
+The reference publishes no absolute numbers (BASELINE.json published: {}),
+so ``vs_baseline`` is the O2 speedup over the O0 (fp32, no amp) step on the
+same chip — the reference's own L1 methodology (O-level cross-product vs an
+O0 baseline, /root/reference/tests/L1/common/run_test.sh:20-49) turned into
+a throughput ratio.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def make_step(model, opt, images, labels):
+    from apex_tpu.models import cross_entropy_loss
+
+    def step(params, batch_stats, opt_state):
+        def loss_fn(p):
+            logits, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images,
+                train=True,
+                mutable=["batch_stats"],
+            )
+            return cross_entropy_loss(logits, labels), mutated["batch_stats"]
+
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, bs, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
+
+
+def measure(dtype, batch, image_size, warmup=3, iters=10):
+    from apex_tpu.models import ResNet50
+    from apex_tpu.optimizers import fused_sgd
+
+    model = ResNet50(num_classes=1000, dtype=dtype)
+    key = jax.random.PRNGKey(0)
+    images = jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32)
+    labels = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, 1000)
+
+    variables = jax.jit(model.init)(key, images)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    # examples/imagenet/main_amp.py trains RN50 with momentum SGD
+    opt = fused_sgd(lr=0.1, momentum=0.9, weight_decay=1e-4)
+    opt_state = opt.init(params)
+
+    step = make_step(model, opt, images, labels)
+    for _ in range(warmup):
+        params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = step(params, batch_stats, opt_state)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    assert bool(jnp.isfinite(loss)), f"loss diverged: {loss}"
+    return batch * iters / dt
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        batch, image_size, iters = 256, 224, 20
+    else:  # CPU smoke mode so the bench is runnable anywhere
+        batch, image_size, iters = 8, 32, 2
+
+    o2 = measure(jnp.bfloat16, batch, image_size, iters=iters)  # amp O2: bf16 compute, fp32 params
+    o0 = measure(jnp.float32, batch, image_size, iters=iters)   # O0 baseline
+
+    print(
+        json.dumps(
+            {
+                "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+                "value": round(o2, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(o2 / o0, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,24 @@ class TestGPT:
             losses.append(float(loss))
         assert losses[-1] < losses[0] - 0.5, losses
 
+    def test_untied_output_weights(self, rng):
+        """share_embeddings_and_output_weights=False must use a separate
+        output projection — including on a last pipeline stage that has no
+        embedding at all (regression: this crashed / silently stayed tied)."""
+        cfg = tiny_cfg(share_embeddings_and_output_weights=False)
+        model = GPTModel(config=cfg)
+        tokens, labels = data(rng)
+        params = model.init(rng, tokens)
+        assert "output_layer" in params["params"]
+        logits = model.apply(params, tokens)
+        assert logits.shape == (2, 16, VOCAB)
+
+        last = GPTModel(config=cfg, pre_process=False, num_layers=1)
+        h = jax.random.normal(rng, (16, 2, cfg.hidden_size))
+        p_last = last.init(rng, h)
+        assert "embedding" not in p_last["params"]
+        assert last.apply(p_last, h).shape == (2, 16, VOCAB)
+
     def test_pipeline_stage_slicing(self, rng):
         """pre/post_process chunks compose to the full model (ref:
         build_model pre/post flags, schedules/common.py:83-108)."""
