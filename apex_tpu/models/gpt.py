@@ -130,6 +130,11 @@ class GPTModel(nn.Module):
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
                 kernel_init=nn.initializers.normal(stddev=0.02),
+                # the layer's own SP gather has a reduce-scatter backward —
+                # half the comm of a manual gather + copy_to composition
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                # fp32 logits for the vocab-parallel CE, like the tied path
+                output_dtype=jnp.float32,
                 name="output_layer",
             )
         self.transformer = ParallelTransformer(
@@ -173,22 +178,22 @@ class GPTModel(nn.Module):
 
         tied = cfg.share_embeddings_and_output_weights
         sp_gathered = cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
-        if sp_gathered:
-            # tied head: to_model_parallel=True — attend(parallel_input=True)
-            # leaves dh partial per tp rank and the gather backward is a
-            # single reduce-scatter (the reference's
-            # tensor_parallel_output_grad=True path). Untied head:
-            # ColumnParallelLinear's own copy_to performs the psum, so the
-            # gather backward must be a plain split.
-            h = gather_from_sequence_parallel_region(
-                h, cfg.tensor_axis, to_model_parallel=tied
-            )
         if tied:
+            if sp_gathered:
+                # to_model_parallel=True — attend(parallel_input=True) leaves
+                # dh partial per tp rank and the gather backward is a single
+                # reduce-scatter (the reference's
+                # tensor_parallel_output_grad=True path)
+                h = gather_from_sequence_parallel_region(
+                    h, cfg.tensor_axis, to_model_parallel=True
+                )
             logits = self.embedding.word_embeddings.attend(
                 h, parallel_input=sp_gathered
             )  # (s, b, v/tp) fp32
         else:
-            logits = self.output_layer(h).astype(jnp.float32)
+            # the layer performs the SP gather itself (reduce-scatter
+            # backward) and emits fp32 logits
+            logits = self.output_layer(h)
         logits = jnp.transpose(logits, (1, 0, 2))  # (b, s, v/tp)
         if labels is None:
             return logits

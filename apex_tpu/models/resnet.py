@@ -147,9 +147,6 @@ ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3], block_cls=BottleneckBlock
 
 def cross_entropy_loss(logits, labels, label_smoothing: float = 0.0):
     """Softmax CE over class logits (main_amp.py uses nn.CrossEntropyLoss)."""
-    num_classes = logits.shape[-1]
-    onehot = jax.nn.one_hot(labels, num_classes, dtype=logits.dtype)
-    if label_smoothing > 0.0:
-        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+    from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+    return jnp.mean(softmax_cross_entropy_loss(logits, labels, label_smoothing))

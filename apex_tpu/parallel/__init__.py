@@ -21,6 +21,7 @@ from apex_tpu.parallel.layers import (
 )
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.parallel import mappings
+from apex_tpu.parallel import pipeline
 from apex_tpu.parallel import random
 from apex_tpu.parallel.utils import (
     VocabUtility,
@@ -41,6 +42,7 @@ __all__ = [
     "VocabParallelEmbedding",
     "vocab_parallel_cross_entropy",
     "mappings",
+    "pipeline",
     "random",
     "VocabUtility",
     "broadcast_data",

@@ -19,7 +19,7 @@ Per-rank init matches Megatron semantics (random.py:204): initializers are
 wrapped so each TP rank draws from fold_in(key, 2718 + rank).
 """
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -74,6 +74,9 @@ class ColumnParallelLinear(nn.Module):
     params_dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = nn.initializers.lecun_normal()
     bias_init: Callable = nn.initializers.zeros_init()
+    # keep the fp32 MXU accumulator instead of rounding back to x.dtype —
+    # for heads whose consumer (e.g. vocab CE) wants full-precision logits
+    output_dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x):
@@ -98,7 +101,7 @@ class ColumnParallelLinear(nn.Module):
             kernel.astype(x.dtype),
             (((x.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ).astype(x.dtype)
+        ).astype(self.output_dtype or x.dtype)
         if self.use_bias:
             bias = self.param(
                 "bias",

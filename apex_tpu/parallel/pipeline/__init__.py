@@ -1,0 +1,66 @@
+"""Pipeline parallelism over the 'pp' mesh axis.
+
+Reference parity: apex/transformer/pipeline_parallel — p2p_communication.py
+(stage edges), schedules/ (no-pipelining, 1F1B, interleaved), microbatches.py
+(constant + batch-size-rampup calculators), utils.py (microbatch calculator
+registry).
+
+TPU design (see schedules.py docstring): schedules are *compiled* collective
+programs — a ``lax.scan`` over clock ticks with ``ppermute`` stage edges
+inside ``shard_map`` — instead of the reference's host-driven loops over
+dynamic NCCL p2p ops. The backward schedule is not hand-written at all: it is
+``jax.grad`` differentiating through the scan, which reverses every
+``ppermute`` edge automatically.
+"""
+
+from apex_tpu.parallel.pipeline.microbatches import (
+    ConstantNumMicroBatchesCalculator,
+    RampupBatchsizeNumMicroBatchesCalculator,
+    build_num_microbatches_calculator,
+    setup_microbatch_calculator,
+    get_num_microbatches,
+    get_current_global_batch_size,
+    update_num_microbatches,
+    destroy_num_microbatches_calculator,
+)
+from apex_tpu.parallel.pipeline.p2p import (
+    send_forward,
+    recv_forward,
+    send_backward,
+    recv_backward,
+    send_forward_recv_forward,
+    send_backward_recv_backward,
+    ring_send_last_to_first,
+)
+from apex_tpu.parallel.pipeline.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_without_interleaving,
+    forward_backward_pipelining_with_interleaving,
+    get_forward_backward_func,
+    pipeline_forward,
+    build_model,
+)
+
+__all__ = [
+    "ConstantNumMicroBatchesCalculator",
+    "RampupBatchsizeNumMicroBatchesCalculator",
+    "build_num_microbatches_calculator",
+    "setup_microbatch_calculator",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "destroy_num_microbatches_calculator",
+    "send_forward",
+    "recv_forward",
+    "send_backward",
+    "recv_backward",
+    "send_forward_recv_forward",
+    "send_backward_recv_backward",
+    "ring_send_last_to_first",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_without_interleaving",
+    "forward_backward_pipelining_with_interleaving",
+    "get_forward_backward_func",
+    "pipeline_forward",
+    "build_model",
+]

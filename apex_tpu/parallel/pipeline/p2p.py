@@ -1,0 +1,93 @@
+"""Stage-edge communication for pipeline parallelism.
+
+Reference parity: apex/transformer/pipeline_parallel/p2p_communication.py —
+``_communicate`` (:168) and the 9 public ops built on it (:385-690):
+recv_forward, send_forward, recv_backward, send_backward,
+send_forward_recv_backward, send_backward_recv_forward, … The reference
+drives dynamic NCCL/UCC isend/irecv pairs (``_run_p2pops``, :48-160) with
+shape/dtype negotiation between adjacent stages.
+
+TPU design: every stage edge is a ``jax.lax.ppermute`` over the 'pp' mesh
+axis inside ``shard_map``. This eliminates the entire reference machinery:
+
+- shape/dtype negotiation (:200-260): shapes are static under jit;
+- FutureTensor async handles (:34): XLA's latency-hiding scheduler overlaps
+  the permute with compute automatically;
+- batched vs individual isend/irecv (:48-160): one collective either way;
+- the "scatter-gather over TP ranks" optimization (:270-330): subsumed by
+  sequence-parallel shardings on the tensors themselves.
+
+Conventions: "forward" moves activations to the *next* stage (rank r → r+1,
+non-ring: the last stage sends to nobody, the first stage receives zeros);
+"backward" moves gradients to the *previous* stage. Autodiff of a ppermute
+is the transposed ppermute, so the backward schedule needs no hand-written
+edges at all — these backward ops exist for API parity and custom schedules.
+
+All functions are pytree-polymorphic and must be called inside
+``shard_map``/``pmap`` over ``axis_name``.
+"""
+
+from typing import Any
+
+import jax
+
+
+def _permute(x: Any, axis_name: str, perm) -> Any:
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.lax.ppermute(leaf, axis_name, perm), x
+    )
+
+
+def _pp_size(axis_name: str):
+    return jax.lax.psum(1, axis_name)
+
+
+def send_forward_recv_forward(x: Any, axis_name: str = "pp") -> Any:
+    """Ship activations one stage downstream (ref ops :385,:421 fused).
+
+    Rank r receives rank r-1's ``x``; rank 0 receives zeros (it will
+    overwrite them with fresh microbatch input). The send and recv sides of
+    the reference's paired isend/irecv collapse into one ppermute.
+    """
+    n = _pp_size(axis_name)
+    perm = [(i, i + 1) for i in range(n - 1)]
+    return _permute(x, axis_name, perm)
+
+
+def send_backward_recv_backward(g: Any, axis_name: str = "pp") -> Any:
+    """Ship gradients one stage upstream (ref :450): rank r receives rank
+    r+1's ``g``; the last stage receives zeros."""
+    n = _pp_size(axis_name)
+    perm = [(i + 1, i) for i in range(n - 1)]
+    return _permute(g, axis_name, perm)
+
+
+def ring_send_last_to_first(x: Any, axis_name: str = "pp") -> Any:
+    """Close the pipeline ring: the last stage's ``x`` arrives at stage 0,
+    everyone else receives zeros. Used by the circular (virtual-PP) schedule
+    and by embedding-weight sharing between first/last stages (ref:
+    parallel_state embedding groups, :319-407)."""
+    n = _pp_size(axis_name)
+    return _permute(x, axis_name, [(n - 1, 0)])
+
+
+# -- thin API-parity aliases (ref p2p_communication.py:385-690) -------------
+# In an SPMD collective there is no separate send/recv pair: both sides are
+# the same ppermute. The split names are kept so schedules read like the
+# reference.
+
+
+def recv_forward(x_sent_upstream: Any, axis_name: str = "pp") -> Any:
+    return send_forward_recv_forward(x_sent_upstream, axis_name)
+
+
+def send_forward(x: Any, axis_name: str = "pp") -> Any:
+    return send_forward_recv_forward(x, axis_name)
+
+
+def recv_backward(g_sent_downstream: Any, axis_name: str = "pp") -> Any:
+    return send_backward_recv_backward(g_sent_downstream, axis_name)
+
+
+def send_backward(g: Any, axis_name: str = "pp") -> Any:
+    return send_backward_recv_backward(g, axis_name)

@@ -1,0 +1,305 @@
+"""Pipeline schedules as compiled collective programs.
+
+Reference parity: apex/transformer/pipeline_parallel/schedules/ —
+- forward_backward_no_pipelining (fwd_bwd_no_pipelining.py:23),
+- 1F1B without interleaving (fwd_bwd_pipelining_without_interleaving.py:241),
+- interleaved 1F1B over virtual-PP model chunks
+  (fwd_bwd_pipelining_with_interleaving.py:27),
+- get_forward_backward_func dispatcher (schedules/__init__.py:22),
+- build_model with pre/post_process flags (schedules/common.py:30).
+
+TPU design. The reference's schedules are host Python loops issuing dynamic
+NCCL p2p ops per microbatch (warmup / steady-1F1B / cooldown phases with
+wait handles). Under XLA everything inside jit is traced once and compiled,
+so the schedule becomes a ``lax.scan`` over T = M + P - 1 clock ticks inside
+``shard_map`` over the 'pp' mesh axis:
+
+- at tick t, stage s computes microbatch t - s (bubble ticks compute masked
+  garbage — the SPMD cost of the (P-1)/(M+P-1) pipeline bubble, identical
+  to the reference's bubble fraction);
+- stage edges are a single ``ppermute`` (p2p.py);
+- the BACKWARD schedule is not written at all: ``jax.grad`` through the
+  scan reverses it tick-for-tick (ppermute transposes into the opposite
+  edge), yielding the same reversed-pipeline order the reference hand-codes
+  in its cooldown/steady phases;
+- 1F1B's purpose is bounding stashed activations to P microbatches; here
+  per-tick ``jax.checkpoint`` on the stage body keeps live memory to the
+  scan carry (one microbatch) plus per-tick boundary activations, the same
+  asymptotics;
+- the interleaved schedule maps virtual-PP chunk v on rank r to global
+  stage v*P + r exactly like the reference's chunk-id mapping
+  (fwd_bwd_pipelining_with_interleaving.py:221-259), executed as V circular
+  passes chained by a last→first ring edge.
+
+All schedule functions must run inside ``shard_map`` over ``axis_name``.
+``stage_fn(params, x) -> y`` must be shape-uniform (y like x); embedding /
+loss heads live outside the scan (pre_process/post_process in build_model).
+"""
+
+import functools
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.pipeline import p2p
+
+
+def _leading_dim(tree: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        raise ValueError("empty microbatch pytree")
+    return leaves[0].shape[0]
+
+
+def _index(tree: Any, i) -> Any:
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=False), tree
+    )
+
+
+def pipeline_forward(
+    stage_fn: Callable[[Any, Any], Any],
+    params: Any,
+    microbatches: Any,
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> Any:
+    """Run M microbatches through the P-stage compiled pipeline.
+
+    ``microbatches``: pytree with leading dim M (stage-0 input; only the
+    first stage reads it, so it may be garbage elsewhere). Returns a pytree
+    with leading dim M of last-stage outputs — *valid on the last stage
+    only* (other stages hold bubble garbage), mirroring how the reference's
+    forward_step returns losses only on the final stage (common.py:296-309).
+    """
+    num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
+    rank = jax.lax.axis_index(axis_name)
+    num_micro = _leading_dim(microbatches)
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    mb0 = _index(microbatches, 0)
+    out_shape = jax.eval_shape(stage_fn, params, mb0)
+    state0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
+    outbuf0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros((num_micro,) + s.shape, s.dtype), out_shape
+    )
+
+    def tick(carry, t):
+        state, outbuf = carry
+        recv = p2p.send_forward_recv_forward(state, axis_name)
+        mb = _index(microbatches, jnp.clip(t, 0, num_micro - 1))
+        is_first = rank == 0
+        x = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_first, a, b), mb, recv
+        )
+        y = body(params, x)
+        out_idx = t - (num_stages - 1)
+        valid = out_idx >= 0  # t < M + P - 1 already bounds out_idx < M
+        idx = jnp.maximum(out_idx, 0)
+
+        def update(buf, leaf):
+            old = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
+            new = jnp.where(valid, leaf, old)
+            return jax.lax.dynamic_update_index_in_dim(buf, new, idx, 0)
+
+        outbuf = jax.tree_util.tree_map(update, outbuf, y)
+        return (y, outbuf), None
+
+    ticks = jnp.arange(num_micro + num_stages - 1)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outbuf0), ticks)
+    return outputs
+
+
+def _last_stage_mean_loss(per_microbatch_losses, axis_name: str):
+    """Average per-microbatch losses and publish from the last stage to all
+    stages (ref: losses divided by num_microbatches on the last stage,
+    common.py:305-309; other stages return nothing)."""
+    num_stages = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    mean = jnp.mean(per_microbatch_losses)
+    local = jnp.where(rank == num_stages - 1, mean, 0.0)
+    # Publish the value via psum but keep only the LOCAL term on the grad
+    # path: psum's transpose would re-sum the replicated cotangent and
+    # scale grads by P. With the local term, the loss cotangent enters the
+    # graph once (on the last stage) and the ppermute transposes carry it
+    # back through every stage exactly as the reference's backward phases.
+    return local + jax.lax.stop_gradient(
+        jax.lax.psum(local, axis_name) - local
+    )
+
+
+def forward_backward_no_pipelining(
+    forward_step_fn: Callable[[Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    *,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Gradient accumulation over microbatches, no pipeline (ref:
+    fwd_bwd_no_pipelining.py:23).
+
+    ``forward_step_fn(params, microbatch) -> scalar loss``. Gradients are
+    accumulated across all microbatches and synchronized ONCE at the end via
+    ``grad_sync_fn`` (e.g. a dp psum) — the reference's "no_sync on all but
+    the last microbatch" semantics (:37-48). Returns
+    ``(mean_loss, per_microbatch_losses, grads)``.
+    """
+    num_micro = _leading_dim(microbatches)
+    grad_fn = jax.value_and_grad(forward_step_fn)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def body(acc, mb):
+        loss, g = grad_fn(params, mb)
+        acc = jax.tree_util.tree_map(jnp.add, acc, g)
+        return acc, loss
+
+    grads, losses = jax.lax.scan(body, zeros, microbatches)
+    grads = jax.tree_util.tree_map(lambda g: g / num_micro, grads)
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return jnp.mean(losses), losses, grads
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable[[Any, Any], Any],
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    targets: Any,
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Compiled 1F1B-equivalent schedule (ref:
+    fwd_bwd_pipelining_without_interleaving.py:241).
+
+    ``loss_fn(last_stage_output, target) -> scalar`` is applied per
+    microbatch on the last stage; the mean loss is psum-published so every
+    stage returns the same scalar. Returns
+    ``(loss, per_microbatch_losses, grads)`` where ``grads`` matches this
+    stage's ``params`` — the backward pipeline (warmup/steady/cooldown of
+    the reference) emerges from differentiating the forward scan.
+    """
+    num_stages = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    def total_loss(p):
+        outs = pipeline_forward(
+            stage_fn, p, microbatches, axis_name=axis_name, remat=remat
+        )
+        losses = jax.vmap(loss_fn)(outs, targets)
+        # mask bubble garbage on non-final stages out of the graph
+        losses = jnp.where(rank == num_stages - 1, losses, 0.0)
+        loss = _last_stage_mean_loss(losses, axis_name)
+        return loss, jax.lax.psum(losses, axis_name)
+
+    (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return loss, losses, grads
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable[[Any, Any], Any],
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    params_chunks: Any,
+    microbatches: Any,
+    targets: Any,
+    *,
+    num_model_chunks: int,
+    axis_name: str = "pp",
+    remat: bool = True,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Virtual-pipeline (interleaved) schedule (ref:
+    fwd_bwd_pipelining_with_interleaving.py:27).
+
+    ``params_chunks`` carries a leading dim V = num_model_chunks on every
+    leaf: this stage's V model chunks, where chunk v on rank r implements
+    global stage v*P + r — the reference's chunk-id mapping (:221-259). The
+    microbatch stream makes V circular passes over the P ranks, chained by
+    a last→first ring edge, so the layer order is exactly the reference's
+    interleaved assignment.
+    """
+    num_stages = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    def total_loss(chunks):
+        x = microbatches
+        outs = None
+        for v in range(num_model_chunks):
+            pv = jax.tree_util.tree_map(lambda a, _v=v: a[_v], chunks)
+            outs = pipeline_forward(
+                stage_fn, pv, x, axis_name=axis_name, remat=remat
+            )
+            if v < num_model_chunks - 1:
+                # close the ring: last stage's outputs become stage-0 input
+                # of the next virtual chunk pass
+                x = p2p.ring_send_last_to_first(outs, axis_name)
+        losses = jax.vmap(loss_fn)(outs, targets)
+        losses = jnp.where(rank == num_stages - 1, losses, 0.0)
+        loss = _last_stage_mean_loss(losses, axis_name)
+        return loss, jax.lax.psum(losses, axis_name)
+
+    (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(
+        params_chunks
+    )
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return loss, losses, grads
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int],
+    pipeline_model_parallel_size: int,
+) -> Callable:
+    """Schedule dispatcher (ref: schedules/__init__.py:22): interleaved iff
+    virtual PP is set, 1F1B iff PP > 1, else plain grad accumulation."""
+    if virtual_pipeline_model_parallel_size is not None:
+        if pipeline_model_parallel_size <= 1:
+            raise ValueError(
+                "virtual pipeline parallelism requires pipeline_model_parallel_size > 1"
+            )
+        return functools.partial(
+            forward_backward_pipelining_with_interleaving,
+            num_model_chunks=virtual_pipeline_model_parallel_size,
+        )
+    if pipeline_model_parallel_size > 1:
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining
+
+
+def build_model(
+    model_provider_func: Callable[..., Any],
+    pipeline_rank: int,
+    pipeline_world_size: int,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    **kwargs,
+) -> List[Any]:
+    """Construct this pipeline stage's model chunk(s) with pre/post flags
+    (ref: schedules/common.py:30-108).
+
+    ``model_provider_func(pre_process=..., post_process=..., **kwargs)``
+    builds one chunk; ``pre_process`` is True only for global stage 0
+    (owns the embedding), ``post_process`` only for the final global stage
+    (owns the head/loss) — the reference's flags at common.py:83-108. With
+    virtual PP, chunk v on rank r is global stage v*P + r, so rank 0 chunk 0
+    gets pre_process and rank P-1 chunk V-1 gets post_process.
+
+    Host-side helper: in SPMD there is no per-process rank, so the caller
+    names the stage being built (e.g. when stacking per-stage params for a
+    'pp'-sharded leading axis).
+    """
+    v = virtual_pipeline_model_parallel_size or 1
+    chunks = []
+    for chunk_id in range(v):
+        global_stage = chunk_id * pipeline_world_size + pipeline_rank
+        pre = global_stage == 0
+        post = global_stage == v * pipeline_world_size - 1
+        chunks.append(
+            model_provider_func(pre_process=pre, post_process=post, **kwargs)
+        )
+    return chunks
