@@ -14,6 +14,7 @@ dim 0); loss is per-token (batch, seq) fp32.
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
@@ -79,6 +80,14 @@ class Embedding(nn.Module):
         if cfg.position_embedding_type == "learned":
             if position_ids is None:
                 position_ids = jnp.arange(tokens.shape[1])[None, :]
+                if cfg.context_parallel_mode is not None:
+                    # cp-sharded sequence: local chunk r holds global
+                    # positions r*s_local.. — offset by the cp rank (same
+                    # fix as the rotary-table slice in transformer/layer.py)
+                    cp = _tp_size(cfg.context_axis)
+                    if cp > 1:
+                        rank = jax.lax.axis_index(cfg.context_axis)
+                        position_ids = position_ids + rank * tokens.shape[1]
             h = h + jnp.take(self.position_embeddings, position_ids, axis=0)
         if tokentype_ids is not None:
             if self.num_tokentypes <= 0:
@@ -165,6 +174,10 @@ class GPTModel(nn.Module):
             seq = h.shape[0]
             if cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1:
                 seq = seq * _tp_size(cfg.tensor_axis)
+            if cfg.context_parallel_mode is not None:
+                # cp-sharded sequence: build the GLOBAL table; attention
+                # slices each rank's chunk (transformer/layer.py)
+                seq = seq * _tp_size(cfg.context_axis)
             rotary = rotary_embedding_for(cfg, seq)
 
         h = self.transformer(

@@ -23,6 +23,7 @@ from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.parallel import mappings
 from apex_tpu.parallel import pipeline
 from apex_tpu.parallel import random
+from apex_tpu.parallel.ring_attention import ring_attention, ulysses_attention
 from apex_tpu.parallel.utils import (
     VocabUtility,
     broadcast_data,
@@ -44,6 +45,8 @@ __all__ = [
     "mappings",
     "pipeline",
     "random",
+    "ring_attention",
+    "ulysses_attention",
     "VocabUtility",
     "broadcast_data",
     "split_tensor_along_last_dim",

@@ -1,0 +1,207 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+The reference has NO context/ring parallelism (SURVEY.md §2.5: its
+long-context story tops out at Megatron sequence parallelism plus a
+seq<=512 fused MHA kernel, contrib/fmha). This module is the long-context
+subsystem the build brief makes first-class: sequence-sharded exact
+attention over the 'cp' mesh axis, scaling max context length linearly in
+the number of chips.
+
+Two strategies, both exact:
+
+- **Ring attention** (`ring_attention`): every rank keeps its query chunk;
+  K/V chunks rotate around the cp ring via ``ppermute`` while an online
+  (flash-style) softmax accumulates in fp32. The backward is NOT autodiff
+  through the forward scan (which would stash every rotated K/V — O(cp)
+  memory): a ``custom_vjp`` runs a second ring pass that recomputes
+  attention probabilities from the saved logsumexp and rotates dK/dV
+  accumulators *with* their chunks, so memory stays O(local) and the
+  compiler overlaps each step's ppermute with the next step's matmuls
+  (the TPU analogue of ring-attention's comm/compute overlap).
+- **Ulysses** (`ulysses_attention`): two ``all_to_all``s repartition
+  sequence-sharded activations to head-sharded, run the full-sequence
+  Pallas flash kernel locally, and repartition back. Cheaper collectives
+  for moderate contexts; requires heads % cp == 0.
+
+Causal handling in the ring: the chunk from rank j attends against local
+queries of rank i with (j < i) → full block, (j == i) → causal block,
+(j > i) → fully masked (contributes nothing). Ranks with higher indices do
+more work — the standard ring-attention causal imbalance; zigzag
+load-balanced chunk ordering is a planned optimization.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _rotate(tree, axis_name: str):
+    """Move every leaf one rank down the ring (rank r -> r+1 mod P)."""
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.ppermute(x, axis_name, perm), tree
+    )
+
+
+def _block_scores(q, k, scale, src, rank, causal):
+    """Masked fp32 scores for one ring step; returns (s, allow).
+
+    q: (b, h, sq, d) local queries, k: (b, h, sk, d) visiting chunk from
+    rank ``src`` (traced). allow is the keep-mask implementing the global
+    causal structure across chunks.
+    """
+    s = (
+        jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
+    if not causal:
+        return s, None
+    sq, sk = s.shape[-2], s.shape[-1]
+    tri = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]  # lower incl diag
+    allow = jnp.where(
+        src < rank, True, jnp.where(src == rank, tri, False)
+    )  # (sq, sk) traced
+    s = jnp.where(allow, s, _NEG_INF)
+    return s, allow
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring(q, k, v, axis_name, causal, scale):
+    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale)
+    return o
+
+
+def _ring_fwd_res(q, k, v, axis_name, causal, scale):
+    num_ranks = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    def step(carry, t):
+        (kc, vc), acc, m, l = carry
+        src = jax.lax.rem(rank - t + num_ranks, num_ranks)
+        s, allow = _block_scores(qf, kc.astype(jnp.float32), scale, src, rank, causal)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        if allow is not None:
+            p = jnp.where(allow, p, 0.0)  # exp(-inf - (-inf)) guard
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32)
+        )
+        return (_rotate((kc, vc), axis_name), acc_new, m_new, l_new), None
+
+    init = (
+        (k, v),
+        jnp.zeros((b, h, sq, d), jnp.float32),
+        jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    (_, acc, m, l), _ = jax.lax.scan(step, init, jnp.arange(num_ranks))
+    l = jnp.maximum(l, 1e-30)
+    o = (acc / l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, res, do):
+    q, k, v, o, lse = res
+    num_ranks = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b, h, sq)
+
+    def step(carry, t):
+        (kc, vc, dkc, dvc), dq = carry
+        src = jax.lax.rem(rank - t + num_ranks, num_ranks)
+        kcf = kc.astype(jnp.float32)
+        vcf = vc.astype(jnp.float32)
+        s, allow = _block_scores(qf, kcf, scale, src, rank, causal)
+        p = jnp.exp(s - lse[..., None])
+        if allow is not None:
+            p = jnp.where(allow, p, 0.0)
+        dvc = dvc + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vcf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kcf)
+        dkc = dkc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        # dK/dV ride the ring with their chunks; after P rotations they are
+        # home with the full sum of every rank's contribution
+        return (_rotate((kc, vc, dkc, dvc), axis_name), dq), None
+
+    init = (
+        (k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+        jnp.zeros(q.shape, jnp.float32),
+    )
+    ((_, _, dk, dv), dq), _ = jax.lax.scan(step, init, jnp.arange(num_ranks))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring.defvjp(_ring_fwd_res, _ring_bwd)
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "cp",
+    causal: bool = False,
+    scale: float = None,
+):
+    """Exact sequence-sharded attention over the ``axis_name`` ring.
+
+    q, k, v: (batch, heads, seq_local, head_dim) — the local chunk of a
+    sequence sharded in rank order over the cp axis. Call inside
+    ``shard_map``. Returns the local output chunk; grads flow through a
+    second ring pass (see module docstring).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _ring(q, k, v, axis_name, causal, scale)
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    axis_name: str = "cp",
+    causal: bool = False,
+    scale: float = None,
+    attn_fn=None,
+):
+    """DeepSpeed-Ulysses-style attention: all-to-all from sequence-sharded
+    to head-sharded, full-sequence local attention, all-to-all back.
+
+    q, k, v: (batch, heads, seq_local, head_dim) with heads divisible by
+    the cp size. ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to
+    the Pallas flash kernel. The two all_to_alls transpose to their own
+    inverses under autodiff, so no custom backward is needed.
+    """
+    if attn_fn is None:
+        from apex_tpu.ops.attention import flash_attention
+
+        attn_fn = flash_attention
+    num_ranks = jax.lax.psum(1, axis_name)  # static inside shard_map
+    assert q.shape[1] % num_ranks == 0, (
+        f"heads ({q.shape[1]}) not divisible by cp size ({num_ranks}); "
+        "use ring_attention for head counts below the cp degree"
+    )
+
+    # With cp=1 this degrades to plain attention.
+    def to_heads(x):
+        # (b, h, s_loc, d) -> (b, h/P, s_glob, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return to_seq(oh)

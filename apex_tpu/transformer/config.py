@@ -52,6 +52,11 @@ class TransformerConfig:
     # parallelism
     sequence_parallel: bool = False
     tensor_axis: str = "tp"
+    # context parallelism (no reference counterpart — SURVEY.md §2.5):
+    # shard the sequence over the 'cp' mesh axis inside attention.
+    # None | "ring" (ppermute K/V ring) | "ulysses" (all-to-all head swap)
+    context_parallel_mode: Optional[str] = None
+    context_axis: str = "cp"
     recompute_granularity: Optional[str] = None  # None | "full" | "selective"
 
     # dtypes: params live in fp32, compute in bf16 by default (TPU-native
@@ -65,6 +70,11 @@ class TransformerConfig:
     share_embeddings_and_output_weights: bool = True
 
     def __post_init__(self):
+        if self.context_parallel_mode not in (None, "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel_mode must be None, 'ring', or 'ulysses'; "
+                f"got {self.context_parallel_mode!r}"
+            )
         if self.ffn_hidden_size is None:
             object.__setattr__(self, "ffn_hidden_size", 4 * self.hidden_size)
         if self.kv_channels is None:

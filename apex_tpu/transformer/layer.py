@@ -230,8 +230,25 @@ class ParallelAttention(nn.Module):
             kv = kv.reshape(kv.shape[0], b, np_local, 2 * hn)
             k, v = jnp.split(kv, 2, axis=-1)
 
+        cp = (
+            _tp_size(cfg.context_axis) if cfg.context_parallel_mode is not None else 1
+        )
+
         if rotary_pos_emb is not None:
             q_pos_emb, k_pos_emb = rotary_pos_emb
+            if cp > 1:
+                # sequence is cp-sharded: slice this rank's chunk out of the
+                # GLOBAL rotary table so positions stay absolute
+                def _local_chunk(emb, s_local):
+                    if emb.shape[0] == s_local:
+                        return emb
+                    r = jax.lax.axis_index(cfg.context_axis)
+                    return jax.lax.dynamic_slice_in_dim(
+                        emb, r * s_local, s_local, 0
+                    )
+
+                q_pos_emb = _local_chunk(q_pos_emb, q.shape[0])
+                k_pos_emb = _local_chunk(k_pos_emb, k.shape[0])
             q = apply_rotary_pos_emb(q, q_pos_emb)
             k = apply_rotary_pos_emb(k, k_pos_emb)
 
@@ -247,7 +264,24 @@ class ParallelAttention(nn.Module):
         use_flash = attention_mask is None and (
             cfg.attention_dropout == 0.0 or deterministic
         )
-        if use_flash:
+        if cp > 1:
+            if not use_flash:
+                raise NotImplementedError(
+                    "context parallelism supports causal/unmasked attention "
+                    "without dropout (like the reference's fused paths)"
+                )
+            from apex_tpu.parallel.ring_attention import (
+                ring_attention,
+                ulysses_attention,
+            )
+
+            cp_attn = (
+                ring_attention
+                if cfg.context_parallel_mode == "ring"
+                else ulysses_attention
+            )
+            ctx = cp_attn(qb, kb, vb, axis_name=cfg.context_axis, causal=causal)
+        elif use_flash:
             ctx = flash_attention(
                 qb, kb, vb, causal=causal, impl=cfg.attention_impl
             )
