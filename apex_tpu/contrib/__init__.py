@@ -1,0 +1,30 @@
+"""Contrib zoo parity (ref: apex/contrib — SURVEY.md §2.3).
+
+Each module re-designs one reference contrib extension for TPU. Where the
+reference ships a CUDA kernel, the TPU path is either a Pallas kernel or an
+XLA-fused jnp composition (the fusion the CUDA kernel hand-codes is exactly
+what XLA does to elementwise chains on TPU).
+"""
+
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.transducer import (
+    TransducerJoint,
+    TransducerLoss,
+    transducer_joint,
+    transducer_loss,
+)
+from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
+
+__all__ = [
+    "focal_loss",
+    "GroupNorm",
+    "group_norm",
+    "index_mul_2d",
+    "TransducerJoint",
+    "TransducerLoss",
+    "transducer_joint",
+    "transducer_loss",
+    "SoftmaxCrossEntropyLoss",
+]

@@ -1,0 +1,180 @@
+"""RNN-Transducer joint and loss.
+
+Reference parity: apex.contrib.transducer
+(contrib/transducer/transducer.py:5 TransducerJoint, :68 TransducerLoss)
+backed by transducer_joint_cuda / transducer_loss_cuda (~2k LoC). Semantics
+follow the reference's own numerical oracle
+(contrib/transducer/_transducer_ref.py): the loss takes RAW LOGITS
+``x: (B, T, U, V)``, applies log_softmax internally, runs the
+Graves-transducer alpha recursion
+
+    alpha[t, u] = logaddexp(alpha[t-1, u] + log P(blank | t-1, u),
+                            alpha[t, u-1] + log P(y_u   | t, u-1))
+
+and returns ``loss[b] = -(alpha[f_len-1, y_len] + log P(blank | f_len-1,
+y_len))`` (= -beta[0,0] of the reference).
+
+TPU design notes:
+
+- the recursion is a ``lax.scan`` over T with a nested scan over U (each
+  step is a (B,)-vector op). The reference's beta pass + hand-fused
+  softmax backward (fuse_softmax_backward) are replaced by autodiff
+  through the scan — the backward recursion it generates IS the beta
+  recursion, in fp32.
+- variable lengths need no masking: cells beyond (f_len, y_len) are
+  computed but never reach the gathered loss, so they cannot affect values
+  or gradients.
+- ``pack_output`` (the reference's don't-care compaction, transducer.py
+  batch_offset/packed_batch) is a non-goal under XLA's static shapes: the
+  joint instead supports zeroing the don't-care region via ``f_len/g_len``
+  masks, which composes with XLA's fusion at no extra memory traffic.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def transducer_joint(
+    f,
+    g,
+    f_len=None,
+    g_len=None,
+    relu: bool = False,
+    dropout_prob: float = 0.0,
+    dropout_rng=None,
+):
+    """Broadcast-add joint: f (B, T, H) + g (B, U, H) -> (B, T, U, H).
+
+    With ``f_len``/``g_len`` the don't-care region is zeroed (the packed
+    form's information content). ``relu`` and dropout mirror the fused
+    epilogues (transducer.py relu/dropout args).
+    """
+    h = f[:, :, None, :] + g[:, None, :, :]
+    if relu:
+        h = jax.nn.relu(h)
+    if dropout_prob > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_prob > 0 requires dropout_rng")
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_prob), 0.0)
+    if f_len is not None:
+        t_valid = jnp.arange(h.shape[1])[None, :, None, None] < f_len[:, None, None, None]
+        h = jnp.where(t_valid, h, 0.0)
+    if g_len is not None:
+        u_valid = jnp.arange(h.shape[2])[None, None, :, None] < g_len[:, None, None, None]
+        h = jnp.where(u_valid, h, 0.0)
+    return h
+
+
+def transducer_loss(x, label, f_len, y_len, blank_idx: int):
+    """Per-batch RNN-T negative log-likelihood; see module docstring.
+
+    x: (B, T, U, V) raw logits; label: (B, U-1) int; f_len, y_len: (B,) int.
+    Returns (B,) fp32 losses.
+    """
+    b, t_max, u_max, _ = x.shape
+    lp = jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+    blank_lp = lp[..., blank_idx]  # (B, T, U)
+    # y_lp[b, t, u] = log P(label[b, u] | t, u); pad u = U-1 (never read)
+    label_pad = jnp.concatenate(
+        [label.astype(jnp.int32), jnp.zeros((b, 1), jnp.int32)], axis=1
+    )
+    y_lp = jnp.take_along_axis(lp, label_pad[:, None, :, None], axis=-1)[..., 0]
+
+    neg_inf = jnp.float32(-1e30)
+
+    def alpha_step(prev_row, inputs):
+        """One time step: prev_row = alpha[t-1, :] -> alpha[t, :]."""
+        up, y_row = inputs  # up: (B, U) from-below term, y_row: (B, U)
+
+        def inner(prev, xs):
+            up_u, y_prev = xs  # (B,), (B,)
+            cur = jnp.logaddexp(up_u, prev + y_prev)
+            return cur, cur
+
+        # shift y right: row[u] consumes y[t, u-1]
+        y_shift = jnp.concatenate(
+            [jnp.full((b, 1), neg_inf), y_row[:, :-1]], axis=1
+        )
+        _, row = jax.lax.scan(
+            inner,
+            jnp.full((b,), neg_inf),
+            (up.swapaxes(0, 1), y_shift.swapaxes(0, 1)),
+        )
+        return row.swapaxes(0, 1), None
+
+    def scan_t(carry, inputs):
+        prev_row, t = carry
+        blank_prev, y_row = inputs  # blank_lp[t-1] (garbage at t=0), y_lp[t]
+        start = jnp.broadcast_to(
+            jnp.where(jnp.arange(u_max)[None, :] == 0, 0.0, neg_inf), (b, u_max)
+        )
+        up = jnp.where(t == 0, start, prev_row + blank_prev)
+        row, _ = alpha_step(None, (up, y_row))
+        return (row, t + 1), row
+
+    blank_shift = jnp.concatenate(
+        [jnp.zeros((b, 1, u_max)), blank_lp[:, :-1, :]], axis=1
+    )
+    (_, _), alpha = jax.lax.scan(
+        scan_t,
+        (jnp.full((b, u_max), neg_inf), jnp.int32(0)),
+        (blank_shift.swapaxes(0, 1), y_lp.swapaxes(0, 1)),
+    )
+    alpha = alpha.swapaxes(0, 1)  # (B, T, U)
+
+    t_idx = (f_len - 1).astype(jnp.int32)
+    u_idx = y_len.astype(jnp.int32)
+    batch = jnp.arange(b)
+    final_alpha = alpha[batch, t_idx, u_idx]
+    final_blank = blank_lp[batch, t_idx, u_idx]
+    return -(final_alpha + final_blank)
+
+
+class TransducerJoint:
+    """Module-form parity (ref: transducer.py:5). ``pack_output`` is
+    rejected (see module docstring); relu/dropout mirror the fused
+    epilogues."""
+
+    def __init__(
+        self,
+        pack_output: bool = False,
+        relu: bool = False,
+        dropout: bool = False,
+        dropout_prob: float = 0.0,
+    ):
+        if pack_output:
+            raise NotImplementedError(
+                "pack_output is a CUDA-memory-layout optimization; under "
+                "XLA static shapes use f_len/g_len masking instead"
+            )
+        self.relu = relu
+        self.dropout = dropout
+        self.dropout_prob = dropout_prob
+
+    def __call__(self, f, g, f_len=None, g_len=None, dropout_rng=None):
+        return transducer_joint(
+            f,
+            g,
+            f_len=f_len,
+            g_len=g_len,
+            relu=self.relu,
+            dropout_prob=self.dropout_prob if self.dropout else 0.0,
+            dropout_rng=dropout_rng,
+        )
+
+
+class TransducerLoss:
+    """Module-form parity (ref: transducer.py:68)."""
+
+    def __init__(self, packed_input: bool = False):
+        if packed_input:
+            raise NotImplementedError(
+                "packed_input is a CUDA-memory-layout optimization; the TPU "
+                "loss ignores cells beyond (f_len, y_len) at no extra cost"
+            )
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

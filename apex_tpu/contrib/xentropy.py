@@ -1,0 +1,21 @@
+"""Contrib-API wrapper for fused softmax cross-entropy.
+
+Reference parity: apex.contrib.xentropy.SoftmaxCrossEntropyLoss
+(contrib/xentropy/softmax_xentropy.py:6). The math lives in
+apex_tpu.ops.xentropy; this class mirrors the reference's autograd-Function
+call signature (logits, labels, smoothing, padding_idx, half_to_float).
+"""
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+
+class SoftmaxCrossEntropyLoss:
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0, half_to_float=False):
+        losses = softmax_cross_entropy_loss(
+            logits, labels, smoothing=smoothing, half_to_float=half_to_float
+        )
+        # the reference zeroes the loss at padding positions
+        return jnp.where(labels == padding_idx, 0.0, losses)

@@ -70,8 +70,13 @@ class Embedding(nn.Module):
                 (self.num_tokentypes, cfg.hidden_size),
                 cfg.params_dtype,
             )
-        # setup-based module: submodules must be declared here, not inline
-        self.dropout = nn.Dropout(rate=cfg.hidden_dropout)
+        # setup-based module: submodules must be declared here, not inline.
+        # Dropout runs BEFORE the SP scatter (full-sequence mask, identical
+        # on all tp ranks) but tokens are already cp-sharded — fold cp.
+        from apex_tpu.transformer.layer import ShardAwareDropout
+
+        cp_axes = (cfg.context_axis,) if cfg.context_parallel_mode else ()
+        self.dropout = ShardAwareDropout(rate=cfg.hidden_dropout, axis_names=cp_axes)
 
     def __call__(self, tokens, position_ids=None, tokentype_ids=None,
                  deterministic: bool = True):

@@ -41,6 +41,25 @@ def model_parallel_rng_key(key, axis_name: str = "tp"):
     return key
 
 
+def shard_aware_rng_key(key, axis_names):
+    """Fold the rank along each *active* named axis into ``key``.
+
+    Used to decorrelate dropout masks across shards that each hold a
+    different slice of the same logical tensor (sequence-parallel over tp,
+    context-parallel over cp) — the SPMD equivalent of the reference's
+    CudaRNGStatesTracker keeping distinct generator states per
+    model-parallel rank (ref: random.py:124-236). Axes that are not bound
+    (module traced outside shard_map, e.g. during ``init``) or have size 1
+    are skipped.
+    """
+    for name in axis_names:
+        try:
+            key = jax.random.fold_in(key, jax.lax.axis_index(name))
+        except NameError:
+            pass
+    return key
+
+
 def data_parallel_rng_key(key):
     """Key for data-parallel regions: identical on all TP ranks (ref:
     random.py — default generator keeps the data-parallel seed)."""

@@ -11,13 +11,17 @@ Two strategies, both exact:
 
 - **Ring attention** (`ring_attention`): every rank keeps its query chunk;
   K/V chunks rotate around the cp ring via ``ppermute`` while an online
-  (flash-style) softmax accumulates in fp32. The backward is NOT autodiff
-  through the forward scan (which would stash every rotated K/V — O(cp)
-  memory): a ``custom_vjp`` runs a second ring pass that recomputes
-  attention probabilities from the saved logsumexp and rotates dK/dV
-  accumulators *with* their chunks, so memory stays O(local) and the
-  compiler overlaps each step's ppermute with the next step's matmuls
-  (the TPU analogue of ring-attention's comm/compute overlap).
+  (flash-style) softmax accumulates in fp32. Each ring step processes the
+  visiting K/V chunk in ``block_size`` slices through an inner ``lax.scan``
+  with the same online-softmax update, so local memory is
+  O(s_local x block_size) — never the full (s_local, s_local) score matrix.
+  The backward is NOT autodiff through the forward scan (which would stash
+  every rotated K/V — O(cp) memory): a ``custom_vjp`` runs a second ring
+  pass that recomputes probabilities blockwise from the saved logsumexp and
+  rotates dK/dV accumulators *with* their chunks. The first ring step uses
+  the resident chunk, so each pass issues exactly P-1 forward rotations
+  (plus one homing rotation in backward), and XLA's latency-hiding
+  scheduler overlaps each step's ppermute with the next step's matmuls.
 - **Ulysses** (`ulysses_attention`): two ``all_to_all``s repartition
   sequence-sharded activations to head-sharded, run the full-sequence
   Pallas flash kernel locally, and repartition back. Cheaper collectives
@@ -48,69 +52,148 @@ def _rotate(tree, axis_name: str):
     )
 
 
-def _block_scores(q, k, scale, src, rank, causal):
-    """Masked fp32 scores for one ring step; returns (s, allow).
-
-    q: (b, h, sq, d) local queries, k: (b, h, sk, d) visiting chunk from
-    rank ``src`` (traced). allow is the keep-mask implementing the global
-    causal structure across chunks.
-    """
-    s = (
-        jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
-        * scale
-    )
+def _allow_mask(sq: int, kv_lo, bk: int, src, rank, causal: bool):
+    """Keep-mask (sq, bk) for queries vs the kv block starting at global-
+    chunk-local offset ``kv_lo`` of the chunk from rank ``src`` (traced)."""
     if not causal:
-        return s, None
-    sq, sk = s.shape[-2], s.shape[-1]
-    tri = jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None]  # lower incl diag
-    allow = jnp.where(
-        src < rank, True, jnp.where(src == rank, tri, False)
-    )  # (sq, sk) traced
-    s = jnp.where(allow, s, _NEG_INF)
-    return s, allow
+        return None
+    rows = jnp.arange(sq)[:, None]
+    cols = kv_lo + jnp.arange(bk)[None, :]
+    tri = cols <= rows
+    return jnp.where(src < rank, True, jnp.where(src == rank, tri, False))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring(q, k, v, axis_name, causal, scale):
-    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale)
-    return o
+def _chunk_block_size(s_local: int, block_size: int) -> int:
+    bk = min(block_size, s_local)
+    while s_local % bk != 0:  # s_local is a power-of-two-ish shard; cheap
+        bk -= 1
+    return bk
 
 
-def _ring_fwd_res(q, k, v, axis_name, causal, scale):
-    num_ranks = jax.lax.psum(1, axis_name)
-    rank = jax.lax.axis_index(axis_name)
-    b, h, sq, d = q.shape
-    qf = q.astype(jnp.float32)
+def _online_chunk_update(state, qf, kc, vc, scale, src, rank, causal, block_size):
+    """Stream one visiting K/V chunk through the online softmax in
+    ``block_size`` slices. state = (acc, m, l) accumulated so far."""
+    sq = qf.shape[-2]
+    s_kv = kc.shape[-2]
+    bk = _chunk_block_size(s_kv, block_size)
+    num_blocks = s_kv // bk
 
-    def step(carry, t):
-        (kc, vc), acc, m, l = carry
-        src = jax.lax.rem(rank - t + num_ranks, num_ranks)
-        s, allow = _block_scores(qf, kc.astype(jnp.float32), scale, src, rank, causal)
+    def block_step(carry, j):
+        acc, m, l = carry
+        lo = j * bk
+        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2).astype(jnp.float32)
+        s = (
+            jnp.einsum("bhqd,bhkd->bhqk", qf, kb, preferred_element_type=jnp.float32)
+            * scale
+        )
+        allow = _allow_mask(sq, lo, bk, src, rank, causal)
+        if allow is not None:
+            s = jnp.where(allow, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         if allow is not None:
             p = jnp.where(allow, p, 0.0)  # exp(-inf - (-inf)) guard
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32)
-        )
-        return (_rotate((kc, vc), axis_name), acc_new, m_new, l_new), None
+        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        return (acc_new, m_new, l_new), None
 
-    init = (
-        (k, v),
+    if num_blocks == 1:
+        state, _ = block_step(state, jnp.int32(0))
+        return state
+    state, _ = jax.lax.scan(block_step, state, jnp.arange(num_blocks))
+    return state
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring(q, k, v, axis_name, causal, scale, block_size):
+    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size)
+    return o
+
+
+def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
+    num_ranks = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    qf = q.astype(jnp.float32)
+
+    init_state = (
         jnp.zeros((b, h, sq, d), jnp.float32),
         jnp.full((b, h, sq), _NEG_INF, jnp.float32),
         jnp.zeros((b, h, sq), jnp.float32),
     )
-    (_, acc, m, l), _ = jax.lax.scan(step, init, jnp.arange(num_ranks))
+    # step 0 on the resident chunk — no rotation needed
+    state = _online_chunk_update(
+        init_state, qf, k, v, scale, rank, rank, causal, block_size
+    )
+
+    def step(carry, t):
+        (kc, vc), state = carry
+        kc, vc = _rotate((kc, vc), axis_name)
+        src = jax.lax.rem(rank - t + num_ranks, num_ranks)
+        state = _online_chunk_update(
+            state, qf, kc, vc, scale, src, rank, causal, block_size
+        )
+        return ((kc, vc), state), None
+
+    if num_ranks > 1:
+        ((_, _), state), _ = jax.lax.scan(
+            step, ((k, v), state), jnp.arange(1, num_ranks)
+        )
+    acc, m, l = state
     l = jnp.maximum(l, 1e-30)
     o = (acc / l[..., None]).astype(q.dtype)
     lse = m + jnp.log(l)
     return o, (q, k, v, o, lse)
 
 
-def _ring_bwd(axis_name, causal, scale, res, do):
+def _chunk_bwd_update(qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
+                      causal, block_size):
+    """Blockwise gradient contributions of one visiting K/V chunk."""
+    sq = qf.shape[-2]
+    s_kv = kc.shape[-2]
+    bk = _chunk_block_size(s_kv, block_size)
+    num_blocks = s_kv // bk
+
+    def block_step(carry, j):
+        dkc, dvc, dq = carry
+        lo = j * bk
+        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2).astype(jnp.float32)
+        s = (
+            jnp.einsum("bhqd,bhkd->bhqk", qf, kb, preferred_element_type=jnp.float32)
+            * scale
+        )
+        allow = _allow_mask(sq, lo, bk, src, rank, causal)
+        if allow is not None:
+            s = jnp.where(allow, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])
+        if allow is not None:
+            p = jnp.where(allow, p, 0.0)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        dkc = jax.lax.dynamic_update_slice_in_dim(
+            dkc, jax.lax.dynamic_slice_in_dim(dkc, lo, bk, 2) + dk_b, lo, 2
+        )
+        dvc = jax.lax.dynamic_update_slice_in_dim(
+            dvc, jax.lax.dynamic_slice_in_dim(dvc, lo, bk, 2) + dv_b, lo, 2
+        )
+        return (dkc, dvc, dq), None
+
+    if num_blocks == 1:
+        (dkc, dvc, dq), _ = block_step((dkc, dvc, dq), jnp.int32(0))
+    else:
+        (dkc, dvc, dq), _ = jax.lax.scan(
+            block_step, (dkc, dvc, dq), jnp.arange(num_blocks)
+        )
+    return dkc, dvc, dq
+
+
+def _ring_bwd(axis_name, causal, scale, block_size, res, do):
     q, k, v, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -118,29 +201,34 @@ def _ring_bwd(axis_name, causal, scale, res, do):
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b, h, sq)
 
+    zeros_k = jnp.zeros(k.shape, jnp.float32)
+    zeros_v = jnp.zeros(v.shape, jnp.float32)
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    # step 0 on the resident chunk
+    dk0, dv0, dq = _chunk_bwd_update(
+        qf, dof, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rank, rank,
+        causal, block_size,
+    )
+
     def step(carry, t):
         (kc, vc, dkc, dvc), dq = carry
+        # dK/dV ride the ring with their chunks
+        kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
-        kcf = kc.astype(jnp.float32)
-        vcf = vc.astype(jnp.float32)
-        s, allow = _block_scores(qf, kcf, scale, src, rank, causal)
-        p = jnp.exp(s - lse[..., None])
-        if allow is not None:
-            p = jnp.where(allow, p, 0.0)
-        dvc = dvc + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vcf)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kcf)
-        dkc = dkc + jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-        # dK/dV ride the ring with their chunks; after P rotations they are
-        # home with the full sum of every rank's contribution
-        return (_rotate((kc, vc, dkc, dvc), axis_name), dq), None
+        dkc, dvc, dq = _chunk_bwd_update(
+            qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
+            causal, block_size,
+        )
+        return ((kc, vc, dkc, dvc), dq), None
 
-    init = (
-        (k, v, jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
-        jnp.zeros(q.shape, jnp.float32),
-    )
-    ((_, _, dk, dv), dq), _ = jax.lax.scan(step, init, jnp.arange(num_ranks))
+    carry = ((k, v, dk0, dv0), dq)
+    if num_ranks > 1:
+        carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))
+    (kc, vc, dk, dv), dq = carry
+    # one homing rotation: after P-1 rotations the accumulators sit one rank
+    # short of their owners
+    if num_ranks > 1:
+        dk, dv = _rotate((dk, dv), axis_name)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -154,17 +242,19 @@ def ring_attention(
     axis_name: str = "cp",
     causal: bool = False,
     scale: float = None,
+    block_size: int = 512,
 ):
     """Exact sequence-sharded attention over the ``axis_name`` ring.
 
     q, k, v: (batch, heads, seq_local, head_dim) — the local chunk of a
     sequence sharded in rank order over the cp axis. Call inside
-    ``shard_map``. Returns the local output chunk; grads flow through a
-    second ring pass (see module docstring).
+    ``shard_map``. ``block_size`` bounds the K/V slice processed at once
+    (local memory O(seq_local x block_size)). Returns the local output
+    chunk; grads flow through a second ring pass (see module docstring).
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _ring(q, k, v, axis_name, causal, scale)
+    return _ring(q, k, v, axis_name, causal, scale, block_size)
 
 
 def ulysses_attention(

@@ -16,6 +16,7 @@ be forced to fp32 (``fp32_residual_connection``); matmuls accumulate in fp32
 on the MXU via ``preferred_element_type``.
 """
 
+import functools
 import math
 from typing import Optional
 
@@ -117,6 +118,42 @@ class ParallelMLP(nn.Module):
         )(h)
 
 
+class ShardAwareDropout(nn.Module):
+    """Dropout whose mask is decorrelated across shards holding different
+    slices of the same logical tensor.
+
+    The SPMD analogue of the reference keeping distinct RNG states per
+    model-parallel rank (tensor_parallel/random.py:124-236): inside
+    shard_map every rank receives the same flax 'dropout' key, so without
+    folding in the shard index, sequence chunks (cp) and head shards (tp)
+    would draw byte-identical masks.
+    """
+
+    rate: float
+    axis_names: tuple = ()
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = False):
+        if deterministic or self.rate == 0.0:
+            return x
+        from apex_tpu.parallel.random import shard_aware_rng_key
+
+        key = shard_aware_rng_key(self.make_rng("dropout"), self.axis_names)
+        keep = jax.random.bernoulli(key, 1.0 - self.rate, x.shape)
+        return jnp.where(keep, x / (1.0 - self.rate), jnp.zeros_like(x))
+
+
+def _hidden_dropout_axes(cfg) -> tuple:
+    """Axes over which hidden-state dropout masks must differ: tp when the
+    sequence is SP-sharded, cp when context-parallel."""
+    axes = ()
+    if cfg.sequence_parallel:
+        axes += (cfg.tensor_axis,)
+    if cfg.context_parallel_mode is not None:
+        axes += (cfg.context_axis,)
+    return axes
+
+
 class CoreAttention(nn.Module):
     """Unfused attention math for masked/dropout paths.
 
@@ -155,9 +192,11 @@ class CoreAttention(nn.Module):
             s, attention_mask, scale=softmax_scale, causal=causal
         )
         if cfg.attention_dropout > 0.0 and not deterministic:
-            probs = nn.Dropout(rate=cfg.attention_dropout)(
-                probs, deterministic=deterministic
-            )
+            # heads are tp-sharded: masks must differ per tp rank (the
+            # reference forks the model-parallel RNG around attn dropout)
+            probs = ShardAwareDropout(
+                rate=cfg.attention_dropout, axis_names=(cfg.tensor_axis,)
+            )(probs, deterministic=deterministic)
         ctx = jnp.einsum(
             "bnqk,bnkd->bnqd",
             probs.astype(q.dtype),
@@ -275,12 +314,21 @@ class ParallelAttention(nn.Module):
                 ulysses_attention,
             )
 
-            cp_attn = (
-                ring_attention
-                if cfg.context_parallel_mode == "ring"
-                else ulysses_attention
-            )
-            ctx = cp_attn(qb, kb, vb, axis_name=cfg.context_axis, causal=causal)
+            if cfg.context_parallel_mode == "ring":
+                ctx = ring_attention(
+                    qb, kb, vb, axis_name=cfg.context_axis, causal=causal
+                )
+            else:
+                ctx = ulysses_attention(
+                    qb,
+                    kb,
+                    vb,
+                    axis_name=cfg.context_axis,
+                    causal=causal,
+                    attn_fn=functools.partial(
+                        flash_attention, impl=cfg.attention_impl
+                    ),
+                )
         elif use_flash:
             ctx = flash_attention(
                 qb, kb, vb, causal=causal, impl=cfg.attention_impl
@@ -350,9 +398,9 @@ class ParallelTransformerLayer(nn.Module):
             ln_out if cfg.apply_residual_connection_post_layernorm else hidden_states
         )
         if cfg.hidden_dropout > 0.0 and not deterministic:
-            attn_out = nn.Dropout(rate=cfg.hidden_dropout)(
-                attn_out, deterministic=deterministic
-            )
+            attn_out = ShardAwareDropout(
+                rate=cfg.hidden_dropout, axis_names=_hidden_dropout_axes(cfg)
+            )(attn_out, deterministic=deterministic)
         h = (residual.astype(rdtype) + attn_out.astype(rdtype)).astype(
             hidden_states.dtype
         )
@@ -379,9 +427,9 @@ class ParallelTransformerLayer(nn.Module):
         mlp_out = ParallelMLP(config=cfg, name="mlp")(ln2)
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
         if cfg.hidden_dropout > 0.0 and not deterministic:
-            mlp_out = nn.Dropout(rate=cfg.hidden_dropout)(
-                mlp_out, deterministic=deterministic
-            )
+            mlp_out = ShardAwareDropout(
+                rate=cfg.hidden_dropout, axis_names=_hidden_dropout_axes(cfg)
+            )(mlp_out, deterministic=deterministic)
         return (residual.astype(rdtype) + mlp_out.astype(rdtype)).astype(
             hidden_states.dtype
         )

@@ -210,3 +210,79 @@ class TestGPTWithCP:
 
         cp_losses = run(params, tokens, labels)
         np.testing.assert_allclose(cp_losses, ref_losses, rtol=2e-4, atol=2e-5)
+
+
+class TestRingBlockwise:
+    @pytest.mark.parametrize("block_size", [2, 4, 8])
+    def test_inner_blocking_matches(self, rng, block_size):
+        """block_size < s_local exercises the inner kv-block scan (the
+        O(s x block) memory path) — results must be block-size invariant."""
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, H, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, H, SEQ, D), jnp.float32)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(seq_spec(),) * 3,
+            out_specs=(seq_spec(),) * 3, check_vma=False,
+        )
+        def run(q, k, v):
+            def loss(q, k, v):
+                o = ring_attention(
+                    q, k, v, axis_name="cp", causal=True, block_size=block_size
+                )
+                l = jnp.sum(o**2)
+                return l + jax.lax.stop_gradient(jax.lax.psum(l, "cp") - l)
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        def ref(q, k, v):
+            return jnp.sum(full_reference(q, k, v, True) ** 2)
+
+        got = run(q, k, v)
+        want = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g, w, rtol=2e-3, atol=1e-4)
+
+
+class TestShardAwareDropout:
+    def test_masks_differ_across_cp_ranks(self, rng):
+        from apex_tpu.transformer.layer import ShardAwareDropout
+
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        mod = ShardAwareDropout(rate=0.5, axis_names=("cp",))
+        x = jnp.ones((4, 64))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P("cp"),
+            check_vma=False,
+        )
+        def run(x):
+            y = mod.apply({}, x, deterministic=False,
+                          rngs={"dropout": jax.random.PRNGKey(7)})
+            return y[None]
+
+        per_rank = run(x)  # (cp, 4, 64) — same input, same key, per-rank mask
+        masks = np.asarray(per_rank) != 0.0
+        assert not all(
+            np.array_equal(masks[0], masks[i]) for i in range(1, cp)
+        ), "cp ranks drew identical dropout masks"
+
+    def test_identity_without_axes(self, rng):
+        from apex_tpu.transformer.layer import ShardAwareDropout
+
+        mod = ShardAwareDropout(rate=0.5, axis_names=("cp",))
+        x = jnp.ones((8, 8))
+        # outside shard_map the unbound axis is skipped, not an error
+        y = mod.apply({}, x, deterministic=False,
+                      rngs={"dropout": jax.random.PRNGKey(0)})
+        assert y.shape == x.shape
+        z = mod.apply({}, x, deterministic=True)
+        np.testing.assert_array_equal(z, x)
