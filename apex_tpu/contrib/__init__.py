@@ -9,6 +9,8 @@ what XLA does to elementwise chains on TPU).
 from apex_tpu.contrib.focal_loss import focal_loss
 from apex_tpu.contrib.group_norm import GroupNorm, group_norm
 from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
+from apex_tpu.contrib import sparsity
 from apex_tpu.contrib.transducer import (
     TransducerJoint,
     TransducerLoss,
@@ -18,6 +20,9 @@ from apex_tpu.contrib.transducer import (
 from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
 
 __all__ = [
+    "EncdecMultiheadAttn",
+    "SelfMultiheadAttn",
+    "sparsity",
     "focal_loss",
     "GroupNorm",
     "group_norm",

@@ -46,8 +46,11 @@ def focal_loss(
     valid = (y[:, None] != -2) & (classes[None, :] < num_real_classes)
 
     if label_smoothing > 0.0:
-        t_pos = 1.0 - label_smoothing + label_smoothing / k_pad
-        t_neg = label_smoothing / k_pad
+        # each (anchor, class) cell is a BINARY problem, so the kernel
+        # smooths with K=2 (focal_loss_cuda_kernel.cu:29): t_pos = 1 - s/2,
+        # t_neg = s/2 — NOT 1/num_classes
+        t_pos = 1.0 - label_smoothing / 2.0
+        t_neg = label_smoothing / 2.0
     else:
         t_pos, t_neg = 1.0, 0.0
     t = jnp.where(is_pos, t_pos, t_neg)

@@ -1,0 +1,39 @@
+"""2:4 structured sparsity (ref: apex/contrib/sparsity — SURVEY.md §2.3)."""
+
+from apex_tpu.contrib.sparsity.asp import (
+    ASP,
+    compute_sparse_masks,
+    default_eligibility,
+    masked_update,
+    prune,
+)
+from apex_tpu.contrib.sparsity.permutation import (
+    apply_permutation,
+    invert_permutation,
+    permute_and_mask,
+    search_for_good_permutation,
+)
+from apex_tpu.contrib.sparsity.sparse_masklib import (
+    create_mask,
+    fill,
+    m4n2_1d,
+    m4n2_2d_best,
+    mn_1d_best,
+)
+
+__all__ = [
+    "ASP",
+    "compute_sparse_masks",
+    "default_eligibility",
+    "masked_update",
+    "prune",
+    "apply_permutation",
+    "invert_permutation",
+    "permute_and_mask",
+    "search_for_good_permutation",
+    "create_mask",
+    "fill",
+    "m4n2_1d",
+    "m4n2_2d_best",
+    "mn_1d_best",
+]

@@ -1,0 +1,144 @@
+"""ASP — automatic 2:4 structured sparsity over parameter pytrees.
+
+Reference parity: apex.contrib.sparsity.ASP (contrib/sparsity/asp.py:28):
+``init_model_for_pruning`` walks modules, allocates mask buffers per
+eligible weight; ``init_optimizer_for_pruning`` patches ``optimizer.step``
+to re-apply masks after every update (:197-211); ``compute_sparse_masks``
+fills the masks (:213); ``prune_trained_model`` is the one-shot recipe
+(:292).
+
+TPU design: the pytree IS the model surgery surface — masks are a pytree
+of the same structure (1-masks for ineligible leaves), pruning is one
+tree_map multiply, and the optimizer patch becomes an optax
+GradientTransformation wrapper whose update keeps parameters exactly on
+the masked subspace: u' = mask * u - (1 - mask) * p, so
+p + u' = mask * (p + u). Channel-permutation search (permutation.py) plugs
+in per-leaf before mask computation.
+
+Eligibility default mirrors the reference's whitelist spirit (Linear/Conv
+weights): floating-point leaves with ndim >= 2 whose reduction dim divides
+by 4 and with >= 32 elements per reduction row. Flax kernels are (in, out)
+so the reduction dim is axis -2 for 2-D leaves; conv kernels (H, W, I, O)
+are pruned along I (axis -2) as the reference prunes C*R*S.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def default_eligibility(path, leaf) -> bool:
+    """(ref: eligible_modules + shape checks, asp.py:18-26, :116-163)"""
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    names = [getattr(k, "key", str(k)) for k in path]
+    if names and names[-1] in ("bias", "scale"):
+        return False
+    red = leaf.shape[-2]
+    return red % 4 == 0 and red >= 32
+
+
+def compute_sparse_masks(
+    params: Any,
+    mask_calculator: str = "m4n2_1d",
+    eligibility: Callable = default_eligibility,
+    axis: int = -2,
+) -> Any:
+    """Mask pytree matching ``params`` (ones for ineligible leaves).
+    (ref: ASP.compute_sparse_masks, asp.py:213)"""
+
+    def one(path, leaf):
+        if eligibility(path, leaf):
+            return create_mask(leaf, mask_calculator, axis=axis)
+        return jnp.ones_like(leaf)
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def prune(params: Any, masks: Any) -> Any:
+    """params * masks (ref: the in-place p.data.mul_(mask) at :213-255)."""
+    return jax.tree_util.tree_map(jnp.multiply, params, masks)
+
+
+def masked_update(masks: Any) -> optax.GradientTransformation:
+    """Optax wrapper keeping params on the masked subspace.
+
+    (ref: ASP.init_optimizer_for_pruning patching optimizer.step, asp.py
+    :185-211.) Chain AFTER the optimizer:
+        optax.chain(optimizer, masked_update(masks)) — then
+        params := params + u' stays exactly masked, equivalent to the
+        reference's mask re-application after each step.
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("masked_update requires params")
+        new_updates = jax.tree_util.tree_map(
+            lambda u, p, m: m * u - (1.0 - m) * p, updates, params, masks
+        )
+        return new_updates, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class ASP:
+    """Stateful convenience mirroring the reference's class API
+    (asp.py:28). Functional users can call the module-level functions."""
+
+    def __init__(self):
+        self._masks = None
+        self._calculator = "m4n2_1d"
+        self._eligibility = default_eligibility
+
+    def init_model_for_pruning(
+        self,
+        params: Any,
+        mask_calculator: str = "m4n2_1d",
+        eligibility: Callable = None,
+    ) -> None:
+        """Allocate (all-ones) masks (ref asp.py:40: buffers are created at
+        init and filled later by compute_sparse_masks)."""
+        self._calculator = mask_calculator
+        if eligibility is not None:
+            self._eligibility = eligibility
+        self._masks = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    def compute_sparse_masks(self, params: Any) -> Any:
+        if self._masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        self._masks = compute_sparse_masks(
+            params, self._calculator, self._eligibility
+        )
+        return self._masks
+
+    def init_optimizer_for_pruning(
+        self, optimizer: optax.GradientTransformation
+    ) -> optax.GradientTransformation:
+        if self._masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        return optax.chain(optimizer, masked_update(self._masks))
+
+    def prune_trained_model(self, params: Any) -> Any:
+        """One-shot recipe (ref asp.py:292): compute masks + prune."""
+        if self._masks is None:
+            self.init_model_for_pruning(params)
+        self.compute_sparse_masks(params)
+        return prune(params, self._masks)
+
+    @property
+    def masks(self):
+        return self._masks
+
+    def is_sparsity_enabled(self) -> bool:
+        """(ref asp.py:271)"""
+        return self._masks is not None

@@ -1,0 +1,80 @@
+"""Channel-permutation search for accuracy-preserving 2:4 pruning.
+
+Reference parity: apex.contrib.sparsity.permutation_lib (~2.3k LoC + CUDA
+search kernels): permuting the input channels of a weight matrix before
+2:4 pruning can raise the retained magnitude substantially, and an inverse
+permutation on the previous layer keeps the network function unchanged.
+
+TPU design: the reference's exhaustive stripe-group search (with CUDA
+enumeration kernels) is replaced by a bounded greedy column-swap search in
+numpy — same objective (maximize total |w| retained by the 2:4 mask after
+permutation), deterministic, and fast enough at the channel counts that
+matter. The permutation is applied/undone with plain ``jnp.take``.
+"""
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.contrib.sparsity.sparse_masklib import mn_1d_best
+
+
+def _retained(matrix: np.ndarray) -> float:
+    """Total |w| kept by the best 2:4 mask along the last dim."""
+    a = np.abs(matrix).reshape(-1, 4)
+    # top-2 per group of 4
+    return float(np.sort(a, axis=1)[:, 2:].sum())
+
+
+def search_for_good_permutation(
+    matrix, max_iters: int = 1000, seed: int = 0
+) -> np.ndarray:
+    """Greedy column-swap search; returns a permutation of the columns.
+
+    ``matrix``: (rows, cols) with cols % 4 == 0; the permutation acts on
+    the pruned (last) dim. Starts from identity, repeatedly proposes
+    swapping two columns from different groups of 4 and accepts strict
+    improvements of the retained-|w| objective.
+    """
+    mat = np.asarray(matrix, dtype=np.float32)
+    rows, cols = mat.shape
+    if cols % 4 != 0:
+        raise ValueError(f"cols ({cols}) not divisible by 4")
+    perm = np.arange(cols)
+    cur = mat.copy()
+    best_score = _retained(cur)
+    rng = np.random.RandomState(seed)
+    for _ in range(max_iters):
+        i, j = rng.randint(0, cols, 2)
+        if i // 4 == j // 4:
+            continue
+        cand = cur.copy()
+        cand[:, [i, j]] = cand[:, [j, i]]
+        score = _retained(cand)
+        if score > best_score + 1e-9:
+            best_score = score
+            cur = cand
+            perm[[i, j]] = perm[[j, i]]
+    return perm
+
+
+def apply_permutation(tensor, perm, axis: int = -1):
+    return jnp.take(tensor, jnp.asarray(perm), axis=axis)
+
+
+def invert_permutation(perm) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[np.asarray(perm)] = np.arange(len(perm))
+    return inv
+
+
+def permute_and_mask(matrix, max_iters: int = 1000) -> Tuple[np.ndarray, jnp.ndarray]:
+    """Convenience: search a permutation, return (perm, mask in ORIGINAL
+    column order). masked = matrix * mask keeps the permuted-2:4 structure:
+    hardware sees 2:4 after applying ``perm`` to the columns."""
+    perm = search_for_good_permutation(matrix, max_iters=max_iters)
+    permuted = apply_permutation(jnp.asarray(matrix), perm, axis=-1)
+    mask_p = mn_1d_best(permuted, 4, 2)
+    mask = apply_permutation(mask_p, invert_permutation(perm), axis=-1)
+    return perm, mask

@@ -37,7 +37,8 @@ class TestFocalLoss:
                 p = float(logits[i, j])
                 sigma = 1.0 / (1.0 + np.exp(-p))
                 pos = y >= 0 and j == y
-                t = (1.0 - smoothing + smoothing / k) if pos else smoothing / k
+                # binary-cell smoothing with K=2 (focal_loss_cuda_kernel.cu:29)
+                t = (1.0 - smoothing / 2) if pos else smoothing / 2
                 bce = -t * np.log(sigma) - (1.0 - t) * np.log(1.0 - sigma)
                 w = alpha * (1 - sigma) ** gamma if pos else (1 - alpha) * sigma**gamma
                 total += w * bce

@@ -1,0 +1,141 @@
+"""ASP 2:4 sparsity tests (ref style: apex/contrib/test/sparsity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.contrib.sparsity import (
+    ASP,
+    apply_permutation,
+    compute_sparse_masks,
+    create_mask,
+    fill,
+    invert_permutation,
+    m4n2_1d,
+    m4n2_2d_best,
+    masked_update,
+    mn_1d_best,
+    permute_and_mask,
+    prune,
+    search_for_good_permutation,
+)
+
+
+class TestMaskLib:
+    def test_m4n2_keeps_top2_per_group(self, rng):
+        x = jax.random.normal(rng, (8, 16))
+        mask = m4n2_1d(x)
+        m = np.asarray(mask).reshape(-1, 4)
+        assert (m.sum(axis=1) == 2).all()
+        # kept entries are the 2 largest |x| per group
+        xs = np.abs(np.asarray(x)).reshape(-1, 4)
+        for g in range(xs.shape[0]):
+            kept = np.sort(xs[g][m[g] == 1])
+            dropped = np.sort(xs[g][m[g] == 0])
+            assert kept.min() >= dropped.max() - 1e-6
+
+    def test_mn_patterns_other_ratios(self, rng):
+        x = jax.random.normal(rng, (4, 8))
+        mask = mn_1d_best(x, 2, 1)
+        assert (np.asarray(mask).reshape(-1, 2).sum(axis=1) == 1).all()
+
+    def test_create_mask_axis(self, rng):
+        x = jax.random.normal(rng, (16, 8))
+        mask = create_mask(x, axis=0)  # prune along dim 0
+        assert (np.asarray(mask).T.reshape(-1, 4).sum(axis=1) == 2).all()
+        with pytest.raises(ValueError):
+            create_mask(x, pattern="nope")
+
+    def test_2d_best_is_valid_rowwise(self, rng):
+        x = jax.random.normal(rng, (16, 16))
+        mask = m4n2_2d_best(x)
+        assert (np.asarray(mask).reshape(-1, 4).sum(axis=1) == 2).all()
+
+    def test_fill(self):
+        assert fill(jnp.array([[1.0, 0.0], [0.0, 0.0]])) == 0.25
+
+
+class TestASP:
+    def make_params(self, rng):
+        return {
+            "dense": {"kernel": jax.random.normal(rng, (32, 16)),
+                      "bias": jnp.ones((16,))},
+            "norm": {"scale": jnp.ones((32,))},
+            "small": {"kernel": jax.random.normal(rng, (4, 4))},
+        }
+
+    def test_compute_masks_eligibility(self, rng):
+        params = self.make_params(rng)
+        masks = compute_sparse_masks(params)
+        # eligible: dense/kernel (reduction dim 32); others all-ones
+        k = np.asarray(masks["dense"]["kernel"])
+        assert (k.T.reshape(-1, 4).sum(axis=1) == 2).all()  # axis=-2
+        assert (np.asarray(masks["dense"]["bias"]) == 1).all()
+        assert (np.asarray(masks["norm"]["scale"]) == 1).all()
+        assert (np.asarray(masks["small"]["kernel"]) == 1).all()
+
+    def test_masked_update_preserves_sparsity(self, rng):
+        params = self.make_params(rng)
+        masks = compute_sparse_masks(params)
+        params = prune(params, masks)
+        opt = optax.chain(optax.adam(1e-2), masked_update(masks))
+        state = opt.init(params)
+
+        def loss_fn(p):
+            return jnp.sum(p["dense"]["kernel"] ** 2) + jnp.sum(
+                p["small"]["kernel"] ** 2
+            )
+
+        for _ in range(3):
+            grads = jax.grad(loss_fn)(params)
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+        k = np.asarray(params["dense"]["kernel"])
+        zero_pat = np.asarray(masks["dense"]["kernel"]) == 0
+        np.testing.assert_array_equal(k[zero_pat], 0.0)
+        # unmasked leaves keep training normally
+        assert np.abs(np.asarray(params["small"]["kernel"])).sum() > 0
+
+    def test_class_api_prune_trained_model(self, rng):
+        asp = ASP()
+        assert not asp.is_sparsity_enabled()
+        params = self.make_params(rng)
+        pruned = asp.prune_trained_model(params)
+        assert asp.is_sparsity_enabled()
+        k = np.asarray(pruned["dense"]["kernel"])
+        assert (np.abs(k).T.reshape(-1, 4) > 0).sum() <= 2 * (32 * 16 // 4)
+        opt = asp.init_optimizer_for_pruning(optax.sgd(0.1))
+        assert opt.init(pruned) is not None
+
+
+class TestPermutation:
+    def test_search_improves_adversarial_matrix(self):
+        # columns arranged so each group of 4 holds 4 equally-large values
+        # -> naive 2:4 drops half the magnitude; a permutation that spreads
+        # them across groups with the near-zero columns retains almost all
+        big = np.ones((8, 8)) * 10.0
+        small = np.ones((8, 8)) * 0.01
+        mat = np.concatenate([big, small], axis=1)  # groups 0,1 all-big
+
+        def retained(m, mask):
+            return float(np.sum(np.abs(m) * np.asarray(mask)))
+
+        naive = retained(mat, m4n2_1d(jnp.asarray(mat)))
+        perm, mask = permute_and_mask(mat, max_iters=2000)
+        permuted_kept = retained(mat, mask)
+        assert permuted_kept > naive * 1.5
+        # permutation is a bijection and inverts correctly
+        inv = invert_permutation(perm)
+        x = jnp.arange(16.0)
+        np.testing.assert_array_equal(
+            apply_permutation(apply_permutation(x, perm), inv), x
+        )
+
+    def test_mask_in_original_order_is_2to4_after_perm(self):
+        rngn = np.random.RandomState(0)
+        mat = rngn.randn(8, 16).astype(np.float32)
+        perm, mask = permute_and_mask(mat, max_iters=500)
+        permuted_mask = np.asarray(apply_permutation(mask, perm, axis=-1))
+        assert (permuted_mask.reshape(-1, 4).sum(axis=1) == 2).all()
