@@ -6,6 +6,11 @@ XLA-fused jnp composition (the fusion the CUDA kernel hand-codes is exactly
 what XLA does to elementwise chains on TPU).
 """
 
+from apex_tpu.contrib.bottleneck import (
+    Bottleneck,
+    SpatialBottleneck,
+    halo_exchange_1d,
+)
 from apex_tpu.contrib.focal_loss import focal_loss
 from apex_tpu.contrib.group_norm import GroupNorm, group_norm
 from apex_tpu.contrib.index_mul_2d import index_mul_2d
@@ -20,6 +25,9 @@ from apex_tpu.contrib.transducer import (
 from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
 
 __all__ = [
+    "Bottleneck",
+    "SpatialBottleneck",
+    "halo_exchange_1d",
     "EncdecMultiheadAttn",
     "SelfMultiheadAttn",
     "sparsity",

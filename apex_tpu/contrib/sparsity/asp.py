@@ -32,13 +32,19 @@ from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
 
 
 def default_eligibility(path, leaf) -> bool:
-    """(ref: eligible_modules + shape checks, asp.py:18-26, :116-163)"""
+    """(ref: eligible_modules whitelist of Linear/Conv, asp.py:18-26,
+    :116-163). Allowlist by leaf name: only GEMM kernels ('kernel' in flax,
+    'weight' for torch-style trees) are pruned — embeddings, biases, norm
+    scales, etc. are never touched, matching the reference's module
+    whitelist."""
     if not hasattr(leaf, "ndim") or leaf.ndim < 2:
         return False
     if not jnp.issubdtype(leaf.dtype, jnp.floating):
         return False
     names = [getattr(k, "key", str(k)) for k in path]
-    if names and names[-1] in ("bias", "scale"):
+    if not names or names[-1] not in ("kernel", "weight"):
+        return False
+    if any("embed" in str(n).lower() for n in names):
         return False
     red = leaf.shape[-2]
     return red % 4 == 0 and red >= 32
@@ -74,7 +80,15 @@ def masked_update(masks: Any) -> optax.GradientTransformation:
         optax.chain(optimizer, masked_update(masks)) — then
         params := params + u' stays exactly masked, equivalent to the
         reference's mask re-application after each step.
+
+    ``masks`` may be a pytree or a zero-arg callable returning one — the
+    callable form binds late, so the reference's documented call order
+    (init optimizer BEFORE computing masks, asp.py:53-55) works: the chain
+    reads whatever masks exist at update time.
     """
+
+    def get_masks():
+        return masks() if callable(masks) else masks
 
     def init_fn(params):
         del params
@@ -84,7 +98,7 @@ def masked_update(masks: Any) -> optax.GradientTransformation:
         if params is None:
             raise ValueError("masked_update requires params")
         new_updates = jax.tree_util.tree_map(
-            lambda u, p, m: m * u - (1.0 - m) * p, updates, params, masks
+            lambda u, p, m: m * u - (1.0 - m) * p, updates, params, get_masks()
         )
         return new_updates, state
 
@@ -126,7 +140,9 @@ class ASP:
     ) -> optax.GradientTransformation:
         if self._masks is None:
             raise RuntimeError("call init_model_for_pruning first")
-        return optax.chain(optimizer, masked_update(self._masks))
+        # late-bound: masks computed AFTER this call (the reference's
+        # documented order) are picked up at update time
+        return optax.chain(optimizer, masked_update(lambda: self._masks))
 
     def prune_trained_model(self, params: Any) -> Any:
         """One-shot recipe (ref asp.py:292): compute masks + prune."""

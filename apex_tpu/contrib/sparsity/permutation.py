@@ -27,6 +27,12 @@ def _retained(matrix: np.ndarray) -> float:
     return float(np.sort(a, axis=1)[:, 2:].sum())
 
 
+def _group_score(mat: np.ndarray, g: int) -> float:
+    """Retained |w| of column group g (columns 4g..4g+3)."""
+    a = np.abs(mat[:, 4 * g : 4 * g + 4])
+    return float(np.sort(a, axis=1)[:, 2:].sum())
+
+
 def search_for_good_permutation(
     matrix, max_iters: int = 1000, seed: int = 0
 ) -> np.ndarray:
@@ -35,27 +41,29 @@ def search_for_good_permutation(
     ``matrix``: (rows, cols) with cols % 4 == 0; the permutation acts on
     the pruned (last) dim. Starts from identity, repeatedly proposes
     swapping two columns from different groups of 4 and accepts strict
-    improvements of the retained-|w| objective.
+    improvements of the retained-|w| objective. A swap only changes its
+    two groups, so scoring is incremental: O(rows x 8) per proposal, with
+    in-place column swaps — not a full-matrix rescore.
     """
-    mat = np.asarray(matrix, dtype=np.float32)
+    mat = np.array(matrix, dtype=np.float32, copy=True)
     rows, cols = mat.shape
     if cols % 4 != 0:
         raise ValueError(f"cols ({cols}) not divisible by 4")
     perm = np.arange(cols)
-    cur = mat.copy()
-    best_score = _retained(cur)
+    group_scores = np.array([_group_score(mat, g) for g in range(cols // 4)])
     rng = np.random.RandomState(seed)
     for _ in range(max_iters):
         i, j = rng.randint(0, cols, 2)
-        if i // 4 == j // 4:
+        gi, gj = i // 4, j // 4
+        if gi == gj:
             continue
-        cand = cur.copy()
-        cand[:, [i, j]] = cand[:, [j, i]]
-        score = _retained(cand)
-        if score > best_score + 1e-9:
-            best_score = score
-            cur = cand
+        mat[:, [i, j]] = mat[:, [j, i]]
+        si, sj = _group_score(mat, gi), _group_score(mat, gj)
+        if si + sj > group_scores[gi] + group_scores[gj] + 1e-9:
+            group_scores[gi], group_scores[gj] = si, sj
             perm[[i, j]] = perm[[j, i]]
+        else:
+            mat[:, [i, j]] = mat[:, [j, i]]  # revert
     return perm
 
 

@@ -1,0 +1,35 @@
+"""Legacy manual mixed-precision API.
+
+Reference parity: apex/fp16_utils — the pre-amp manual workflow
+(fp16util.py:35-177 conversion helpers, loss_scaler.py:10,58 scalers,
+fp16_optimizer.py:13 FP16_Optimizer). Kept for API-surface parity; new code
+should use ``apex_tpu.amp``. Torch modules become parameter pytrees, so the
+"model surgery" helpers become tree casts.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (
+    BN_convert_float,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+    tofp16,
+)
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer
+
+__all__ = [
+    "BN_convert_float",
+    "convert_network",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "to_python_float",
+    "tofp16",
+    "DynamicLossScaler",
+    "LossScaler",
+    "FP16_Optimizer",
+]

@@ -1,0 +1,59 @@
+"""FP16_Optimizer — legacy master-weight wrapper.
+
+Reference parity: fp16_utils/fp16_optimizer.py:13 (step :275, backward
+:376, update_master_grads :439): wraps any optimizer with fp32 master
+params, (dynamic) loss scaling and overflow skip-steps. Implemented as a
+thin legacy facade over ``apex_tpu.amp.AmpOptimizer`` with an O2-style
+fp16 policy — one shared mixed-precision engine underneath.
+
+The torch control flow (``optimizer.backward(loss)`` mutating ``.grad``)
+becomes the functional equivalent: ``scale_loss`` before ``jax.grad`` and
+``step(grads, state, params)`` after.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.optimizer import AmpOptimizer
+from apex_tpu.amp.policy import O2
+
+
+class FP16_Optimizer:
+    def __init__(
+        self,
+        tx: optax.GradientTransformation,
+        static_loss_scale: float = 1.0,
+        dynamic_loss_scale: bool = False,
+        verbose: bool = False,
+    ):
+        policy = dataclasses.replace(
+            O2(half_dtype=jnp.float16),
+            loss_scale="dynamic" if dynamic_loss_scale else float(static_loss_scale),
+        )
+        self._amp = AmpOptimizer(tx, policy)
+        self.verbose = verbose
+
+    def init(self, params) -> Any:
+        return self._amp.init(params)
+
+    def scale_loss(self, loss, state):
+        """(ref: backward :376 — loss.float() * loss_scale)"""
+        return self._amp.scale_loss(loss, state)
+
+    def step(self, grads, state, params):
+        """Unscale master grads, skip on overflow, update, recast
+        (ref: step :275 + update_master_grads :439)."""
+        return self._amp.step(grads, state, params)
+
+    @property
+    def loss_scale(self):
+        return self._amp.scaler
+
+    def state_dict(self, state) -> dict:
+        return self._amp.state_dict(state)
+
+    def load_state_dict(self, state, d: dict):
+        return self._amp.load_state_dict(state, d)

@@ -1,0 +1,72 @@
+"""Pytree analogues of the reference's model-conversion helpers
+(ref: fp16_utils/fp16util.py:35-177)."""
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import default_keep_fp32_predicate
+from apex_tpu.utils.pytree import tree_cast, tree_map_with_path
+
+
+def tofp16(params: Any) -> Any:
+    """Cast every float leaf to fp16 (ref: tofp16 module wrapper, :35)."""
+    return tree_cast(params, jnp.float16)
+
+
+def BN_convert_float(params: Any) -> Any:
+    """Restore norm-layer leaves to fp32 (ref: BN_convert_float :44 — BN
+    stays fp32 for stability). Norm leaves are identified by path, like
+    amp's keep_batchnorm_fp32."""
+
+    def _c(path, x):
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact) and (
+            default_keep_fp32_predicate(path)
+        ):
+            return jnp.asarray(x).astype(jnp.float32)
+        return x
+
+    return tree_map_with_path(_c, params)
+
+
+def network_to_half(params: Any) -> Any:
+    """fp16 everywhere except norm layers (ref: network_to_half :60)."""
+    return BN_convert_float(tofp16(params))
+
+
+def convert_network(params: Any, dtype) -> Any:
+    """Like network_to_half with an arbitrary dtype (ref: convert_network
+    :71 — used by amp O2 with keep-BN-fp32)."""
+    def _c(path, x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.inexact):
+            return x
+        if default_keep_fp32_predicate(path):
+            return x.astype(jnp.float32)
+        return x.astype(dtype)
+
+    return tree_map_with_path(_c, params)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params, fp32 master copy) (ref: prep_param_lists :93 —
+    flattens to a master fp32 copy for the optimizer)."""
+    return params, tree_cast(params, jnp.float32)
+
+
+def master_params_to_model_params(model_params: Any, master_params: Any) -> Any:
+    """Copy master values back in model dtypes (ref :146)."""
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(jnp.asarray(p).dtype), master_params, model_params
+    )
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """fp16 grads -> fp32 master grads (ref :131)."""
+    return tree_cast(model_grads, jnp.float32)
+
+
+def to_python_float(t) -> float:
+    """(ref :177)"""
+    return float(jnp.asarray(t).reshape(()))

@@ -1,0 +1,36 @@
+"""Fused-cell RNNs (LSTM/GRU/ReLU/Tanh/mLSTM).
+
+Reference parity: apex/RNN (RNN/__init__.py:1 exports LSTM, GRU, ReLU,
+Tanh, mLSTM; models.py/cells.py/RNNBackend.py, 508 LoC) — apex's legacy
+"fused cell" RNN API whose point was one big gate GEMM per step instead of
+four.
+
+TPU design: each cell computes all gates in a single (x·Wi + h·Wh) matmul
+pair (the fusion the reference hand-rolls — MXU-shaped by construction),
+and the time loop is ``lax.scan`` (XLA compiles it once; no per-step
+dispatch). Stacked layers, inter-layer dropout, and bidirectional
+concatenation mirror the RNNBackend feature set.
+"""
+
+from apex_tpu.rnn.cells import (
+    GRUCell,
+    LSTMCell,
+    RNNReLUCell,
+    RNNTanhCell,
+    mLSTMCell,
+)
+from apex_tpu.rnn.models import GRU, LSTM, RNN, ReLU, Tanh, mLSTM
+
+__all__ = [
+    "GRUCell",
+    "LSTMCell",
+    "RNNReLUCell",
+    "RNNTanhCell",
+    "mLSTMCell",
+    "GRU",
+    "LSTM",
+    "RNN",
+    "ReLU",
+    "Tanh",
+    "mLSTM",
+]

@@ -1,0 +1,188 @@
+"""RNN cells with fused gate GEMMs (ref: apex/RNN/cells.py, RNNBackend.py).
+
+Every cell is a flax module with ``(carry, x) -> (carry, y)`` signature
+(scan-compatible). Gates are computed as ONE input GEMM + ONE hidden GEMM
+(the reference's "fused" formulation); nonlinearity math runs in fp32.
+"""
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _dense(x, kernel, bias=None):
+    y = jax.lax.dot_general(
+        x, kernel.astype(x.dtype), (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y  # fp32
+
+
+class LSTMCell(nn.Module):
+    """(ref: RNNBackend's LSTM cell; gate order i, f, g, o)."""
+
+    hidden_size: int
+    use_bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry: Tuple[Any, Any], x):
+        h, c = carry
+        hs = self.hidden_size
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (x.shape[-1], 4 * hs),
+            self.params_dtype,
+        )
+        wh = self.param(
+            "wh", nn.initializers.orthogonal(), (hs, 4 * hs), self.params_dtype
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros_init(), (4 * hs,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        gates = _dense(x, wi, b) + _dense(h, wh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        cf = c.astype(jnp.float32)
+        new_c = jax.nn.sigmoid(f) * cf + jax.nn.sigmoid(i) * jnp.tanh(g)
+        new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+        new_h = new_h.astype(x.dtype)
+        return (new_h, new_c.astype(c.dtype)), new_h
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return (jnp.zeros((batch, hidden), dtype), jnp.zeros((batch, hidden), dtype))
+
+
+class mLSTMCell(nn.Module):
+    """Multiplicative LSTM (ref: apex/RNN mLSTM: m = (x·Wmx) * (h·Wmh)
+    replaces h in the gate computation)."""
+
+    hidden_size: int
+    use_bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        h, c = carry
+        hs = self.hidden_size
+        wmx = self.param(
+            "wmx", nn.initializers.lecun_normal(), (x.shape[-1], hs),
+            self.params_dtype,
+        )
+        wmh = self.param(
+            "wmh", nn.initializers.orthogonal(), (hs, hs), self.params_dtype
+        )
+        m = (_dense(x, wmx) * _dense(h, wmh)).astype(x.dtype)
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (x.shape[-1], 4 * hs),
+            self.params_dtype,
+        )
+        wh = self.param(
+            "wh", nn.initializers.orthogonal(), (hs, 4 * hs), self.params_dtype
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros_init(), (4 * hs,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        gates = _dense(x, wi, b) + _dense(m, wh)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        cf = c.astype(jnp.float32)
+        new_c = jax.nn.sigmoid(f) * cf + jax.nn.sigmoid(i) * jnp.tanh(g)
+        new_h = (jax.nn.sigmoid(o) * jnp.tanh(new_c)).astype(x.dtype)
+        return (new_h, new_c.astype(c.dtype)), new_h
+
+    init_carry = staticmethod(LSTMCell.init_carry)
+
+
+class GRUCell(nn.Module):
+    """(gate order r, z, n — torch convention)."""
+
+    hidden_size: int
+    use_bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        hs = self.hidden_size
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (x.shape[-1], 3 * hs),
+            self.params_dtype,
+        )
+        wh = self.param(
+            "wh", nn.initializers.orthogonal(), (hs, 3 * hs), self.params_dtype
+        )
+        bi = (
+            self.param("bi", nn.initializers.zeros_init(), (3 * hs,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        bh = (
+            self.param("bh", nn.initializers.zeros_init(), (3 * hs,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        gi = _dense(x, wi, bi)
+        gh = _dense(h, wh, bh)
+        ir, iz, inn = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn)
+        new_h = ((1.0 - z) * n + z * h.astype(jnp.float32)).astype(x.dtype)
+        return (new_h,), new_h
+
+    @staticmethod
+    def init_carry(batch, hidden, dtype=jnp.float32):
+        return (jnp.zeros((batch, hidden), dtype),)
+
+
+class _ElementwiseCell(nn.Module):
+    hidden_size: int
+    use_bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    def _act(self, x):
+        raise NotImplementedError
+
+    @nn.compact
+    def __call__(self, carry, x):
+        (h,) = carry
+        hs = self.hidden_size
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(), (x.shape[-1], hs),
+            self.params_dtype,
+        )
+        wh = self.param(
+            "wh", nn.initializers.orthogonal(), (hs, hs), self.params_dtype
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros_init(), (hs,),
+                       self.params_dtype)
+            if self.use_bias
+            else None
+        )
+        new_h = self._act(_dense(x, wi, b) + _dense(h, wh)).astype(x.dtype)
+        return (new_h,), new_h
+
+    init_carry = staticmethod(GRUCell.init_carry)
+
+
+class RNNReLUCell(_ElementwiseCell):
+    def _act(self, x):
+        return jax.nn.relu(x)
+
+
+class RNNTanhCell(_ElementwiseCell):
+    def _act(self, x):
+        return jnp.tanh(x)

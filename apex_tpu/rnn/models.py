@@ -1,0 +1,136 @@
+"""Stacked/bidirectional RNN driver (ref: apex/RNN/models.py + RNNBackend).
+
+``RNN`` scans a cell over time with ``nn.scan`` (params shared across
+steps, compiled once), stacks layers with optional inter-layer dropout,
+and supports bidirectional concatenation — the RNNBackend feature set.
+Inputs are (seq, batch, features) like the reference (bRNN/RNNBackend
+default layout).
+"""
+
+from typing import Optional, Type
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.rnn.cells import (
+    GRUCell,
+    LSTMCell,
+    RNNReLUCell,
+    RNNTanhCell,
+    mLSTMCell,
+)
+
+
+class _ScannedCell(nn.Module):
+    cell_cls: Type[nn.Module]
+    hidden_size: int
+    use_bias: bool
+    params_dtype: jnp.dtype
+    reverse: bool = False
+
+    @nn.compact
+    def __call__(self, xs, carry=None):
+        # xs: (seq, batch, feat)
+        if carry is None:
+            carry = self.cell_cls.init_carry(
+                xs.shape[1], self.hidden_size, xs.dtype
+            )
+        scan = nn.scan(
+            self.cell_cls,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+            reverse=self.reverse,
+        )
+        cell = scan(
+            hidden_size=self.hidden_size,
+            use_bias=self.use_bias,
+            params_dtype=self.params_dtype,
+            name="cell",
+        )
+        final_carry, ys = cell(carry, xs)
+        return ys, final_carry
+
+
+class RNN(nn.Module):
+    """(ref: RNNBackend.RNNBase semantics)."""
+
+    cell_cls: Type[nn.Module]
+    hidden_size: int
+    num_layers: int = 1
+    bidirectional: bool = False
+    dropout: float = 0.0
+    use_bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, deterministic: bool = True):
+        h = xs
+        finals = []
+        for layer in range(self.num_layers):
+            fwd, carry_f = _ScannedCell(
+                self.cell_cls, self.hidden_size, self.use_bias,
+                self.params_dtype, name=f"layer{layer}",
+            )(h)
+            if self.bidirectional:
+                bwd, carry_b = _ScannedCell(
+                    self.cell_cls, self.hidden_size, self.use_bias,
+                    self.params_dtype, reverse=True,
+                    name=f"layer{layer}_reverse",
+                )(h)
+                h = jnp.concatenate([fwd, bwd], axis=-1)
+                finals.append((carry_f, carry_b))
+            else:
+                h = fwd
+                finals.append(carry_f)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                h = nn.Dropout(rate=self.dropout)(h, deterministic=deterministic)
+        return h, finals
+
+
+def LSTM(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0,
+         bidirectional=False, **kw):
+    """(ref: RNN/models.py LSTM factory — input_size accepted for signature
+    parity; flax infers it from the input.)"""
+    del input_size
+    return RNN(
+        cell_cls=LSTMCell, hidden_size=hidden_size, num_layers=num_layers,
+        use_bias=bias, dropout=dropout, bidirectional=bidirectional, **kw,
+    )
+
+
+def GRU(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0,
+        bidirectional=False, **kw):
+    del input_size
+    return RNN(
+        cell_cls=GRUCell, hidden_size=hidden_size, num_layers=num_layers,
+        use_bias=bias, dropout=dropout, bidirectional=bidirectional, **kw,
+    )
+
+
+def ReLU(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0,
+         bidirectional=False, **kw):
+    del input_size
+    return RNN(
+        cell_cls=RNNReLUCell, hidden_size=hidden_size, num_layers=num_layers,
+        use_bias=bias, dropout=dropout, bidirectional=bidirectional, **kw,
+    )
+
+
+def Tanh(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0,
+         bidirectional=False, **kw):
+    del input_size
+    return RNN(
+        cell_cls=RNNTanhCell, hidden_size=hidden_size, num_layers=num_layers,
+        use_bias=bias, dropout=dropout, bidirectional=bidirectional, **kw,
+    )
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, bias=True, dropout=0.0, **kw):
+    del input_size
+    return RNN(
+        cell_cls=mLSTMCell, hidden_size=hidden_size, num_layers=num_layers,
+        use_bias=bias, dropout=dropout, **kw,
+    )

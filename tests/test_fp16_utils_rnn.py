@@ -1,0 +1,157 @@
+"""fp16_utils legacy API + RNN tests (ref: tests/L0/run_fp16util, apex/RNN)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    DynamicLossScaler,
+    LossScaler,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+)
+from apex_tpu.rnn import GRU, LSTM, RNN, LSTMCell, ReLU, Tanh, mLSTM
+
+
+class TestFP16Util:
+    def params(self):
+        return {
+            "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.zeros((4,))},
+            "batch_norm": {"scale": jnp.ones((4,)), "mean": jnp.zeros((4,))},
+            "step": jnp.asarray(3, jnp.int32),
+        }
+
+    def test_network_to_half_keeps_norm_fp32(self):
+        half = network_to_half(self.params())
+        assert half["dense"]["kernel"].dtype == jnp.float16
+        assert half["batch_norm"]["scale"].dtype == jnp.float32
+        assert half["step"].dtype == jnp.int32  # non-float untouched
+
+    def test_convert_network_bf16(self):
+        conv = convert_network(self.params(), jnp.bfloat16)
+        assert conv["dense"]["kernel"].dtype == jnp.bfloat16
+        assert conv["batch_norm"]["scale"].dtype == jnp.float32
+
+    def test_master_model_round_trip(self):
+        model = network_to_half(self.params())
+        model_p, master = prep_param_lists(model)
+        assert master["dense"]["kernel"].dtype == jnp.float32
+        back = master_params_to_model_params(model_p, master)
+        assert back["dense"]["kernel"].dtype == jnp.float16
+        grads = model_grads_to_master_grads(model)
+        assert grads["dense"]["kernel"].dtype == jnp.float32
+        assert to_python_float(jnp.asarray([2.5])) == 2.5
+
+
+class TestLegacyScalers:
+    def test_static(self):
+        s = LossScaler(128.0)
+        assert s.loss_scale == 128.0
+        assert not s.has_overflow({"g": jnp.array([jnp.inf])})
+        s.update_scale(True)
+        assert s.loss_scale == 128.0
+
+    def test_dynamic_schedule(self):
+        s = DynamicLossScaler(init_scale=2.0**8, scale_window=4)
+        assert s.has_overflow({"g": jnp.array([jnp.nan])})
+        assert not s.has_overflow({"g": jnp.array([1.0])})
+        s.update_scale(True)
+        assert s.cur_scale == 2.0**7
+        for _ in range(4):
+            s.update_scale(False)
+        assert s.cur_scale == 2.0**8
+        d = s.state_dict()
+        s2 = DynamicLossScaler()
+        s2.load_state_dict(d)
+        assert s2.cur_scale == s.cur_scale and s2.cur_iter == s.cur_iter
+
+
+class TestFP16Optimizer:
+    def test_trains_and_skips_overflow(self, rng):
+        params = {"w": jax.random.normal(rng, (8, 8), jnp.float16)}
+        opt = FP16_Optimizer(optax.sgd(0.1), dynamic_loss_scale=True)
+        state = opt.init(params)
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 8), jnp.float16)
+
+        def loss_fn(p):
+            return jnp.mean((x @ p["w"]) ** 2)
+
+        losses = []
+        for _ in range(5):
+            scaled = lambda p: opt.scale_loss(loss_fn(p), state)  # noqa: E731
+            grads = jax.grad(scaled)(params)
+            params, state, info = opt.step(grads, state, params)
+            losses.append(float(loss_fn(params)))
+            assert params["w"].dtype == jnp.float16
+        assert losses[-1] < losses[0]
+        # forced overflow skips the step
+        bad = {"w": jnp.full((8, 8), jnp.inf, jnp.float16)}
+        before = params["w"].copy()
+        params, state, info = opt.step(bad, state, params)
+        assert bool(info["found_inf"])
+        np.testing.assert_array_equal(params["w"], before)
+
+
+class TestRNN:
+    def naive_lstm(self, params, xs):
+        wi = np.asarray(params["wi"], np.float32)
+        wh = np.asarray(params["wh"], np.float32)
+        b = np.asarray(params["bias"], np.float32)
+        hsz = wh.shape[0]
+        h = np.zeros((xs.shape[1], hsz), np.float32)
+        c = np.zeros_like(h)
+        sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+        out = []
+        for t in range(xs.shape[0]):
+            gates = np.asarray(xs[t], np.float32) @ wi + h @ wh + b
+            i, f, g, o = np.split(gates, 4, axis=-1)
+            c = sig(f) * c + sig(i) * np.tanh(g)
+            h = sig(o) * np.tanh(c)
+            out.append(h)
+        return np.stack(out)
+
+    def test_lstm_matches_naive(self, rng):
+        xs = jax.random.normal(rng, (6, 2, 4), jnp.float32)
+        mod = LSTM(4, 8)
+        variables = mod.init(rng, xs)
+        ys, finals = mod.apply(variables, xs)
+        cell_params = variables["params"]["layer0"]["cell"]
+        want = self.naive_lstm(cell_params, np.asarray(xs))
+        np.testing.assert_allclose(ys, want, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(finals[0][0], want[-1], rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("factory", [GRU, ReLU, Tanh, mLSTM])
+    def test_variants_shapes_and_grads(self, rng, factory):
+        xs = jax.random.normal(rng, (5, 2, 4), jnp.float32)
+        mod = factory(4, 8, num_layers=2)
+        variables = mod.init(rng, xs)
+        ys, _ = mod.apply(variables, xs)
+        assert ys.shape == (5, 2, 8)
+        g = jax.grad(
+            lambda v: jnp.sum(mod.apply(v, xs)[0] ** 2)
+        )(variables)
+        flat = jax.tree_util.tree_leaves(g)
+        assert all(bool(jnp.all(jnp.isfinite(x))) for x in flat)
+        assert any(float(jnp.abs(x).sum()) > 0 for x in flat)
+
+    def test_bidirectional(self, rng):
+        xs = jax.random.normal(rng, (5, 2, 4), jnp.float32)
+        mod = LSTM(4, 8, bidirectional=True)
+        variables = mod.init(rng, xs)
+        ys, _ = mod.apply(variables, xs)
+        assert ys.shape == (5, 2, 16)
+        # reverse half equals running the net on time-reversed input
+        fwd_half = np.asarray(ys)[..., :8]
+        mod_uni = LSTM(4, 8)
+        uni_vars = {
+            "params": {"layer0": variables["params"]["layer0"]}
+        }
+        ys_uni, _ = mod_uni.apply(uni_vars, xs)
+        np.testing.assert_allclose(fwd_half, ys_uni, rtol=1e-5, atol=1e-6)

@@ -139,3 +139,34 @@ class TestPermutation:
         perm, mask = permute_and_mask(mat, max_iters=500)
         permuted_mask = np.asarray(apply_permutation(mask, perm, axis=-1))
         assert (permuted_mask.reshape(-1, 4).sum(axis=1) == 2).all()
+
+
+class TestASPRegression:
+    def test_late_bound_masks_reference_call_order(self, rng):
+        """Reference order: init model -> init optimizer -> compute masks
+        (asp.py:53-55) — the chain must see the masks computed LATER."""
+        params = {"dense": {"kernel": jax.random.normal(rng, (32, 16))}}
+        asp = ASP()
+        asp.init_model_for_pruning(params)
+        opt = asp.init_optimizer_for_pruning(optax.sgd(0.1))
+        asp.compute_sparse_masks(params)  # after optimizer creation
+        params = prune(params, asp.masks)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+        k = np.asarray(params["dense"]["kernel"])
+        zero_pat = np.asarray(asp.masks["dense"]["kernel"]) == 0
+        np.testing.assert_array_equal(k[zero_pat], 0.0)
+
+    def test_embeddings_never_pruned(self, rng):
+        params = {
+            "embedding": {"embedding": jax.random.normal(rng, (64, 32))},
+            "embed_tokens": {"weight": jax.random.normal(rng, (64, 32))},
+            "proj": {"kernel": jax.random.normal(rng, (64, 32))},
+        }
+        masks = compute_sparse_masks(params)
+        assert (np.asarray(masks["embedding"]["embedding"]) == 1).all()
+        assert (np.asarray(masks["embed_tokens"]["weight"]) == 1).all()
+        k = np.asarray(masks["proj"]["kernel"])
+        assert (k.T.reshape(-1, 4).sum(axis=1) == 2).all()
