@@ -1,0 +1,8 @@
+"""Data utilities (ref: apex/transformer/_data)."""
+
+from apex_tpu.data.batchsampler import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+__all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]

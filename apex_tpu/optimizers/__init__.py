@@ -27,6 +27,10 @@ from apex_tpu.optimizers.distributed_fused_adam import (
     distributed_fused_adam,
     DistributedFusedAdam,
 )
+from apex_tpu.optimizers.distributed_fused_lamb import (
+    distributed_fused_lamb,
+    DistributedFusedLAMB,
+)
 
 __all__ = [
     "fused_adam",
@@ -45,4 +49,6 @@ __all__ = [
     "clip_grad_norm",
     "distributed_fused_adam",
     "DistributedFusedAdam",
+    "distributed_fused_lamb",
+    "DistributedFusedLAMB",
 ]

@@ -47,6 +47,38 @@ def _padded_flatten(tree, axis_size):
     return flat, spec
 
 
+def zero_init_master_shard(params, axis_name: str, axis_size: int):
+    """Shared ZeRO init: flatten+pad params, keep this rank's fp32 shard.
+    Returns (master_shard, shard_len)."""
+    flat, _ = _padded_flatten(params, axis_size)
+    shard = flat.shape[0] // axis_size
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice(flat, (idx * shard,), (shard,)), shard
+
+
+def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
+    """Shared ZeRO grad reduce-scatter. Returns (grad_shard, spec)."""
+    gflat, spec = _padded_flatten(grads, axis_size)
+    gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
+    if average:
+        gshard = gshard / axis_size
+    return gshard, spec
+
+
+def zero_gather_updates(new_master, params, spec, axis_name: str):
+    """Shared ZeRO epilogue: all-gather the updated master shard and return
+    optax-style updates (new - old) in the params' dtypes."""
+    new_flat = jax.lax.all_gather(new_master, axis_name, tiled=True)
+    new_params = unflatten_pytree(new_flat, spec_like(spec, params), cast_back=True)
+    return jax.tree_util.tree_map(
+        lambda n, o: (
+            n.astype(jnp.float32) - o.astype(jnp.float32)
+        ).astype(o.dtype),
+        new_params,
+        params,
+    )
+
+
 def distributed_fused_adam(
     lr: float = 1e-3,
     bias_correction: bool = True,
@@ -70,10 +102,7 @@ def distributed_fused_adam(
         axis_size = parallel_state.get_data_parallel_world_size()
 
     def init_fn(params):
-        flat, _ = _padded_flatten(params, axis_size)
-        shard = flat.shape[0] // axis_size
-        idx = jax.lax.axis_index(axis_name)
-        master = jax.lax.dynamic_slice(flat, (idx * shard,), (shard,))
+        master, shard = zero_init_master_shard(params, axis_name, axis_size)
         return DistributedFusedAdamState(
             step=jnp.zeros((), jnp.int32),
             master_shard=master,
@@ -84,11 +113,7 @@ def distributed_fused_adam(
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("distributed_fused_adam requires params")
-        gflat, spec = _padded_flatten(grads, axis_size)
-        # ZeRO grad reduce-scatter: each device keeps the summed shard it owns
-        gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
-        if average_grads:
-            gshard = gshard / axis_size
+        gshard, spec = zero_scatter_grads(grads, axis_name, axis_size, average_grads)
 
         step = state.step + 1
         stepf = step.astype(jnp.float32)
@@ -107,13 +132,7 @@ def distributed_fused_adam(
         new_master = p - lr * upd
 
         # ZeRO param all-gather
-        new_flat = jax.lax.all_gather(new_master, axis_name, tiled=True)
-        new_params = unflatten_pytree(new_flat, spec_like(spec, params), cast_back=True)
-        updates = jax.tree_util.tree_map(
-            lambda n, o: (n.astype(jnp.float32) - o.astype(jnp.float32)).astype(o.dtype),
-            new_params,
-            params,
-        )
+        updates = zero_gather_updates(new_master, params, spec, axis_name)
         new_state = DistributedFusedAdamState(
             step=step, master_shard=new_master, exp_avg=m, exp_avg_sq=v
         )

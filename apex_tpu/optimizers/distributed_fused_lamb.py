@@ -1,0 +1,175 @@
+"""Distributed (ZeRO) fused LAMB.
+
+Reference parity: apex.contrib.optimizers.DistributedFusedLAMB
+(contrib/optimizers/distributed_fused_lamb.py:24 — ~1k lines of sharded
+full-pipeline fusion: reduce-scatter grads, sharded Adam moments,
+clip-after-allreduce, per-tensor trust ratios, NCCL all-gather of params).
+
+TPU design: same skeleton as distributed_fused_adam (psum_scatter →
+local math on the 1/N state shard → all_gather), with the LAMB-specific
+twist that trust ratios are PER TENSOR while the state lives in one flat
+shard. Per-leaf ||p|| and ||update|| are computed with a segment-sum over
+the local shard (each flat position carries its leaf id) followed by one
+``psum`` — so the 3k-line fragment bookkeeping of the reference becomes a
+static segment-id array. Math matches apex's multi_tensor_lamb exactly
+(see fused_lamb.py): global grad-norm clip, Adam moments with bias
+correction, decoupled weight decay, trust ratio ||p||/||update||.
+"""
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from apex_tpu.ops.multi_tensor import FlatSpec
+from apex_tpu.optimizers.distributed_fused_adam import (
+    zero_gather_updates,
+    zero_init_master_shard,
+    zero_scatter_grads,
+)
+
+
+class DistributedFusedLAMBState(NamedTuple):
+    step: jax.Array
+    master_shard: jax.Array  # fp32 params shard
+    exp_avg: jax.Array
+    exp_avg_sq: jax.Array
+
+
+def _segment_ids(spec: FlatSpec) -> np.ndarray:
+    """Flat position -> leaf index; padding -> num_leaves (host-side,
+    static — the TPU replacement for the reference's ParameterFragment
+    bookkeeping, distributed_fused_adam.py:370)."""
+    ids = np.full((spec.padded_total,), spec.num_leaves, np.int32)
+    for i, (off, shape) in enumerate(zip(spec.offsets, spec.shapes)):
+        n = int(np.prod(shape)) if shape else 1
+        ids[off : off + n] = i
+    return ids
+
+
+def distributed_fused_lamb(
+    lr: float = 1e-3,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    max_grad_norm: float = 1.0,
+    use_nvlamb: bool = False,
+    axis_name: str = "dp",
+    axis_size: int = None,
+    average_grads: bool = True,
+) -> optax.GradientTransformation:
+    """ZeRO LAMB over the ``axis_name`` mesh axis; use inside shard_map."""
+    beta1, beta2 = betas
+    if axis_size is None:
+        from apex_tpu.parallel import parallel_state
+
+        axis_size = parallel_state.get_data_parallel_world_size()
+
+    def init_fn(params):
+        master, shard = zero_init_master_shard(params, axis_name, axis_size)
+        return DistributedFusedLAMBState(
+            step=jnp.zeros((), jnp.int32),
+            master_shard=master,
+            exp_avg=jnp.zeros((shard,), jnp.float32),
+            exp_avg_sq=jnp.zeros((shard,), jnp.float32),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("distributed_fused_lamb requires params")
+        gshard, spec = zero_scatter_grads(grads, axis_name, axis_size, average_grads)
+        shard = gshard.shape[0]
+
+        # local shard's segment ids (static slice per rank)
+        seg_all = jnp.asarray(_segment_ids(spec))
+        idx = jax.lax.axis_index(axis_name)
+        seg = jax.lax.dynamic_slice(seg_all, (idx * shard,), (shard,))
+        nseg = spec.num_leaves + 1  # + padding bucket
+
+        # stage 1: GLOBAL grad norm (clip-after-allreduce, ref
+        # distributed_fused_lamb.py _pipeline_step): local sq sum + psum
+        sq = jax.lax.psum(jnp.sum(gshard * gshard), axis_name)
+        global_norm = jnp.sqrt(sq)
+        clip = jnp.where(
+            (max_grad_norm > 0) & (global_norm > max_grad_norm),
+            global_norm / max_grad_norm,
+            1.0,
+        )
+        g = gshard / clip
+
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1**stepf if bias_correction else jnp.asarray(1.0)
+        bc2 = 1.0 - beta2**stepf if bias_correction else jnp.asarray(1.0)
+
+        p = state.master_shard
+        m = beta1 * state.exp_avg + (1.0 - beta1) * g
+        v = beta2 * state.exp_avg_sq + (1.0 - beta2) * g * g
+        u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+        if weight_decay != 0.0:
+            u = u + weight_decay * p
+
+        # per-TENSOR trust ratios across the flat shard: segment sums of
+        # squares, combined over dp ranks
+        w_norm_sq = jax.lax.psum(
+            jax.ops.segment_sum(p * p, seg, num_segments=nseg), axis_name
+        )
+        u_norm_sq = jax.lax.psum(
+            jax.ops.segment_sum(u * u, seg, num_segments=nseg), axis_name
+        )
+        w_norm = jnp.sqrt(w_norm_sq)
+        u_norm = jnp.sqrt(u_norm_sq)
+        if use_nvlamb:
+            ratios = jnp.where(u_norm > 0, w_norm / jnp.maximum(u_norm, 1e-30), 1.0)
+        else:
+            ratios = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                w_norm / jnp.maximum(u_norm, 1e-30),
+                1.0,
+            )
+        new_master = p - lr * jnp.take(ratios, seg) * u
+
+        updates = zero_gather_updates(new_master, params, spec, axis_name)
+        new_state = DistributedFusedLAMBState(
+            step=step, master_shard=new_master, exp_avg=m, exp_avg_sq=v
+        )
+        return updates, new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class DistributedFusedLAMB:
+    """Class-style wrapper mirroring the reference constructor (the NCCL
+    tuning surface — dwu_group_size, overlap_reductions, num_blocks… —
+    is intentionally absent: XLA owns comm scheduling)."""
+
+    def __new__(
+        cls,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        axis_name: str = "dp",
+        axis_size: int = None,
+        average_grads: bool = True,
+        **_unused,
+    ):
+        return distributed_fused_lamb(
+            lr=lr,
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            weight_decay=weight_decay,
+            max_grad_norm=max_grad_norm,
+            use_nvlamb=use_nvlamb,
+            axis_name=axis_name,
+            axis_size=axis_size,
+            average_grads=average_grads,
+        )

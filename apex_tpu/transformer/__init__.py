@@ -11,8 +11,20 @@ from apex_tpu.transformer.layer import (
     ParallelTransformerLayer,
     rotary_embedding_for,
 )
+from apex_tpu.transformer.utils import (
+    average_losses_across_data_parallel_group,
+    calc_params_l2_norm,
+    get_ltor_masks_and_position_ids,
+    print_params_min_max_norm,
+    report_memory,
+)
 
 __all__ = [
+    "average_losses_across_data_parallel_group",
+    "calc_params_l2_norm",
+    "get_ltor_masks_and_position_ids",
+    "print_params_min_max_norm",
+    "report_memory",
     "AttnMaskType",
     "AttnType",
     "LayerType",

@@ -1,0 +1,134 @@
+"""Training-loop utilities (ref: apex/transformer/pipeline_parallel/utils.py).
+
+- ``average_losses_across_data_parallel_group`` (:242) — dp-mean of losses;
+- ``calc_params_l2_norm`` (:213) — TP-aware global parameter norm (TP-
+  duplicated params counted once);
+- ``get_ltor_masks_and_position_ids`` (:303) — GPT input preprocessing;
+- ``report_memory`` (:253) — device memory stats via jax;
+- ``print_params_min_max_norm`` (:265).
+"""
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def average_losses_across_data_parallel_group(losses, axis_name: str = "dp"):
+    """(ref :242) — call inside shard_map; stacks then dp-means."""
+    stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+    return jax.lax.pmean(stacked, axis_name)
+
+
+def calc_params_l2_norm(
+    params: Any,
+    tp_duplicate_predicate=None,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Global L2 norm of all params (ref :213).
+
+    With ``axis_name`` (model-parallel axis, inside shard_map), per-rank
+    partial sums are psum-combined; ``tp_duplicate_predicate(path)`` marks
+    params replicated across TP (e.g. layernorm scales) so they are
+    counted on rank 0 only — the reference's ``tensor_model_parallel``
+    attribute check.
+    """
+    rank = jax.lax.axis_index(axis_name) if axis_name else 0
+
+    def leaf_sq(path, p):
+        sq = jnp.sum(jnp.square(p.astype(jnp.float32)))
+        if axis_name and tp_duplicate_predicate is not None:
+            pathname = "/".join(str(getattr(k, "key", k)) for k in path)
+            if tp_duplicate_predicate(pathname):
+                sq = jnp.where(rank == 0, sq, 0.0)
+        return sq
+
+    total = sum(
+        jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map_with_path(leaf_sq, params)
+        )
+    )
+    if axis_name:
+        total = jax.lax.psum(total, axis_name)
+    return jnp.sqrt(total)
+
+
+def get_ltor_masks_and_position_ids(
+    data,
+    eod_token: int,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Left-to-right LM masks (ref :303).
+
+    data: (b, s) int tokens. Returns (attention_mask, loss_mask,
+    position_ids) where attention_mask is True = MASKED (our convention;
+    the reference returns <0.5 after building a tril of ones).
+    Document-reset variants rebuild positions/masks after each EOD token —
+    implemented with cumulative counts (scan-free, jit-friendly).
+    """
+    b, s = data.shape
+    causal = jnp.triu(jnp.ones((s, s), bool), 1)  # True above diagonal
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    is_eod = data == eod_token
+    # docs[i] = number of EODs strictly before position i
+    docs = jnp.cumsum(is_eod, axis=1) - is_eod.astype(jnp.int32)
+
+    if reset_position_ids:
+        # positions restart after each EOD: doc_start[i] = (index of the
+        # last EOD strictly before i) + 1, via a shifted cummax
+        idx = jnp.broadcast_to(jnp.arange(s), (b, s))
+        marker = jnp.where(is_eod, idx, -1)
+        last_eod = jax.lax.cummax(marker, axis=1)
+        prev_last = jnp.concatenate(
+            [jnp.full((b, 1), -1, last_eod.dtype), last_eod[:, :-1]], axis=1
+        )
+        position_ids = idx - (prev_last + 1)
+
+    if reset_attention_mask:
+        # tokens attend only within their document
+        same_doc = docs[:, :, None] == docs[:, None, :]
+        attention_mask = jnp.logical_or(causal[None], ~same_doc)
+    else:
+        attention_mask = jnp.broadcast_to(causal, (1, s, s))
+    # add the head broadcast dim: (b or 1, 1, s, s)
+    attention_mask = attention_mask[:, None, :, :]
+    return attention_mask, loss_mask, position_ids
+
+
+def report_memory(name: str) -> str:
+    """(ref :253) — per-device live/peak bytes from jax memory stats."""
+    mb = 1024.0 * 1024.0
+    parts = [f"{name} memory (MB)"]
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        parts.append(
+            f"| {d.platform}:{d.id} in_use: "
+            f"{stats.get('bytes_in_use', 0) / mb:.1f} peak: "
+            f"{stats.get('peak_bytes_in_use', 0) / mb:.1f}"
+        )
+    s = " ".join(parts)
+    print(s, flush=True)
+    return s
+
+
+def print_params_min_max_norm(params: Any, iteration: int) -> str:
+    """(ref :265) — min/max/norm per param leaf."""
+    lines = ["iteration, index, min, max, norm"]
+    for i, (path, p) in enumerate(
+        jax.tree_util.tree_flatten_with_path(params)[0]
+    ):
+        pf = jnp.asarray(p, jnp.float32)
+        lines.append(
+            f"{iteration:7d}, {i:4d}, {float(pf.min()):.6E}, "
+            f"{float(pf.max()):.6E}, {float(jnp.linalg.norm(pf)):.6E}"
+        )
+    s = "\n".join(lines)
+    print(s, flush=True)
+    return s

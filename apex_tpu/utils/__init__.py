@@ -4,10 +4,22 @@ from apex_tpu.utils.pytree import (
     tree_zeros_like,
     tree_map_with_path,
 )
+from apex_tpu.utils.timers import Timers, annotate, step_annotation
+from apex_tpu.utils.checkpoint import (
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
 
 __all__ = [
     "tree_cast",
     "tree_any_non_finite",
     "tree_zeros_like",
     "tree_map_with_path",
+    "Timers",
+    "annotate",
+    "step_annotation",
+    "latest_step",
+    "load_checkpoint",
+    "save_checkpoint",
 ]

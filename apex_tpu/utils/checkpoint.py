@@ -1,0 +1,64 @@
+"""Checkpoint save/restore (orbax-backed).
+
+Reference parity: the reference's checkpoint story is pieces — amp
+state_dict round-trip (amp/frontend.py:367-404), FP16_Optimizer.state_dict
+(fp16_utils/fp16_optimizer.py:212-273), DistributedFusedAdam sharded state
+dicts (contrib/optimizers/distributed_fused_adam.py ~:2400). On TPU one
+engine covers all of it: any pytree (params, optax/amp state, scaler
+state, RNG keys) round-trips through orbax, which handles sharded arrays
+(each host writes its shards — the "sharded state dict" of the reference)
+and atomic step directories natively.
+"""
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(directory: str, step: int, tree: Any, overwrite: bool = True) -> str:
+    """Write ``tree`` to ``directory/step_<N>``; returns the path.
+
+    ``tree`` may contain params, optimizer state, scaler state, metadata —
+    any pytree of arrays/scalars (ref: the save side of amp.state_dict +
+    optimizer state_dict composition).
+    """
+    path = os.path.join(os.path.abspath(directory), f"step_{step}")
+    _checkpointer().save(path, tree, force=overwrite)
+    return path
+
+
+def load_checkpoint(directory: str, step: Optional[int] = None, target: Any = None) -> Any:
+    """Restore the pytree saved at ``step`` (default: latest). ``target``
+    (a pytree of like-shaped arrays) restores dtypes/shardings exactly —
+    pass the freshly-initialized state for a true resume."""
+    directory = os.path.abspath(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = os.path.join(directory, f"step_{step}")
+    if target is not None:
+        import orbax.checkpoint as ocp
+
+        return _checkpointer().restore(
+            path, restore_args=ocp.checkpoint_utils.construct_restore_args(target)
+        )
+    return _checkpointer().restore(path)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(d.split("_", 1)[1])
+        for d in os.listdir(directory)
+        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
+    ]
+    return max(steps) if steps else None

@@ -1,0 +1,97 @@
+"""Named timers + profiler annotations.
+
+Reference parity: apex/transformer/pipeline_parallel/_timers.py (`_Timer`
+:6 with cuda synchronize, `Timers` with log/write). TPU translation:
+``jax.block_until_ready`` replaces ``torch.cuda.synchronize`` and
+``jax.profiler`` trace annotations replace NVTX ranges
+(parallel/distributed.py:363 nvtx.range_push sites).
+"""
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+import jax
+
+
+class _Timer:
+    """(ref: _timers.py:6)"""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self, barrier_on=None):
+        assert not self.started_, f"timer {self.name} already started"
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, barrier_on=None):
+        assert self.started_, f"timer {self.name} not started"
+        if barrier_on is not None:
+            jax.block_until_ready(barrier_on)
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """(ref: _timers.py Timers — log() prints "time (ms)"; the TB writer
+    becomes an optional callback so any metrics sink plugs in)."""
+
+    def __init__(self, write_fn=None):
+        self.timers: Dict[str, _Timer] = {}
+        self.write_fn = write_fn
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names, iteration: int, normalizer: float = 1.0):
+        for name in names:
+            value = self.timers[name].elapsed(reset=False) / normalizer
+            if self.write_fn is not None:
+                self.write_fn(f"{name}-time", value, iteration)
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True) -> str:
+        names = names if names is not None else list(self.timers)
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            string += f" | {name}: {t:.2f}"
+        print(string, flush=True)
+        return string
+
+
+@contextmanager
+def annotate(name: str):
+    """NVTX-range analogue: a jax.profiler trace annotation visible in
+    TensorBoard/XProf captures (ref: DDP prof ranges,
+    parallel/distributed.py:363-364)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def step_annotation(step: int):
+    """Step marker for profiler traces (jax.profiler.StepTraceAnnotation)."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)

@@ -1,0 +1,192 @@
+"""Timers, checkpointing, training-loop utils, batch samplers.
+
+Ref style: pipeline_parallel/utils.py + _timers.py + _batchsampler.py
+consumers; checkpoint round-trip mirrors the amp state_dict tests
+(tests/L0/run_amp/test_checkpointing.py).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.data import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+from apex_tpu.parallel import parallel_state
+from apex_tpu.transformer import (
+    average_losses_across_data_parallel_group,
+    calc_params_l2_norm,
+    get_ltor_masks_and_position_ids,
+    print_params_min_max_norm,
+    report_memory,
+)
+from apex_tpu.utils import (
+    Timers,
+    annotate,
+    latest_step,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+class TestTimers:
+    def test_elapsed_and_log(self):
+        timers = Timers()
+        timers("fwd").start()
+        timers("fwd").stop()
+        e = timers("fwd").elapsed(reset=False)
+        assert e >= 0.0
+        out = timers.log(["fwd"])
+        assert "fwd" in out and "time (ms)" in out
+
+    def test_write_callback(self):
+        seen = []
+        timers = Timers(write_fn=lambda name, v, it: seen.append((name, it)))
+        timers("x").start()
+        timers("x").stop()
+        timers.write(["x"], iteration=7)
+        assert seen == [("x-time", 7)]
+
+    def test_annotate_context(self):
+        with annotate("test-region"):
+            jnp.ones(4).sum()
+
+
+class TestCheckpoint:
+    def test_round_trip_and_latest(self, tmp_path, rng):
+        tree = {
+            "params": {"w": jax.random.normal(rng, (4, 4))},
+            "step": jnp.asarray(3, jnp.int32),
+            "scale": jnp.asarray(2.0**16, jnp.float32),
+        }
+        save_checkpoint(str(tmp_path), 1, tree)
+        tree2 = jax.tree_util.tree_map(lambda x: x + 1, tree)
+        save_checkpoint(str(tmp_path), 5, tree2)
+        assert latest_step(str(tmp_path)) == 5
+        restored = load_checkpoint(str(tmp_path))
+        np.testing.assert_allclose(restored["params"]["w"], tree2["params"]["w"])
+        assert int(restored["step"]) == 4
+        old = load_checkpoint(str(tmp_path), step=1, target=tree)
+        np.testing.assert_allclose(old["params"]["w"], tree["params"]["w"])
+        assert old["step"].dtype == jnp.int32
+
+
+class TestTrainUtils:
+    def test_average_losses_across_dp(self):
+        mesh = parallel_state.initialize_model_parallel()  # dp=8
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+            check_vma=False,
+        )
+        def run(x):
+            return average_losses_across_data_parallel_group([x[0, 0]])
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        np.testing.assert_allclose(run(x), [3.5])
+
+    def test_calc_params_l2_norm(self, rng):
+        params = {"a": jnp.ones((3, 3)), "b": 2.0 * jnp.ones((4,))}
+        want = float(np.sqrt(9 + 4 * 2.0**2))
+        np.testing.assert_allclose(calc_params_l2_norm(params), want, rtol=1e-6)
+
+    def test_ltor_masks_basic(self):
+        data = jnp.array([[5, 1, 7, 1, 9, 2]])  # eod_token = 1
+        att, loss_mask, pos = get_ltor_masks_and_position_ids(
+            data, eod_token=1, eod_mask_loss=True
+        )
+        assert att.shape == (1, 1, 6, 6)
+        assert bool(att[0, 0, 0, 1])  # future masked
+        assert not bool(att[0, 0, 1, 0])  # past visible
+        np.testing.assert_array_equal(loss_mask[0], [1, 0, 1, 0, 1, 1])
+        np.testing.assert_array_equal(pos[0], np.arange(6))
+
+    def test_ltor_masks_reset(self):
+        data = jnp.array([[5, 1, 7, 8, 1, 9]])
+        att, _, pos = get_ltor_masks_and_position_ids(
+            data, eod_token=1, reset_position_ids=True,
+            reset_attention_mask=True,
+        )
+        # positions restart after each eod
+        np.testing.assert_array_equal(pos[0], [0, 1, 0, 1, 2, 0])
+        # token 2 (doc 2) cannot attend token 0 (doc 1)
+        assert bool(att[0, 0, 2, 0])
+        # within doc it can attend backward
+        assert not bool(att[0, 0, 3, 2])
+
+    def test_report_and_print(self, rng, capsys):
+        report_memory("test")
+        print_params_min_max_norm({"w": jnp.ones((2, 2))}, iteration=1)
+        out = capsys.readouterr().out
+        assert "memory (MB)" in out and "iteration" in out
+
+
+class TestBatchSamplers:
+    def test_sequential_shards_and_resume(self):
+        s = MegatronPretrainingSampler(
+            total_samples=20, consumed_samples=4, local_minibatch_size=2,
+            data_parallel_rank=1, data_parallel_size=2,
+        )
+        batches = list(s)
+        # first global batch covers samples 4..7; rank1 gets [6, 7]
+        assert batches[0] == [6, 7]
+        assert all(len(b) == 2 for b in batches)
+        flat = [i for b in batches for i in b]
+        assert max(flat) < 20 and min(flat) >= 4
+
+    def test_sequential_validations(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(0, 0, 2, 0, 1)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(10, 10, 2, 0, 1)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(10, 0, 2, 3, 2)
+
+    def test_random_is_permutation_and_disjoint(self):
+        ranks = []
+        for r in range(2):
+            s = MegatronPretrainingRandomSampler(
+                total_samples=16, consumed_samples=0, local_minibatch_size=2,
+                data_parallel_rank=r, data_parallel_size=2, seed=3,
+            )
+            ranks.append([i for b in s for i in b])
+        assert len(set(ranks[0]) & set(ranks[1])) == 0
+        assert sorted(ranks[0] + ranks[1]) == list(range(16))
+
+    def test_random_epoch_reshuffles(self):
+        def epoch_indices(consumed):
+            s = MegatronPretrainingRandomSampler(
+                total_samples=16, consumed_samples=consumed,
+                local_minibatch_size=2, data_parallel_rank=0,
+                data_parallel_size=2, seed=3,
+            )
+            return [i for b in s for i in b]
+
+        assert epoch_indices(0) != epoch_indices(16)
+
+    def test_random_rampup_resume(self):
+        """Resume after a batch-size rampup: consumed not a multiple of the
+        new global batch must not crash (the reference's commented assert)."""
+        s = MegatronPretrainingRandomSampler(
+            total_samples=16, consumed_samples=6, local_minibatch_size=2,
+            data_parallel_rank=0, data_parallel_size=2, seed=3,
+        )
+        batches = list(s)
+        assert all(len(b) == 2 for b in batches)
+
+    def test_random_too_few_samples_rejected(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingRandomSampler(
+                total_samples=3, consumed_samples=0, local_minibatch_size=2,
+                data_parallel_rank=0, data_parallel_size=2,
+            )
+
+    def test_sequential_zero_batch_rejected(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingSampler(10, 0, 0, 0, 1)
