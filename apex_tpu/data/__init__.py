@@ -6,3 +6,11 @@ from apex_tpu.data.batchsampler import (
 )
 
 __all__ = ["MegatronPretrainingSampler", "MegatronPretrainingRandomSampler"]
+
+from apex_tpu.data.indexed_dataset import (
+    IndexedTokenDataset,
+    LMDataset,
+    write_token_file,
+)
+
+__all__ += ["IndexedTokenDataset", "LMDataset", "write_token_file"]

@@ -1,0 +1,93 @@
+"""Memory-mapped token dataset + LM batch staging on the native runtime.
+
+Reference parity: the Megatron-style data path the reference's samplers
+(_data/_batchsampler.py) feed — in the Megatron ecosystem the indexed
+binary dataset and its sample gathering are C++ for throughput. Here the
+same split: Python owns metadata; the per-batch token gather and epoch
+shuffles run in the native host library (csrc/apex_tpu_C.cpp) with a
+numpy fallback.
+
+Format: ``<prefix>.bin`` is a flat little-endian token array (int32 or
+uint16); ``<prefix>.idx.npy`` optionally holds document start offsets.
+``LMDataset`` exposes fixed-length (tokens, labels) samples with the
+usual next-token shift.
+"""
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu import _native
+
+
+def write_token_file(prefix: str, tokens: np.ndarray, doc_offsets=None) -> str:
+    """Writer for the binary format (tests/tools). Records the dtype in a
+    ``.dtype`` sidecar so readers can never misinterpret the raw bytes."""
+    tokens = np.ascontiguousarray(tokens)
+    assert tokens.dtype in (np.int32, np.uint16), tokens.dtype
+    with open(prefix + ".bin", "wb") as f:
+        f.write(tokens.tobytes())
+    with open(prefix + ".dtype", "w") as f:
+        f.write(tokens.dtype.name)
+    if doc_offsets is not None:
+        np.save(prefix + ".idx.npy", np.asarray(doc_offsets, np.int64))
+    return prefix + ".bin"
+
+
+class IndexedTokenDataset:
+    """Memory-mapped flat token stream with optional document index."""
+
+    def __init__(self, prefix: str, dtype=None):
+        path = prefix + ".bin"
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        dtype_path = prefix + ".dtype"
+        if os.path.exists(dtype_path):
+            with open(dtype_path) as f:
+                recorded = np.dtype(f.read().strip())
+            if dtype is not None and np.dtype(dtype) != recorded:
+                raise ValueError(
+                    f"requested dtype {np.dtype(dtype)} != recorded {recorded}"
+                )
+            dtype = recorded
+        elif dtype is None:
+            dtype = np.int32
+        if os.path.getsize(path) % np.dtype(dtype).itemsize != 0:
+            raise ValueError(
+                f"{path} size is not a multiple of {np.dtype(dtype)} itemsize "
+                "— wrong dtype?"
+            )
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        idx_path = prefix + ".idx.npy"
+        self.doc_offsets: Optional[np.ndarray] = (
+            np.load(idx_path) if os.path.exists(idx_path) else None
+        )
+
+    def __len__(self) -> int:
+        return int(self.tokens.shape[0])
+
+
+class LMDataset:
+    """Fixed-length causal-LM view: sample i = tokens[i*seq_len :
+    i*seq_len + seq_len + 1] split into (inputs, labels)."""
+
+    def __init__(self, dataset: IndexedTokenDataset, seq_len: int):
+        self.ds = dataset
+        self.seq_len = seq_len
+        self.offsets = _native.lm_sample_offsets(len(dataset), seq_len)
+
+    def __len__(self) -> int:
+        return int(self.offsets.shape[0])
+
+    def batch(self, indices: Sequence[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Native batched gather of samples (+1 token for the label shift)."""
+        idx = np.asarray(indices, np.int64)
+        offs = self.offsets[idx]
+        # lm_sample_offsets guarantees the +1 label token stays in bounds;
+        # gather_rows raises IndexError if that invariant is ever broken
+        rows = _native.gather_rows(self.ds.tokens, offs, self.seq_len + 1)
+        return rows[:, :-1], rows[:, 1:]
+
+    def epoch_permutation(self, epoch: int, seed: int = 0) -> np.ndarray:
+        return _native.permutation(len(self), seed * 1_000_003 + epoch)

@@ -1,0 +1,96 @@
+// apex_tpu native host runtime.
+//
+// Reference parity: the reference's host-side native layer — apex_C
+// flatten/unflatten (csrc/flatten_unflatten.cpp:16-17), the
+// multi_tensor_apply chunking engine's host bookkeeping
+// (csrc/multi_tensor_apply.cuh:19-133), and the C++ indexed-dataset
+// machinery the Megatron data path relies on. On TPU the device side of
+// those components is XLA/Pallas; what remains genuinely native is the
+// HOST runtime: staging training batches out of memory-mapped token files
+// and packing/unpacking parameter buffers without Python-loop overhead.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the image).
+// Every function is thread-free and operates on caller-owned memory; the
+// Python wrapper (apex_tpu/_native.py) owns shape/bounds validation and
+// falls back to numpy when the shared library is unavailable.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Batched row gather: out[i, :] = data[offsets[i] : offsets[i] + row_len].
+// The data-loader hot loop: one memcpy per sample from the token memmap
+// into the pinned staging batch.
+void gather_rows_i32(const int32_t* data, const int64_t* offsets,
+                     int64_t n_rows, int64_t row_len, int32_t* out) {
+  for (int64_t i = 0; i < n_rows; ++i) {
+    std::memcpy(out + i * row_len, data + offsets[i],
+                static_cast<size_t>(row_len) * sizeof(int32_t));
+  }
+}
+
+void gather_rows_u16(const uint16_t* data, const int64_t* offsets,
+                     int64_t n_rows, int64_t row_len, uint16_t* out) {
+  for (int64_t i = 0; i < n_rows; ++i) {
+    std::memcpy(out + i * row_len, data + offsets[i],
+                static_cast<size_t>(row_len) * sizeof(uint16_t));
+  }
+}
+
+// Flatten n float buffers into one contiguous buffer (apex_C.flatten).
+// srcs: array of n pointers; sizes: element counts per buffer.
+void flatten_f32(const float* const* srcs, const int64_t* sizes, int64_t n,
+                 float* dst) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + off, srcs[i], static_cast<size_t>(sizes[i]) * sizeof(float));
+    off += sizes[i];
+  }
+}
+
+// Inverse of flatten_f32 (apex_C.unflatten).
+void unflatten_f32(const float* src, const int64_t* sizes, int64_t n,
+                   float* const* dsts) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dsts[i], src + off, static_cast<size_t>(sizes[i]) * sizeof(float));
+    off += sizes[i];
+  }
+}
+
+// Deterministic Fisher-Yates permutation with splitmix64 — the sampler's
+// epoch shuffle without materializing numpy RandomState overhead for
+// billion-sample datasets.
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+void permutation_i64(int64_t n, uint64_t seed, int64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = i;
+  uint64_t s = seed ^ 0xd6e8feb86659fd93ULL;
+  for (int64_t i = n - 1; i > 0; --i) {
+    uint64_t j = splitmix64(&s) % static_cast<uint64_t>(i + 1);
+    int64_t t = out[i];
+    out[i] = out[j];
+    out[j] = t;
+  }
+}
+
+// Build sequence start offsets for fixed-length LM samples over a token
+// stream of total length n_tokens: samples at stride `seq_len` (+1 label
+// shift handled by the caller). Returns the number of samples written.
+int64_t build_lm_sample_offsets(int64_t n_tokens, int64_t seq_len,
+                                int64_t* out, int64_t max_out) {
+  int64_t n = (n_tokens - 1) / seq_len;
+  if (n > max_out) n = max_out;
+  for (int64_t i = 0; i < n; ++i) out[i] = i * seq_len;
+  return n;
+}
+
+int64_t apex_tpu_native_abi_version() { return 1; }
+
+}  // extern "C"

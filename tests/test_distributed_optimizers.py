@@ -31,6 +31,9 @@ def make_params(rng):
     }
 
 
+# NOTE: grads enter replicated (in_specs=P()), so psum_scatter sums DP
+# copies; average_grads=True divides by DP making the scattered grads
+# EXACTLY the dense grads — the parity below is exact, not scale-invariant.
 def run_distributed(opt_factory, params, grads_seq):
     mesh = parallel_state.initialize_model_parallel(devices=jax.devices()[:DP])
     opt = opt_factory()
@@ -79,7 +82,7 @@ class TestDistributedFusedAdam:
         params = make_params(rng)
         got = run_distributed(
             lambda: distributed_fused_adam(
-                lr=1e-2, weight_decay=0.01, axis_size=DP, average_grads=False
+                lr=1e-2, weight_decay=0.01, axis_size=DP, average_grads=True
             ),
             params,
             grads_seq,
@@ -99,7 +102,7 @@ class TestDistributedFusedLAMB:
         got = run_distributed(
             lambda: distributed_fused_lamb(
                 lr=1e-2, weight_decay=0.01, max_grad_norm=1.0,
-                use_nvlamb=use_nvlamb, axis_size=DP, average_grads=False,
+                use_nvlamb=use_nvlamb, axis_size=DP, average_grads=True,
             ),
             params,
             grads_seq,
