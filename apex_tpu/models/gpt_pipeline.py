@@ -1,0 +1,96 @@
+"""Pipelined-GPT building blocks: pre/stage/post functions for the
+compiled pipeline schedule.
+
+Reference parity: the model side of build_model + forward_step
+(schedules/common.py:30,253) — the reference splits its GPT into
+pre_process (embedding), per-stage transformer chunks, and post_process
+(final LN + head + loss). One shared implementation here feeds the tests,
+the driver dryrun, and the examples, including the two SP subtleties:
+
+- the Embedding module already scatters its output to the SP region
+  (models/gpt.py) — pre_fn must NOT scatter again;
+- under SP each tp rank scores only its sequence shard, so the replicated
+  post params (final norm + head) receive tp-PARTIAL grads; routing them
+  through ``copy_to_tensor_model_parallel_region`` (identity forward,
+  psum backward) completes them — the same mechanism Norm uses for its
+  SP-sharded scale/bias (transformer/layer.py Norm).
+"""
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.models.gpt import Embedding
+from apex_tpu.ops.layer_norm import layer_norm
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.parallel.layers import _tp_size
+from apex_tpu.parallel.mappings import copy_to_tensor_model_parallel_region
+from apex_tpu.transformer import ParallelTransformer, TransformerConfig
+
+
+class GPTPipelineParts(NamedTuple):
+    embed: Any
+    chunk: Any
+    pre_fn: Callable
+    stage_fn: Callable
+    post_loss_fn: Callable
+    init_post: Callable
+
+
+def build_gpt_pipeline(cfg: TransformerConfig, pp: int) -> GPTPipelineParts:
+    """Modules + pure functions for ``forward_backward_with_pre_post``.
+
+    The stack is split as: Embedding (pre, replicated over pp) →
+    ``num_layers/pp`` transformer layers per stage → final LayerNorm +
+    untied vocab head + token-mean CE (post, replicated over pp).
+    """
+    if cfg.num_layers % pp != 0:
+        raise ValueError(f"num_layers ({cfg.num_layers}) not divisible by pp ({pp})")
+    embed = Embedding(config=cfg)
+    chunk = ParallelTransformer(
+        config=cfg, num_layers=cfg.num_layers // pp, post_layer_norm=False
+    )
+
+    def pre_fn(pre_params, tokens_mb):
+        # Embedding handles the SP scatter internally (models/gpt.py)
+        return embed.apply({"params": pre_params}, tokens_mb)
+
+    def stage_fn(chunk_params, h):
+        return chunk.apply({"params": chunk_params}, h)
+
+    def post_loss_fn(post_params, y, labels_mb):
+        tp = _tp_size(cfg.tensor_axis)
+        sp = cfg.sequence_parallel and tp > 1
+        scale = post_params["norm_scale"]
+        bias = post_params["norm_bias"]
+        head = post_params["head"]
+        lab = labels_mb
+        if sp:
+            # replicated post params see tp-partial grads under SP:
+            # identity-forward/psum-backward completes them
+            scale = copy_to_tensor_model_parallel_region(scale, cfg.tensor_axis)
+            bias = copy_to_tensor_model_parallel_region(bias, cfg.tensor_axis)
+            head = copy_to_tensor_model_parallel_region(head, cfg.tensor_axis)
+            r = jax.lax.axis_index(cfg.tensor_axis)
+            lab = jax.lax.dynamic_slice_in_dim(
+                labels_mb, r * y.shape[0], y.shape[0], axis=1
+            )
+        h = layer_norm(
+            y, scale.astype(jnp.float32), bias.astype(jnp.float32)
+        ).astype(y.dtype)
+        logits = jnp.transpose(jnp.einsum("sbh,hv->sbv", h, head), (1, 0, 2))
+        loss = jnp.mean(softmax_cross_entropy_loss(logits, lab))
+        # under SP: local-mean / tp — the SPMD sum across tp ranks
+        # differentiates to the global token mean
+        return loss / tp if sp else loss
+
+    def init_post(key):
+        return {
+            "norm_scale": jnp.ones((cfg.hidden_size,)),
+            "norm_bias": jnp.zeros((cfg.hidden_size,)),
+            "head": 0.05
+            * jax.random.normal(key, (cfg.hidden_size, cfg.vocab_size)),
+        }
+
+    return GPTPipelineParts(embed, chunk, pre_fn, stage_fn, post_loss_fn, init_post)

@@ -36,6 +36,7 @@ from apex_tpu.parallel.pipeline.schedules import (
     forward_backward_no_pipelining,
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
+    forward_backward_with_pre_post,
     get_forward_backward_func,
     pipeline_forward,
     build_model,
@@ -60,6 +61,7 @@ __all__ = [
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
+    "forward_backward_with_pre_post",
     "get_forward_backward_func",
     "pipeline_forward",
     "build_model",

@@ -112,6 +112,37 @@ def pipeline_forward(
     return outputs
 
 
+def _stages_forward(
+    stage_fn, stages_params, h, *, axis_name: str, remat: bool,
+    num_model_chunks: int,
+):
+    """Forward through this rank's chunk(s): one pipeline pass, or V
+    circular passes chained by the last→first ring edge (chunk v on rank r
+    = global stage v*P + r, the reference's interleaved chunk-id map)."""
+    if num_model_chunks == 1:
+        return pipeline_forward(
+            stage_fn, stages_params, h, axis_name=axis_name, remat=remat
+        )
+    outs = None
+    x = h
+    for v in range(num_model_chunks):
+        pv = jax.tree_util.tree_map(lambda a, _v=v: a[_v], stages_params)
+        outs = pipeline_forward(stage_fn, pv, x, axis_name=axis_name, remat=remat)
+        if v < num_model_chunks - 1:
+            x = p2p.ring_send_last_to_first(outs, axis_name)
+    return outs
+
+
+def _publish_losses(per_microbatch_losses, axis_name: str):
+    """Mask bubble garbage off non-final stages, publish the mean loss and
+    the per-microbatch losses from the last stage to every stage."""
+    num_stages = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    losses = jnp.where(rank == num_stages - 1, per_microbatch_losses, 0.0)
+    loss = _last_stage_mean_loss(losses, axis_name)
+    return loss, jax.lax.psum(losses, axis_name)
+
+
 def _last_stage_mean_loss(per_microbatch_losses, axis_name: str):
     """Average per-microbatch losses and publish from the last stage to all
     stages (ref: losses divided by num_microbatches on the last stage,
@@ -183,18 +214,11 @@ def forward_backward_pipelining_without_interleaving(
     stage's ``params`` — the backward pipeline (warmup/steady/cooldown of
     the reference) emerges from differentiating the forward scan.
     """
-    num_stages = jax.lax.psum(1, axis_name)
-    rank = jax.lax.axis_index(axis_name)
-
     def total_loss(p):
         outs = pipeline_forward(
             stage_fn, p, microbatches, axis_name=axis_name, remat=remat
         )
-        losses = jax.vmap(loss_fn)(outs, targets)
-        # mask bubble garbage on non-final stages out of the graph
-        losses = jnp.where(rank == num_stages - 1, losses, 0.0)
-        loss = _last_stage_mean_loss(losses, axis_name)
-        return loss, jax.lax.psum(losses, axis_name)
+        return _publish_losses(jax.vmap(loss_fn)(outs, targets), axis_name)
 
     (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
     if grad_sync_fn is not None:
@@ -224,28 +248,71 @@ def forward_backward_pipelining_with_interleaving(
     a last→first ring edge, so the layer order is exactly the reference's
     interleaved assignment.
     """
-    num_stages = jax.lax.psum(1, axis_name)
-    rank = jax.lax.axis_index(axis_name)
-
     def total_loss(chunks):
-        x = microbatches
-        outs = None
-        for v in range(num_model_chunks):
-            pv = jax.tree_util.tree_map(lambda a, _v=v: a[_v], chunks)
-            outs = pipeline_forward(
-                stage_fn, pv, x, axis_name=axis_name, remat=remat
-            )
-            if v < num_model_chunks - 1:
-                # close the ring: last stage's outputs become stage-0 input
-                # of the next virtual chunk pass
-                x = p2p.ring_send_last_to_first(outs, axis_name)
-        losses = jax.vmap(loss_fn)(outs, targets)
-        losses = jnp.where(rank == num_stages - 1, losses, 0.0)
-        loss = _last_stage_mean_loss(losses, axis_name)
-        return loss, jax.lax.psum(losses, axis_name)
+        outs = _stages_forward(
+            stage_fn, chunks, microbatches, axis_name=axis_name,
+            remat=remat, num_model_chunks=num_model_chunks,
+        )
+        return _publish_losses(jax.vmap(loss_fn)(outs, targets), axis_name)
 
     (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(
         params_chunks
+    )
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return loss, losses, grads
+
+
+def forward_backward_with_pre_post(
+    pre_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    post_loss_fn: Callable[[Any, Any, Any], jnp.ndarray],
+    params: Any,
+    inputs: Any,
+    targets: Any,
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+    num_model_chunks: int = 1,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Full-model pipeline step: embedding + stages + head in one backward.
+
+    ``params`` is a dict ``{"pre": …, "stages": …, "post": …}``:
+    - ``pre`` (e.g. the embedding) and ``post`` (final norm + head/loss)
+      are REPLICATED across pp ranks; only stage 0 / the last stage's
+      compute reaches the loss, so their raw grads are nonzero on one rank
+      only — they are psum-synced over pp afterwards, which is exactly the
+      reference's first/last-stage embedding-group grad allreduce for tied
+      embeddings (parallel_state.py:319-407 embedding groups);
+    - ``stages`` holds this rank's chunk params (leading dim V when
+      ``num_model_chunks`` > 1, chunk v = global stage v*P + rank).
+
+    ``pre_fn(pre_params, input_mb) -> h``; ``stage_fn(chunk_params, h) ->
+    h``; ``post_loss_fn(post_params, h, target_mb) -> scalar``. Returns
+    ``(loss, per_microbatch_losses, grads)`` with grads matching
+    ``params``.
+    """
+    def total_loss(p):
+        h = jax.vmap(lambda mb: pre_fn(p["pre"], mb))(inputs)
+        outs = _stages_forward(
+            stage_fn, p["stages"], h, axis_name=axis_name, remat=remat,
+            num_model_chunks=num_model_chunks,
+        )
+        losses = jax.vmap(
+            lambda y, t: post_loss_fn(p["post"], y, t)
+        )(outs, targets)
+        return _publish_losses(losses, axis_name)
+
+    (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
+    # replicated pre/post params: combine the single contributing rank's
+    # grads onto every rank (tied-embedding allreduce semantics)
+    grads = dict(grads)
+    grads["pre"] = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), grads["pre"]
+    )
+    grads["post"] = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, axis_name), grads["post"]
     )
     if grad_sync_fn is not None:
         grads = grad_sync_fn(grads)
