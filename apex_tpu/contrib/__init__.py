@@ -11,7 +11,14 @@ from apex_tpu.contrib.bottleneck import (
     SpatialBottleneck,
     halo_exchange_1d,
 )
+from apex_tpu.contrib.conv_bias_relu import (
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+    conv_frozen_scale_bias_relu,
+)
 from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.groupbn import GroupBatchNorm2d
 from apex_tpu.contrib.group_norm import GroupNorm, group_norm
 from apex_tpu.contrib.index_mul_2d import index_mul_2d
 from apex_tpu.contrib.multihead_attn import EncdecMultiheadAttn, SelfMultiheadAttn
@@ -25,6 +32,11 @@ from apex_tpu.contrib.transducer import (
 from apex_tpu.contrib.xentropy import SoftmaxCrossEntropyLoss
 
 __all__ = [
+    "conv_bias",
+    "conv_bias_mask_relu",
+    "conv_bias_relu",
+    "conv_frozen_scale_bias_relu",
+    "GroupBatchNorm2d",
     "Bottleneck",
     "SpatialBottleneck",
     "halo_exchange_1d",

@@ -1,0 +1,57 @@
+"""Fused Conv + Bias (+ Mask) (+ ReLU) ops.
+
+Reference parity: apex.contrib.conv_bias_relu
+(contrib/conv_bias_relu/conv_bias_relu.py:12-99 — ConvBiasReLU_, ConvBias_,
+ConvBiasMaskReLU_, ConvFrozenScaleBiasReLU_, each a cudnn-frontend fusion
+graph with a hand-written backward). On TPU the conv+bias+mask+relu chain
+is a single XLA fusion around the MXU conv, and autodiff produces the same
+dgrad/wgrad/relu-mask backward the reference codes by hand.
+
+Layout: NHWC activations, HWIO weights (TPU native — the reference's
+channels_last requirement maps to "the default").
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv(x, weight, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x,
+        weight.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def conv_bias(x, weight, bias, padding: int = 0, stride: int = 1):
+    """(ref: ConvBias_, :34)"""
+    return (_conv(x, weight, stride, padding) + bias.astype(jnp.float32)).astype(
+        x.dtype
+    )
+
+
+def conv_bias_relu(x, weight, bias, padding: int = 0, stride: int = 1):
+    """(ref: ConvBiasReLU_, :12)"""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, padding: int = 0, stride: int = 1):
+    """(ref: ConvBiasMaskReLU_, :55) — mask multiplies the pre-activation
+    (dropout-style or attention masks in detection heads)."""
+    y = _conv(x, weight, stride, padding) + bias.astype(jnp.float32)
+    y = y * mask.astype(jnp.float32)
+    return jax.nn.relu(y).astype(x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, padding: int = 0,
+                                stride: int = 1):
+    """(ref: ConvFrozenScaleBiasReLU_, :78) — folded frozen-BN epilogue:
+    relu(conv(x) * scale + bias) with scale/bias treated as constants."""
+    scale = jax.lax.stop_gradient(scale.astype(jnp.float32))
+    bias = jax.lax.stop_gradient(bias.astype(jnp.float32))
+    y = _conv(x, weight, stride, padding) * scale + bias
+    return jax.nn.relu(y).astype(x.dtype)

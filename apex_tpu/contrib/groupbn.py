@@ -1,0 +1,58 @@
+"""Group BatchNorm (NHWC) with optional fused ReLU / add-ReLU.
+
+Reference parity: apex.contrib.groupbn.BatchNorm2d_NHWC
+(contrib/groupbn/batch_norm.py:101 — CUDA-IPC cross-GPU group BN with
+bn_group ranks sharing statistics, optional fused relu and residual
+add-relu) and apex.contrib.cudnn_gbn.GroupBatchNorm2d
+(contrib/cudnn_gbn/batch_norm.py:44 — the cudnn-frontend flavor of the
+same thing).
+
+TPU design: "a BN whose statistics span a group of devices" is exactly
+SyncBatchNorm over a mesh axis; the IPC peer-memory machinery is a psum.
+``bn_group`` semantics (stats shared by groups of ranks along the dp axis)
+are expressed by choosing which mesh axes to reduce over; the fused
+relu/add-relu epilogues are XLA fusions.
+"""
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
+
+
+class GroupBatchNorm2d(nn.Module):
+    """(ref: groupbn/batch_norm.py:101 constructor — num_features, eps,
+    momentum, fuse_relu, bn_group). ``axis_names`` names the mesh axes the
+    statistics group spans (the bn_group); () = plain local BN."""
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    fuse_relu: bool = False
+    axis_names: Sequence[str] = ("dp",)
+
+    @nn.compact
+    def __call__(self, x, z=None, train: bool = False):
+        """``z``: optional residual fused as add-relu (ref: the bn_addrelu
+        kernels, batch_norm.py fwd/bwd _addrelu paths)."""
+        if x.shape[-1] != self.num_features:
+            raise ValueError(
+                f"expected {self.num_features} channels, got {x.shape[-1]}"
+            )
+        y = SyncBatchNorm(
+            axis_names=tuple(self.axis_names),
+            momentum=self.momentum,
+            epsilon=self.eps,
+            name="bn",
+        )(x, use_running_average=not train)
+        if z is not None:
+            # the reference asserts fuse_relu for the add-relu path
+            # (groupbn/batch_norm.py:197-198)
+            assert self.fuse_relu, "residual add requires fuse_relu=True"
+            return jax.nn.relu(y + z)
+        if self.fuse_relu:
+            return jax.nn.relu(y)
+        return y

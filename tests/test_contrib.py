@@ -213,3 +213,80 @@ class TestContribXentropy:
         losses = SoftmaxCrossEntropyLoss.apply(logits, labels, 0.1, padding_idx=0)
         assert float(losses[0]) == 0.0 and float(losses[3]) == 0.0
         assert float(losses[1]) > 0.0
+
+
+class TestConvBiasRelu:
+    def test_variants_match_composition(self, rng):
+        from apex_tpu.contrib import (
+            conv_bias,
+            conv_bias_mask_relu,
+            conv_bias_relu,
+            conv_frozen_scale_bias_relu,
+        )
+
+        x = jax.random.normal(rng, (2, 8, 8, 4), jnp.float32)
+        w = jax.random.normal(jax.random.fold_in(rng, 1), (3, 3, 4, 6)) * 0.3
+        b = jax.random.normal(jax.random.fold_in(rng, 2), (6,))
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + b
+        np.testing.assert_allclose(
+            conv_bias(x, w, b, padding=1), ref, rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            conv_bias_relu(x, w, b, padding=1), np.maximum(ref, 0),
+            rtol=1e-4, atol=1e-5,
+        )
+        mask = (jax.random.uniform(jax.random.fold_in(rng, 3), ref.shape) > 0.5)
+        np.testing.assert_allclose(
+            conv_bias_mask_relu(x, w, b, mask, padding=1),
+            np.maximum(np.asarray(ref) * np.asarray(mask), 0),
+            rtol=1e-4, atol=1e-5,
+        )
+        scale = jnp.ones((6,)) * 2.0
+        got = conv_frozen_scale_bias_relu(x, w, scale, b, padding=1)
+        np.testing.assert_allclose(
+            got, np.maximum((np.asarray(ref) - b) * 2.0 + np.asarray(b), 0),
+            rtol=1e-4, atol=1e-5,
+        )
+        # frozen scale/bias receive no gradient
+        g = jax.grad(
+            lambda s: jnp.sum(conv_frozen_scale_bias_relu(x, w, s, b, padding=1))
+        )(scale)
+        np.testing.assert_array_equal(g, 0.0)
+
+
+class TestGroupBatchNorm2d:
+    def test_local_bn_and_fused_relu(self, rng):
+        from apex_tpu.contrib import GroupBatchNorm2d
+
+        x = jax.random.normal(rng, (4, 6, 6, 8), jnp.float32)
+        mod = GroupBatchNorm2d(num_features=8, fuse_relu=True, axis_names=())
+        variables = mod.init(rng, x, train=True)
+        y, _ = mod.apply(variables, x, train=True, mutable=["batch_stats"])
+        assert float(jnp.min(y)) >= 0.0
+        # normalized pre-relu: per-channel mean ~0
+        mod2 = GroupBatchNorm2d(num_features=8, axis_names=())
+        v2 = mod2.init(rng, x, train=True)
+        y2, _ = mod2.apply(v2, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(y2).mean(axis=(0, 1, 2)), 0.0, atol=1e-5
+        )
+
+    def test_add_relu_residual(self, rng):
+        from apex_tpu.contrib import GroupBatchNorm2d
+
+        x = jax.random.normal(rng, (2, 4, 4, 8), jnp.float32)
+        z = jax.random.normal(jax.random.fold_in(rng, 1), (2, 4, 4, 8))
+        mod = GroupBatchNorm2d(num_features=8, fuse_relu=True, axis_names=())
+        variables = mod.init(rng, x, train=True)
+        y, _ = mod.apply(variables, x, z=z, train=True, mutable=["batch_stats"])
+        plain = GroupBatchNorm2d(num_features=8, axis_names=())
+        base, _ = plain.apply(variables, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(y, np.maximum(np.asarray(base) + z, 0),
+                                   rtol=1e-5, atol=1e-6)
+        # residual without fuse_relu is rejected (ref: batch_norm.py:197)
+        bad = GroupBatchNorm2d(num_features=8, axis_names=())
+        with pytest.raises(AssertionError):
+            bad.apply(variables, x, z=z, train=True, mutable=["batch_stats"])
