@@ -8,10 +8,10 @@ online-softmax attention kernel with no sequence-length cap.
 Design: forward is a Pallas kernel — grid over (batch*heads, q_blocks), K/V
 resident in VMEM per (b,h), online softmax accumulation in fp32, causal
 blocks skipped entirely via a data-dependent ``fori_loop`` bound. The
-backward recomputes attention from the saved logsumexp (standard
-flash-attention recompute strategy; saves O(S^2) activation memory in the
-forward). The backward itself is currently an XLA einsum chain — a Pallas
-backward kernel is the planned next optimization.
+backward is two Pallas kernels (dq over q blocks; dk/dv over kv blocks)
+that recompute probabilities from the saved logsumexp per block pair —
+the standard flash recompute strategy, O(seq x block) memory in both
+directions.
 
 Long-context across chips is handled one level up by
 ``apex_tpu.parallel.ring_attention``, which calls the blockwise pieces here
@@ -28,6 +28,19 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._dispatch import resolve_impl
 
 _NEG_INF = -1e30
+
+
+def _causal_hi(qi, bq: int, bk: int, num_kv):
+    """Last kv block (exclusive) participating for q block ``qi`` under the
+    causal mask — shared by the fwd and both bwd kernels."""
+    return jnp.minimum(jax.lax.div((qi + 1) * bq + bk - 1, bk), num_kv)
+
+
+def _causal_keep(qi, kj, bq: int, bk: int):
+    """(bq, bk) keep-mask (True = attend) for block pair (qi, kj)."""
+    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return col <= row
 
 
 def causal_mask(sq: int, sk: int):
@@ -54,12 +67,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
     seq_k = k_ref.shape[1]
     qi = pl.program_id(1)
     num_kv = seq_k // bk
-    if causal:
-        # only blocks whose first col index <= last row index participate
-        hi = jax.lax.div((qi + 1) * bq + bk - 1, bk)
-        hi = jnp.minimum(hi, num_kv)
-    else:
-        hi = num_kv
+    hi = _causal_hi(qi, bq, bk, num_kv) if causal else num_kv
 
     def body(j, carry):
         acc, m, l = carry
@@ -69,9 +77,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # (BQ, BK)
         if causal:
-            row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(col > row, _NEG_INF, s)
+            s = jnp.where(_causal_keep(qi, j, bq, bk), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[:, None])
@@ -133,24 +139,138 @@ def _flash_fwd_res(q3, k3, v3, scale, causal, interpret, bq, bk):
     return o, (q3, k3, v3, o, lse)
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale, causal, bq, bk):
+    """dq for one q block: loop over participating kv blocks (the exact
+    recompute-from-lse strategy of the standard flash backward)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+    seq_k = k_ref.shape[1]
+    num_kv = seq_k // bk
+    hi = _causal_hi(qi, bq, bk, num_kv) if causal else num_kv
+
+    def body(j, acc):
+        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return acc + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    d = q_ref.shape[2]
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale, causal, bq, bk):
+    """dk/dv for one kv block: loop over participating q blocks."""
+    kj = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)  # (BK, D)
+    vb = v_ref[0].astype(jnp.float32)
+    seq_q = q_ref.shape[1]
+    num_q = seq_q // bq
+    lo = jax.lax.div(kj * bk, bq) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse_b = lse_ref[0, 0, pl.ds(i * bq, bq)]
+        delta_b = delta_ref[0, 0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse_b[:, None])
+        if causal:
+            p = jnp.where(_causal_keep(i, kj, bq, bk), p, 0.0)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_b[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    d = q_ref.shape[2]
+    init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(lo, num_q, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
 def _flash_bwd(scale, causal, interpret, bq, bk, res, do):
+    """Pallas flash backward: recompute p from the saved logsumexp per
+    block pair — O(seq x block) memory like the forward, never the full
+    (sq, sk) score matrix (previously an XLA einsum chain)."""
     q3, k3, v3, o, lse = res
-    del interpret, bq, bk
-    qf = q3.astype(jnp.float32)
-    kf = k3.astype(jnp.float32)
-    vf = v3.astype(jnp.float32)
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, SQ)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf, preferred_element_type=jnp.float32) * scale
-    if causal:
-        s = jnp.where(causal_mask(s.shape[-2], s.shape[-1]), _NEG_INF, s)
-    p = jnp.exp(s - lse[..., None])
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    ds = p * (dp - delta[..., None]) * scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+    lse3 = lse.reshape(bh, 1, sq)
+    delta3 = delta.reshape(bh, 1, sq)
+
+    full_q = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0))
+    full_k = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+    row_q = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        grid=(bh, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # q block
+            full_k, full_k,                                    # k, v resident
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # do block
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # lse block
+            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # delta block
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse3, delta3)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ),
+        grid=(bh, sk // bk),
+        in_specs=[
+            full_q,                                            # q resident
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # k block
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # v block
+            full_q,                                            # do resident
+            row_q,                                             # lse full row
+            row_q,                                             # delta full row
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ),
+        interpret=interpret,
+    )(q3, k3, v3, do, lse3, delta3)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_res, _flash_bwd)

@@ -11,6 +11,7 @@ from apex_tpu.transformer.layer import (
     ParallelTransformerLayer,
     rotary_embedding_for,
 )
+from apex_tpu.transformer.moe import MoEMLP
 from apex_tpu.transformer.utils import (
     average_losses_across_data_parallel_group,
     calc_params_l2_norm,
@@ -20,6 +21,7 @@ from apex_tpu.transformer.utils import (
 )
 
 __all__ = [
+    "MoEMLP",
     "average_losses_across_data_parallel_group",
     "calc_params_l2_norm",
     "get_ltor_masks_and_position_ids",

@@ -57,6 +57,14 @@ class TransformerConfig:
     # None | "ring" (ppermute K/V ring) | "ulysses" (all-to-all head swap)
     context_parallel_mode: Optional[str] = None
     context_axis: str = "cp"
+    # mixture-of-experts (no reference counterpart — EP extension):
+    # num_moe_experts switches the MLP block to MoEMLP; experts shard over
+    # moe_expert_axis (None = local experts)
+    num_moe_experts: Optional[int] = None
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_expert_axis: Optional[str] = None
+    moe_aux_loss_coeff: float = 0.01
     recompute_granularity: Optional[str] = None  # None | "full" | "selective"
 
     # dtypes: params live in fp32, compute in bf16 by default (TPU-native

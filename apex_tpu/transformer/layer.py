@@ -424,7 +424,24 @@ class ParallelTransformerLayer(nn.Module):
             )
 
         ln2 = Norm(config=cfg, name="post_attention_layernorm")(h)
-        mlp_out = ParallelMLP(config=cfg, name="mlp")(ln2)
+        if cfg.num_moe_experts is not None:
+            from apex_tpu.transformer.moe import MoEMLP
+
+            s_, b_, h_ = ln2.shape
+            mlp_out, moe_aux = MoEMLP(
+                config=cfg,
+                num_experts=cfg.num_moe_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                expert_axis=cfg.moe_expert_axis,
+                name="mlp",
+            )(ln2.reshape(s_ * b_, h_))
+            mlp_out = mlp_out.reshape(s_, b_, h_)
+            # surface the aux loss: readers pull it via
+            # mutable=['intermediates'] and add moe_aux_loss_coeff * mean
+            self.sow("intermediates", "moe_aux_loss", moe_aux)
+        else:
+            mlp_out = ParallelMLP(config=cfg, name="mlp")(ln2)
         residual = ln2 if cfg.apply_residual_connection_post_layernorm else h
         if cfg.hidden_dropout > 0.0 and not deterministic:
             mlp_out = ShardAwareDropout(

@@ -1,0 +1,194 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+No reference counterpart (the reference has no MoE/EP — SURVEY.md §2.5
+lists EP as absent); this is the expert-parallelism extension the TPU
+framework makes first-class, in the Switch/GShard capacity-based style
+that maps cleanly onto static XLA shapes:
+
+- a router scores tokens against E experts (top-1 "switch" or top-2
+  "gshard" gating) with the standard load-balancing auxiliary loss
+  ``E * Σ_e fraction_e * prob_e``;
+- tokens are packed into a (E, capacity, h) dispatch tensor via the
+  cumsum position trick (overflow tokens are dropped, pass through the
+  residual path);
+- experts are sharded over a mesh axis (``expert_axis``): one
+  ``all_to_all`` ships each rank's per-expert slots to the expert's owner,
+  the expert FFNs run as one batched einsum over the local experts, and a
+  second ``all_to_all`` ships results home — the EP dispatch pattern over
+  ICI;
+- with expert_axis size 1 (or outside shard_map) everything degrades to a
+  local MoE.
+"""
+
+from typing import Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.config import TransformerConfig
+
+
+def _axis_size_or_1(axis_name: Optional[str]) -> int:
+    if axis_name is None:
+        return 1
+    try:
+        return jax.lax.psum(1, axis_name)
+    except NameError:
+        return 1
+
+
+def router_probs(logits, num_experts: int, top_k: int):
+    """Softmax gate probabilities + top-k expert assignment."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)
+    return probs, gate_vals, expert_idx
+
+
+def total_moe_aux_loss(intermediates, config) -> jnp.ndarray:
+    """Sum every sown ``moe_aux_loss`` scaled by
+    ``config.moe_aux_loss_coeff`` — add this to the training loss:
+
+        out, inter = model.apply(vars, x, mutable=["intermediates"])
+        loss = task_loss + total_moe_aux_loss(inter, cfg)
+    """
+    total = jnp.asarray(0.0, jnp.float32)
+    count = 0
+
+    def visit(node):
+        nonlocal total, count
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "moe_aux_loss":
+                    for leaf in jax.tree_util.tree_leaves(v):
+                        total = total + leaf
+                        count += 1
+                else:
+                    visit(v)
+
+    visit(intermediates)
+    return config.moe_aux_loss_coeff * total
+
+
+def load_balancing_loss(probs, expert_idx, num_experts: int):
+    """Switch aux loss: E * Σ_e (token fraction to e) * (mean prob of e)."""
+    f = jnp.mean(
+        jax.nn.one_hot(expert_idx[..., 0], num_experts, dtype=jnp.float32),
+        axis=0,
+    )
+    p = jnp.mean(probs, axis=0)
+    return num_experts * jnp.sum(f * p)
+
+
+def _dispatch_indices(expert_idx, num_experts: int, capacity: int):
+    """Position of each token inside its expert's capacity buffer (cumsum
+    trick); tokens beyond capacity get position -1 (dropped)."""
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based within expert
+    pos_in_expert = jnp.sum(pos, axis=-1) - 1
+    keep = pos_in_expert < capacity
+    return jnp.where(keep, pos_in_expert, -1)
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MoE FFN block (Switch top-1 / GShard top-2).
+
+    Input (tokens, hidden) — callers flatten (s, b). ``num_experts`` is the
+    GLOBAL expert count and must divide by the expert-axis size; each rank
+    owns ``num_experts / ep`` experts. Returns (output, aux_loss).
+    """
+
+    config: TransformerConfig
+    num_experts: int = 8
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    expert_axis: Optional[str] = "dp"
+    activation: Callable = jax.nn.gelu
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        cfg = self.config
+        tokens, h = x.shape
+        e = self.num_experts
+        ep = _axis_size_or_1(self.expert_axis)
+        assert e % ep == 0, f"num_experts ({e}) not divisible by ep ({ep})"
+        local_e = e // ep
+        ffn = cfg.ffn_hidden_size
+        # per-assignment-pass capacity: each of the top_k passes dispatches
+        # one assignment per token, so per-pass slots are cf*tokens/e and
+        # TOTAL slots per expert are cf*tokens*top_k/e — the GShard
+        # convention for the capacity_factor knob
+        capacity = max(1, int(self.capacity_factor * tokens / e))
+
+        gate_w = self.param(
+            "router", nn.initializers.normal(stddev=0.02), (h, e),
+            cfg.params_dtype,
+        )
+        # router math in fp32 (standard MoE stability practice)
+        logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        probs, gate_vals, expert_idx = router_probs(logits, e, self.top_k)
+        aux = load_balancing_loss(probs, expert_idx, e)
+
+        # per-rank experts: (local_e, h, ffn) / (local_e, ffn, h)
+        w_in = self.param(
+            "w_in",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (local_e, h, ffn),
+            cfg.params_dtype,
+        )
+        w_out = self.param(
+            "w_out",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (local_e, ffn, h),
+            cfg.params_dtype,
+        )
+
+        out = jnp.zeros((tokens, h), jnp.float32)
+        for k in range(self.top_k):
+            idx_k = expert_idx[:, k]
+            gate_k = gate_vals[:, k]
+            pos = _dispatch_indices(idx_k, e, capacity)
+            keep = pos >= 0
+            # dispatch: (E, C, h) — scatter each kept token into its slot
+            dispatch = jnp.zeros((e, capacity, h), x.dtype)
+            dispatch = dispatch.at[
+                jnp.where(keep, idx_k, 0),
+                jnp.where(keep, pos, 0),
+            ].add(jnp.where(keep[:, None], x, 0))
+
+            if ep > 1:
+                # (E, C, h) -> (ep, local_e, C, h); all_to_all swaps the ep
+                # shards so each rank receives ITS experts' slots from all
+                # ranks: result (ep_src, local_e, C, h)
+                d = dispatch.reshape(ep, local_e, capacity, h)
+                d = jax.lax.all_to_all(
+                    d, self.expert_axis, split_axis=0, concat_axis=0,
+                    tiled=False,
+                )
+            else:
+                d = dispatch.reshape(1, local_e, capacity, h)
+
+            # expert FFN over (src, local_e, C, h)
+            hdn = jnp.einsum(
+                "slch,lhf->slcf", d, w_in.astype(d.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            hdn = self.activation(hdn)
+            y = jnp.einsum(
+                "slcf,lfh->slch", hdn.astype(d.dtype), w_out.astype(d.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(x.dtype)
+
+            if ep > 1:
+                y = jax.lax.all_to_all(
+                    y, self.expert_axis, split_axis=0, concat_axis=0,
+                    tiled=False,
+                )
+            y = y.reshape(e, capacity, h)
+
+            # combine: gather each token's slot, weight by its gate
+            gathered = y[jnp.where(keep, idx_k, 0), jnp.where(keep, pos, 0)]
+            out = out + jnp.where(
+                keep[:, None], gate_k[:, None] * gathered.astype(jnp.float32), 0.0
+            )
+        return out.astype(x.dtype), aux

@@ -199,6 +199,46 @@ class TestFlashAttention:
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_multiblock(self, rng, causal):
+        """seq > block forces the backward kernels' inner block loops (and
+        the causal lo/hi bounds) to run over several blocks."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        shape = (1, 2, 256, 32)
+        q = jax.random.normal(k1, shape)
+        k = jax.random.normal(k2, shape)
+        v = jax.random.normal(k3, shape)
+        ct = jax.random.normal(k4, shape)
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=causal, impl=impl,
+                                block_q=64, block_k=64) * ct
+            )
+
+        gp = jax.grad(loss("pallas"), (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss("xla"), (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+    def test_grads_rectangular_kv(self, rng):
+        """sk > sq (cross-attention shape) through the Pallas backward."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        q = jax.random.normal(k1, (1, 2, 64, 32))
+        k = jax.random.normal(k2, (1, 2, 192, 32))
+        v = jax.random.normal(k3, (1, 2, 192, 32))
+        ct = jax.random.normal(k4, (1, 2, 64, 32))
+
+        def loss(impl):
+            return lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, impl=impl, block_q=64, block_k=64) * ct
+            )
+
+        gp = jax.grad(loss("pallas"), (0, 1, 2))(q, k, v)
+        gr = jax.grad(loss("xla"), (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
     def test_mask_path(self, rng):
         k1, k2, k3, k4 = jax.random.split(rng, 4)
         q = jax.random.normal(k1, (2, 2, 64, 32))
