@@ -2,10 +2,12 @@
 
 from apex_tpu.contrib.sparsity.asp import (
     ASP,
+    MaskedState,
     compute_sparse_masks,
     default_eligibility,
     masked_update,
     prune,
+    replace_masks,
 )
 from apex_tpu.contrib.sparsity.permutation import (
     apply_permutation,
@@ -19,14 +21,17 @@ from apex_tpu.contrib.sparsity.sparse_masklib import (
     m4n2_1d,
     m4n2_2d_best,
     mn_1d_best,
+    mn_2d_best,
 )
 
 __all__ = [
     "ASP",
+    "MaskedState",
     "compute_sparse_masks",
     "default_eligibility",
     "masked_update",
     "prune",
+    "replace_masks",
     "apply_permutation",
     "invert_permutation",
     "permute_and_mask",
@@ -36,4 +41,5 @@ __all__ = [
     "m4n2_1d",
     "m4n2_2d_best",
     "mn_1d_best",
+    "mn_2d_best",
 ]

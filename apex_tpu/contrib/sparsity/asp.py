@@ -22,13 +22,23 @@ so the reduction dim is axis -2 for 2-D leaves; conv kernels (H, W, I, O)
 are pruned along I (axis -2) as the reference prunes C*R*S.
 """
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import optax
 
 from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+class MaskedState(NamedTuple):
+    """State of ``masked_update``: the masks themselves. Keeping masks in
+    the optimizer state (not as closure constants) means they are traced as
+    *data* — a jitted train step sees whatever masks the state carries, and
+    recomputed masks enter via ``replace_masks`` instead of being silently
+    frozen into the compiled trace."""
+
+    masks: Any
 
 
 def default_eligibility(path, leaf) -> bool:
@@ -81,28 +91,45 @@ def masked_update(masks: Any) -> optax.GradientTransformation:
         params := params + u' stays exactly masked, equivalent to the
         reference's mask re-application after each step.
 
-    ``masks`` may be a pytree or a zero-arg callable returning one — the
-    callable form binds late, so the reference's documented call order
-    (init optimizer BEFORE computing masks, asp.py:53-55) works: the chain
-    reads whatever masks exist at update time.
+    ``masks`` may be a pytree, a zero-arg callable returning one, or None
+    (all-ones). It is resolved once, at ``init`` time, and stored in the
+    optimizer STATE — so the reference's documented call order (init
+    optimizer BEFORE computing masks, asp.py:53-55) works as long as masks
+    are computed before ``opt.init``. Masks computed after ``opt.init``
+    (e.g. recomputed mid-training) must be pushed into the live state with
+    ``replace_masks(opt_state, masks)`` — because the masks are state data,
+    this works even on a train step that was jitted long before.
     """
 
-    def get_masks():
-        return masks() if callable(masks) else masks
-
     def init_fn(params):
-        del params
-        return optax.EmptyState()
+        m = masks() if callable(masks) else masks
+        if m is None:
+            m = jax.tree_util.tree_map(jnp.ones_like, params)
+        return MaskedState(masks=m)
 
     def update_fn(updates, state, params=None):
         if params is None:
             raise ValueError("masked_update requires params")
         new_updates = jax.tree_util.tree_map(
-            lambda u, p, m: m * u - (1.0 - m) * p, updates, params, get_masks()
+            lambda u, p, m: m * u - (1.0 - m) * p, updates, params, state.masks
         )
         return new_updates, state
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def replace_masks(opt_state: Any, masks: Any) -> Any:
+    """Return ``opt_state`` with every ``MaskedState`` swapped for the new
+    masks. Use after recomputing masks on a live (possibly jitted-over)
+    optimizer state; masks are state data so no retrace is needed."""
+    if isinstance(opt_state, MaskedState):
+        return MaskedState(masks=masks)
+    if isinstance(opt_state, tuple):
+        items = [replace_masks(s, masks) for s in opt_state]
+        if hasattr(opt_state, "_fields"):  # NamedTuple state
+            return type(opt_state)(*items)
+        return tuple(items)
+    return opt_state
 
 
 class ASP:
@@ -111,8 +138,25 @@ class ASP:
 
     def __init__(self):
         self._masks = None
+        self._computed = False
         self._calculator = "m4n2_1d"
         self._eligibility = default_eligibility
+
+    def _masks_for_init(self):
+        """Masks handed to ``opt.init``; loud when they are still the
+        all-ones placeholder so the reference call order cannot silently
+        train dense — the user must refresh_opt_state after computing."""
+        if not self._computed:
+            import warnings
+
+            warnings.warn(
+                "ASP: optimizer state initialized before "
+                "compute_sparse_masks — it holds all-ones placeholder "
+                "masks. Call asp.refresh_opt_state(opt_state) after "
+                "compute_sparse_masks or training stays dense.",
+                stacklevel=3,
+            )
+        return self._masks
 
     def init_model_for_pruning(
         self,
@@ -126,6 +170,7 @@ class ASP:
         if eligibility is not None:
             self._eligibility = eligibility
         self._masks = jax.tree_util.tree_map(jnp.ones_like, params)
+        self._computed = False
 
     def compute_sparse_masks(self, params: Any) -> Any:
         if self._masks is None:
@@ -133,6 +178,7 @@ class ASP:
         self._masks = compute_sparse_masks(
             params, self._calculator, self._eligibility
         )
+        self._computed = True
         return self._masks
 
     def init_optimizer_for_pruning(
@@ -140,9 +186,17 @@ class ASP:
     ) -> optax.GradientTransformation:
         if self._masks is None:
             raise RuntimeError("call init_model_for_pruning first")
-        # late-bound: masks computed AFTER this call (the reference's
-        # documented order) are picked up at update time
-        return optax.chain(optimizer, masked_update(lambda: self._masks))
+        # late-bound up to opt.init: masks computed AFTER this call but
+        # BEFORE opt.init (the reference's documented order) are picked up;
+        # masks computed after opt.init warn and need refresh_opt_state
+        return optax.chain(optimizer, masked_update(self._masks_for_init))
+
+    def refresh_opt_state(self, opt_state: Any) -> Any:
+        """Push the current masks into a live optimizer state (after a
+        mid-training compute_sparse_masks)."""
+        if self._masks is None:
+            raise RuntimeError("call init_model_for_pruning first")
+        return replace_masks(opt_state, self._masks)
 
     def prune_trained_model(self, params: Any) -> Any:
         """One-shot recipe (ref asp.py:292): compute masks + prune."""

@@ -57,23 +57,51 @@ def m4n2_1d(mat, density: float = 0.5):
     return mn_1d_best(mat, 4, 2)
 
 
+def compute_valid_2d_patterns(m: int, n: int) -> np.ndarray:
+    """All m x m 0/1 matrices with exactly n ones per row AND per column,
+    flattened to (P, m*m) (ref compute_valid_2d_patterns: the 2-D variant
+    enumerates doubly-balanced block patterns; 90 patterns for m=4, n=2)."""
+    if ("2d", m, n) in _PATTERN_CACHE:
+        return _PATTERN_CACHE[("2d", m, n)]
+    rows = compute_valid_1d_patterns(m, n)  # (C(m,n), m)
+    idx = np.array(list(itertools.product(range(len(rows)), repeat=m)))
+    mats = rows[idx]  # (C^m, m, m): every stacking of valid rows
+    valid = mats[(mats.sum(axis=1) == n).all(axis=1)]  # filter column sums
+    pats = valid.reshape(-1, m * m).astype(np.float32)
+    _PATTERN_CACHE[("2d", m, n)] = pats
+    return pats
+
+
+def mn_2d_best(matrix, m: int, n: int):
+    """Best m:n mask valid along BOTH of the last two dims: each m x m
+    block gets the doubly-balanced pattern maximizing retained |w|, so the
+    tensor and its transpose are both m:n sparse (fprop AND dgrad GEMMs)."""
+    *lead, r, c = matrix.shape
+    if r % m != 0 or c % m != 0:
+        raise ValueError(
+            f"last two dims ({r}, {c}) must both divide by m ({m}) "
+            "for the 2-D pattern"
+        )
+    pats = jnp.asarray(compute_valid_2d_patterns(m, n))  # (P, m*m)
+    a = jnp.abs(matrix.astype(jnp.float32))
+    blocks = a.reshape(*lead, r // m, m, c // m, m)
+    blocks = jnp.swapaxes(blocks, -3, -2)  # (..., r/m, c/m, m, m)
+    flat = blocks.reshape(-1, m * m)
+    scores = flat @ pats.T  # (G, P): retained |w| per block pattern
+    best = jnp.argmax(scores, axis=1)
+    mask = jnp.take(pats, best, axis=0)
+    mask = mask.reshape(*lead, r // m, c // m, m, m)
+    mask = jnp.swapaxes(mask, -3, -2).reshape(matrix.shape)
+    return mask
+
+
 def m4n2_2d_best(mat, density: float = 0.5):
-    """2-D 2:4: mask must hold for the tensor AND its transpose so both
-    fprop and the transposed dgrad GEMM are sparse (ref m4n2_2d_best).
-    Implemented as the reference's "best of 4x4 block patterns": for each
-    4x4 block choose the permutation-pair pattern maximizing retained |w|
-    among patterns valid in both directions — here approximated by
-    intersecting row-wise and column-wise best masks and repairing to
-    exactly 2/4 per row greedily, which preserves the 2:4 guarantee row-
-    wise (the hardware-relevant direction)."""
+    """2-D 2:4: the mask holds for the tensor AND its transpose so both
+    fprop and the transposed dgrad GEMM are sparse (ref m4n2_2d_best) —
+    exhaustive search over the 90 doubly-balanced 4x4 patterns per block,
+    matching the reference's 2-D enumeration rather than a greedy repair."""
     del density
-    row_mask = mn_1d_best(mat, 4, 2)
-    col_mask = jnp.swapaxes(mn_1d_best(jnp.swapaxes(mat, -1, -2), 4, 2), -1, -2)
-    both = row_mask * col_mask
-    # repair rows that lost entries: rerun 1d best on the masked weights,
-    # keeping already-agreed entries by boosting them
-    boosted = jnp.abs(mat) * (1.0 + both)
-    return mn_1d_best(boosted, 4, 2)
+    return mn_2d_best(mat, 4, 2)
 
 
 _CALCULATORS = {

@@ -17,8 +17,12 @@ import jax
 
 @functools.lru_cache(maxsize=None)
 def on_tpu() -> bool:
+    # The axon relay exposes the real chip under platform name "axon" with a
+    # TPU device_kind; treat any TPU-kind device as TPU so "auto" dispatches
+    # to compiled Mosaic kernels instead of silently falling back to XLA.
     try:
-        return jax.devices()[0].platform == "tpu"
+        dev = jax.devices()[0]
+        return dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
     except Exception:  # pragma: no cover
         return False
 

@@ -28,9 +28,26 @@ def fused_adam(
     eps: float = 1e-8,
     adam_w_mode: bool = True,
     weight_decay: float = 0.0,
+    fuse: str = "tree",
 ) -> optax.GradientTransformation:
-    """Optax transform matching amp_C.multi_tensor_adam semantics."""
+    """Optax transform matching amp_C.multi_tensor_adam semantics.
+
+    ``fuse`` selects the update engine:
+    - ``"tree"``: per-leaf tree_map math, fused by XLA inside the caller's
+      jit (the default — measured competitive, see BENCH.md);
+    - ``"flat"``: the reference's multi_tensor design — moments live in one
+      CHUNK_SIZE-padded fp32 buffer and a single Pallas kernel
+      (``_fused_kernels.adam_flat``) updates everything per step.
+    """
     beta1, beta2 = betas
+    if fuse not in ("tree", "flat"):
+        raise ValueError(f"unknown fuse mode {fuse!r}; expected tree|flat")
+
+    def _bias_corrections(stepf):
+        if bias_correction:
+            return 1.0 - beta1**stepf, 1.0 - beta2**stepf
+        one = jnp.asarray(1.0, jnp.float32)
+        return one, one
 
     def init_fn(params):
         zeros = lambda t: jax.tree_util.tree_map(
@@ -44,12 +61,7 @@ def fused_adam(
         if params is None:
             raise ValueError("fused_adam requires params")
         step = state.step + 1
-        stepf = step.astype(jnp.float32)
-        if bias_correction:
-            bc1 = 1.0 - beta1**stepf
-            bc2 = 1.0 - beta2**stepf
-        else:
-            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        bc1, bc2 = _bias_corrections(step.astype(jnp.float32))
 
         def _g(g, p):
             gf = g.astype(jnp.float32)
@@ -74,6 +86,42 @@ def fused_adam(
         updates = jax.tree_util.tree_map(_upd, params, m, v)
         return updates, FusedAdamState(step=step, exp_avg=m, exp_avg_sq=v)
 
+    def flat_init_fn(params):
+        from apex_tpu.ops.multi_tensor import CHUNK_SIZE
+
+        # padded length from shapes alone — no transient fp32 flat copy
+        total = sum(
+            int(x.size) for x in jax.tree_util.tree_leaves(params)
+        )
+        padded = max(CHUNK_SIZE, -(-total // CHUNK_SIZE) * CHUNK_SIZE)
+        zeros = jnp.zeros((padded,), jnp.float32)
+        return FusedAdamState(
+            step=jnp.zeros((), jnp.int32), exp_avg=zeros, exp_avg_sq=zeros
+        )
+
+    def flat_update_fn(grads, state, params=None):
+        from apex_tpu.optimizers._fused_kernels import adam_flat
+        from apex_tpu.ops.multi_tensor import flatten_pytree, unflatten_pytree
+
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        step = state.step + 1
+        bc1, bc2 = _bias_corrections(step.astype(jnp.float32))
+        g_flat, _ = flatten_pytree(grads, dtype=jnp.float32)
+        p_flat, spec = flatten_pytree(params, dtype=jnp.float32)
+        upd_flat, m_flat, v_flat = adam_flat(
+            g_flat, p_flat, state.exp_avg, state.exp_avg_sq, bc1, bc2,
+            lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+            weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+        )
+        # spec carries params' dtypes, so updates cast back per leaf
+        updates = unflatten_pytree(upd_flat, spec)
+        return updates, FusedAdamState(
+            step=step, exp_avg=m_flat, exp_avg_sq=v_flat
+        )
+
+    if fuse == "flat":
+        return optax.GradientTransformation(flat_init_fn, flat_update_fn)
     return optax.GradientTransformation(init_fn, update_fn)
 
 

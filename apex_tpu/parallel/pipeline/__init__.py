@@ -30,6 +30,7 @@ from apex_tpu.parallel.pipeline.p2p import (
     recv_backward,
     send_forward_recv_forward,
     send_backward_recv_backward,
+    ring_forward,
     ring_send_last_to_first,
 )
 from apex_tpu.parallel.pipeline.schedules import (
@@ -39,6 +40,7 @@ from apex_tpu.parallel.pipeline.schedules import (
     forward_backward_with_pre_post,
     get_forward_backward_func,
     pipeline_forward,
+    pipeline_forward_interleaved,
     build_model,
 )
 
@@ -57,6 +59,7 @@ __all__ = [
     "recv_backward",
     "send_forward_recv_forward",
     "send_backward_recv_backward",
+    "ring_forward",
     "ring_send_last_to_first",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_without_interleaving",
@@ -64,5 +67,6 @@ __all__ = [
     "forward_backward_with_pre_post",
     "get_forward_backward_func",
     "pipeline_forward",
+    "pipeline_forward_interleaved",
     "build_model",
 ]

@@ -62,6 +62,17 @@ def send_backward_recv_backward(g: Any, axis_name: str = "pp") -> Any:
     return _permute(g, axis_name, perm)
 
 
+def ring_forward(x: Any, axis_name: str = "pp") -> Any:
+    """Full ring shift: rank r receives rank r-1's ``x``, rank 0 receives
+    rank P-1's. The interleaved schedule uses this single collective for
+    both edge kinds each tick — same-chunk hops (r → r+1) and the
+    chunk-advance wrap (P-1 → 0), which carries a microbatch from chunk v
+    on the last rank to chunk v+1 on rank 0."""
+    n = _pp_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return _permute(x, axis_name, perm)
+
+
 def ring_send_last_to_first(x: Any, axis_name: str = "pp") -> Any:
     """Close the pipeline ring: the last stage's ``x`` arrives at stage 0,
     everyone else receives zeros. Used by the circular (virtual-PP) schedule

@@ -28,8 +28,10 @@ so the schedule becomes a ``lax.scan`` over T = M + P - 1 clock ticks inside
   asymptotics;
 - the interleaved schedule maps virtual-PP chunk v on rank r to global
   stage v*P + r exactly like the reference's chunk-id mapping
-  (fwd_bwd_pipelining_with_interleaving.py:221-259), executed as V circular
-  passes chained by a last→first ring edge.
+  (fwd_bwd_pipelining_with_interleaving.py:221-259), executed as ONE scan
+  over V*M + P - 1 ticks of one-chunk work each — bubble fraction
+  (P-1)/(V*M + P - 1), the non-interleaved bubble shrunk by 1/V
+  (see pipeline_forward_interleaved).
 
 All schedule functions must run inside ``shard_map`` over ``axis_name``.
 ``stage_fn(params, x) -> y`` must be shape-uniform (y like x); embedding /
@@ -112,25 +114,108 @@ def pipeline_forward(
     return outputs
 
 
+def pipeline_forward_interleaved(
+    stage_fn: Callable[[Any, Any], Any],
+    params_chunks: Any,
+    microbatches: Any,
+    *,
+    num_model_chunks: int,
+    axis_name: str = "pp",
+    remat: bool = True,
+) -> Any:
+    """Genuinely interleaved virtual-PP forward: ONE scan over
+    T = V*M + P - 1 ticks, one chunk-computation per rank per tick.
+
+    Chunk v on rank r implements global stage v*P + r (the reference's
+    chunk-id map, fwd_bwd_pipelining_with_interleaving.py:221-259), and the
+    per-rank work order is the reference's group-of-P depth-first pattern:
+    microbatch group k = (kP..kP+P-1) runs chunks 0..V-1 before group k+1
+    starts. Rank r processes, at tick t with u = t - r:
+        k = u // (V*P), v = (u % (V*P)) // P, m = k*P + u % P.
+    Each produced activation is consumed exactly one tick later by the next
+    global stage — same-chunk hop (rank r+1) or the ring wrap (rank 0,
+    chunk v+1) — so every tick ships ONE ring ppermute.
+
+    Per-tick work is one chunk = 1/V of a rank's layers, and only P - 1 of
+    the V*M + P - 1 ticks are bubble — bubble fraction (P-1)/(V*M + P - 1),
+    i.e. the reference's ≈(P-1)/M shrunk by 1/V, unlike V sequential passes
+    (V*(M + P - 1) ticks, bubble unchanged). Requires M % P == 0, as the
+    reference asserts (:118).
+
+    Returns last-stage outputs (leading dim M), valid on rank P-1 only.
+    """
+    num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
+    rank = jax.lax.axis_index(axis_name)
+    num_micro = _leading_dim(microbatches)
+    V = num_model_chunks
+    if num_micro % num_stages != 0:
+        raise ValueError(
+            f"interleaved schedule requires num_microbatches ({num_micro}) "
+            f"% pipeline size ({num_stages}) == 0"
+        )
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    mb0 = _index(microbatches, 0)
+    p0 = jax.tree_util.tree_map(lambda a: a[0], params_chunks)
+    out_shape = jax.eval_shape(stage_fn, p0, mb0)
+    state0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), out_shape
+    )
+    outbuf0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros((num_micro,) + s.shape, s.dtype), out_shape
+    )
+
+    def tick(carry, t):
+        state, outbuf = carry
+        recv = p2p.ring_forward(state, axis_name)
+        u = t - rank
+        uc = jnp.clip(u, 0, V * num_micro - 1)
+        v = (uc % (V * num_stages)) // num_stages
+        m = (uc // (V * num_stages)) * num_stages + uc % num_stages
+        # fresh input only where the stream enters the model: rank 0, chunk 0
+        takes_input = (rank == 0) & (v == 0)
+        mb = _index(microbatches, m)
+        x = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(takes_input, a, b), mb, recv
+        )
+        pv = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            params_chunks,
+        )
+        y = body(pv, x)
+        # the final global stage V*P - 1 lives on rank P-1, chunk V-1
+        is_out = (
+            (u >= 0) & (u < V * num_micro)
+            & (rank == num_stages - 1) & (v == V - 1)
+        )
+
+        def update(buf, leaf):
+            old = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
+            new = jnp.where(is_out, leaf, old)
+            return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
+
+        outbuf = jax.tree_util.tree_map(update, outbuf, y)
+        return (y, outbuf), None
+
+    ticks = jnp.arange(V * num_micro + num_stages - 1)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outbuf0), ticks)
+    return outputs
+
+
 def _stages_forward(
     stage_fn, stages_params, h, *, axis_name: str, remat: bool,
     num_model_chunks: int,
 ):
-    """Forward through this rank's chunk(s): one pipeline pass, or V
-    circular passes chained by the last→first ring edge (chunk v on rank r
-    = global stage v*P + r, the reference's interleaved chunk-id map)."""
+    """Forward through this rank's chunk(s): the plain pipeline for V=1,
+    the single-scan interleaved schedule for V>1."""
     if num_model_chunks == 1:
         return pipeline_forward(
             stage_fn, stages_params, h, axis_name=axis_name, remat=remat
         )
-    outs = None
-    x = h
-    for v in range(num_model_chunks):
-        pv = jax.tree_util.tree_map(lambda a, _v=v: a[_v], stages_params)
-        outs = pipeline_forward(stage_fn, pv, x, axis_name=axis_name, remat=remat)
-        if v < num_model_chunks - 1:
-            x = p2p.ring_send_last_to_first(outs, axis_name)
-    return outs
+    return pipeline_forward_interleaved(
+        stage_fn, stages_params, h, num_model_chunks=num_model_chunks,
+        axis_name=axis_name, remat=remat,
+    )
 
 
 def _publish_losses(per_microbatch_losses, axis_name: str):

@@ -12,6 +12,8 @@ Prints exactly ONE JSON line:
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -76,11 +78,13 @@ def measure(dtype, batch, image_size, warmup=3, iters=10):
     return batch * iters / dt
 
 
-def main():
-    dev = jax.devices()[0]
-    # the axon relay exposes the real chip under platform name "axon"
-    on_tpu = dev.platform in ("tpu", "axon") or "TPU" in (dev.device_kind or "")
-    if on_tpu:
+def run_bench():
+    if os.environ.get("APEX_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+    from apex_tpu.ops._dispatch import on_tpu as _on_tpu
+
+    jax.devices()  # force backend init (raises here on failure, not mid-bench)
+    if _on_tpu():  # recognizes both "tpu" and the axon relay platform
         batch, image_size, iters = 256, 224, 20
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image_size, iters = 8, 32, 2
@@ -98,6 +102,61 @@ def main():
             }
         )
     )
+    return 0
+
+
+def main():
+    """Supervisor: run the measurement in a child process, retrying on
+    backend-init failure with a fresh process each time (a failed axon init
+    is cached inside a JAX process, and a hung child must be killed so it
+    cannot keep holding the chip). Round 1 died on one transient
+    ``Unable to initialize backend 'axon'`` with no retry — never again.
+    Always emits exactly one JSON line (CPU smoke as the last resort)."""
+    if "--run" in sys.argv:
+        return run_bench()
+
+    def attempt(extra_env=None, timeout=2400):
+        env = dict(os.environ, **(extra_env or {}))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run"],
+                capture_output=True, text=True, timeout=timeout, env=env,
+            )
+        except subprocess.TimeoutExpired as e:  # child killed -> chip freed
+            sys.stderr.write(f"[bench] child timed out after {timeout}s\n")
+            if e.stderr:
+                sys.stderr.write(e.stderr[-2000:] if isinstance(e.stderr, str) else "")
+            return None
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rec = json.loads(line)
+                if isinstance(rec, dict) and "metric" in rec:
+                    return rec
+            except ValueError:
+                continue
+        sys.stderr.write(
+            f"[bench] child rc={proc.returncode}; stderr tail:\n"
+            + proc.stderr[-3000:] + "\n"
+        )
+        return None
+
+    for i in range(3):
+        rec = attempt()
+        if rec is not None:
+            print(json.dumps(rec))
+            return 0
+        sys.stderr.write(f"[bench] attempt {i + 1}/3 failed; retrying\n")
+        time.sleep(15 * (i + 1))
+
+    sys.stderr.write("[bench] TPU unavailable after 3 attempts; CPU smoke fallback\n")
+    rec = attempt(extra_env={"APEX_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+                  timeout=900)
+    if rec is not None:
+        rec["platform"] = "cpu_fallback"
+        print(json.dumps(rec))
+        return 0
+    sys.stderr.write("[bench] CPU fallback also failed\n")
+    return 1
 
 
 if __name__ == "__main__":

@@ -27,6 +27,48 @@ jax.config.update("jax_enable_x64", False)
 
 import pytest  # noqa: E402
 
+# Slow tier (measured >=8 s each on the CPU mesh, ~430 s of the ~750 s
+# suite): excluded from the smoke run. Central list instead of per-file
+# decorators so the tier stays auditable in one place.
+#   smoke: python -m pytest tests/ -q -m "not slow"   (~5 min serial)
+#   fast:  python -m pytest tests/ -q -m "not slow" -n 4
+#   full:  python -m pytest tests/ -q
+_SLOW_TESTS = {
+    "test_post_params_stay_replicated_under_sp",
+    "test_matches_sequential_composition",
+    "test_bert_sp_loss_and_grads_match_non_sp",
+    "test_tp8_loss_decreases",
+    "test_selective_remat_matches_plain",
+    "test_tp8_sequence_parallel_loss_decreases",
+    "test_loss_decreases",
+    "test_gradients_flow_through_halo",
+    "test_layer_with_moe_mlp",
+    "test_sp_matches_non_sp",
+    "test_forward_shapes",
+    "test_forward_shape_and_dtype",
+    "test_train_updates_batch_stats_and_loss_decreases",
+    "test_ep_matches_local",
+    "test_pp_tp_sp_training_converges",
+    "test_syncbn_dp_matches_single_device_global_batch",
+    "test_matches_unsharded",
+    "test_gpt_ring_cp_matches_single_device",
+    "test_inner_blocking_matches",
+    "test_grad_flows",
+    "test_remat_matches_plain",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: >=8s on the CPU mesh; excluded by -m 'not slow'"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.originalname in _SLOW_TESTS or item.name in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def rng():

@@ -61,6 +61,47 @@ class TestFusedAdam:
             ref,
         )
 
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    @pytest.mark.parametrize("wd", [0.0, 0.1])
+    def test_flat_engine_matches_tree(self, rng, wd, impl, monkeypatch):
+        """fuse="flat" (one Pallas kernel over the padded flat buffer, ref
+        csrc/multi_tensor_adam.cu) matches the tree_map engine bit-for-bit
+        in fp32."""
+        import apex_tpu.optimizers._fused_kernels as fk
+
+        monkeypatch.setattr(
+            fk, "resolve_impl",
+            lambda _: (impl == "pallas", impl == "pallas"),
+        )
+        params = _params(rng)
+        gkey = jax.random.PRNGKey(7)
+        grads_fn = lambda i, p: jax.tree_util.tree_map(
+            lambda x: jax.random.normal(jax.random.fold_in(gkey, i), x.shape), p
+        )
+        tree = _run(fused_adam(lr=1e-2, weight_decay=wd), dict(params), grads_fn)
+        flat = _run(
+            fused_adam(lr=1e-2, weight_decay=wd, fuse="flat"),
+            dict(params), grads_fn,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            tree, flat,
+        )
+
+    def test_flat_l2norm_matches(self, rng):
+        from apex_tpu.ops.multi_tensor import flatten_pytree
+        from apex_tpu.optimizers._fused_kernels import l2norm_flat
+
+        params = _params(rng)
+        flat, _ = flatten_pytree(params, dtype=jnp.float32)
+        ref = float(jnp.sqrt(sum(
+            jnp.sum(jnp.square(x)) for x in jax.tree_util.tree_leaves(params)
+        )))
+        np.testing.assert_allclose(float(l2norm_flat(flat, impl="xla")), ref, rtol=1e-6)
+        np.testing.assert_allclose(float(l2norm_flat(flat, impl="pallas")), ref, rtol=1e-6)
+
     def test_l2_mode(self, rng):
         # adam_w_mode=False folds wd into the gradient (L2), diverging from adamw
         params = _params(rng)

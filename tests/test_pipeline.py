@@ -227,6 +227,75 @@ class TestPipelineSchedules:
                 grads[k], ref_stacked, rtol=1e-4, atol=1e-5
             )
 
+    def test_interleaved_bubble_shrinks_with_v(self, rng):
+        """The point of virtual PP (ref fwd_bwd_pipelining_with_
+        interleaving.py:27): bubble ticks stay P-1 while useful ticks grow
+        to V*M, so the bubble FRACTION shrinks by 1/V. Assert on the
+        compiled scan length: exactly V*M + P - 1 ticks of one-chunk work,
+        not the V*(M + P - 1) of V sequential full passes."""
+        from apex_tpu.parallel.pipeline.schedules import (
+            pipeline_forward_interleaved,
+        )
+
+        pp, num_micro = 2, 4
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+
+        def scan_lengths(jaxpr):
+            out = []
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name == "scan":
+                    out.append(eqn.params["length"])
+                for sub in jax.core.jaxprs_in_params(eqn.params):
+                    out.extend(scan_lengths(sub))
+            return out
+
+        for vpp in (2, 4):
+            params = {
+                "w": jax.random.normal(rng, (vpp, HID, HID)),
+                "b": jnp.zeros((vpp, HID)),
+            }
+            mbs = jnp.zeros((num_micro, MICRO_B, HID))
+
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                check_vma=False,
+            )
+            def run(chunks, mbs, _v=vpp):
+                return pipeline_forward_interleaved(
+                    stage_fn, chunks, mbs, num_model_chunks=_v,
+                    axis_name="pp", remat=False,
+                )
+
+            lengths = scan_lengths(jax.make_jaxpr(run)(params, mbs))
+            assert lengths == [vpp * num_micro + pp - 1]
+
+    def test_interleaved_requires_divisible_microbatches(self, rng):
+        pp, vpp = 2, 2
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+        params = {
+            "w": jax.random.normal(rng, (vpp, HID, HID)),
+            "b": jnp.zeros((vpp, HID)),
+        }
+        mbs = jnp.zeros((3, MICRO_B, HID))  # 3 % 2 != 0
+        targets = jnp.zeros((3, MICRO_B, HID))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+            out_specs=(P(), P(), P()), check_vma=False,
+        )
+        def run(chunks, mbs, targets):
+            return forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, chunks, mbs, targets,
+                num_model_chunks=vpp, axis_name="pp",
+            )
+
+        with pytest.raises(ValueError, match="interleaved schedule requires"):
+            run(params, mbs, targets)
+
     def test_no_pipelining_grad_accumulation(self, rng):
         params = {"w": jax.random.normal(rng, (HID, HID))}
         mbs = jax.random.normal(jax.random.fold_in(rng, 1), (4, MICRO_B, HID))

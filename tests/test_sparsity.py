@@ -53,6 +53,41 @@ class TestMaskLib:
         mask = m4n2_2d_best(x)
         assert (np.asarray(mask).reshape(-1, 4).sum(axis=1) == 2).all()
 
+    def test_2d_best_is_valid_both_directions(self, rng):
+        """The 2-D variant's whole purpose: the transpose (dgrad GEMM
+        direction) is also 2:4 sparse (ref m4n2_2d_best)."""
+        x = jax.random.normal(rng, (16, 24))
+        mask = np.asarray(m4n2_2d_best(x))
+        assert (mask.reshape(-1, 4).sum(axis=1) == 2).all()  # row-wise
+        # column-wise: within each 4x4 block every column keeps exactly 2
+        blocks = mask.reshape(4, 4, 6, 4).transpose(0, 2, 1, 3)
+        assert (blocks.sum(axis=2) == 2).all()
+
+    def test_2d_best_maximizes_retained_magnitude_per_block(self):
+        # a block where the greedy row-then-repair approach is suboptimal:
+        # exhaustive search must pick the doubly-balanced argmax
+        from apex_tpu.contrib.sparsity import mn_2d_best
+        from apex_tpu.contrib.sparsity.sparse_masklib import (
+            compute_valid_2d_patterns,
+        )
+
+        rngn = np.random.RandomState(3)
+        for _ in range(5):
+            blk = rngn.randn(4, 4).astype(np.float32)
+            mask = np.asarray(mn_2d_best(jnp.asarray(blk), 4, 2))
+            pats = compute_valid_2d_patterns(4, 2).reshape(-1, 4, 4)
+            best = max(float(np.sum(np.abs(blk) * p)) for p in pats)
+            got = float(np.sum(np.abs(blk) * mask))
+            assert got == pytest.approx(best, rel=1e-6)
+
+    def test_2d_pattern_count(self):
+        from apex_tpu.contrib.sparsity.sparse_masklib import (
+            compute_valid_2d_patterns,
+        )
+
+        # doubly-balanced 4x4 matrices with row/col sums 2: exactly 90
+        assert compute_valid_2d_patterns(4, 2).shape == (90, 16)
+
     def test_fill(self):
         assert fill(jnp.array([[1.0, 0.0], [0.0, 0.0]])) == 0.25
 
@@ -158,6 +193,36 @@ class TestASPRegression:
         k = np.asarray(params["dense"]["kernel"])
         zero_pat = np.asarray(asp.masks["dense"]["kernel"]) == 0
         np.testing.assert_array_equal(k[zero_pat], 0.0)
+
+    def test_masks_recomputed_after_jit_are_seen(self, rng):
+        """Masks live in the optimizer STATE, so a step jitted before
+        compute_sparse_masks still applies masks pushed in later via
+        refresh_opt_state (the round-1 closure-constant hazard)."""
+        from apex_tpu.contrib.sparsity import replace_masks
+
+        params = {"dense": {"kernel": jax.random.normal(rng, (32, 16))}}
+        asp = ASP()
+        asp.init_model_for_pruning(params)
+        opt = asp.init_optimizer_for_pruning(optax.sgd(0.1))
+        state = opt.init(params)  # masks still all-ones here
+
+        @jax.jit
+        def step(params, state):
+            grads = jax.tree_util.tree_map(jnp.ones_like, params)
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state
+
+        params, state = step(params, state)  # trace with all-ones masks
+        asp.compute_sparse_masks(params)
+        params = prune(params, asp.masks)
+        state = asp.refresh_opt_state(state)
+        params, state = step(params, state)  # same trace, new masks
+        k = np.asarray(params["dense"]["kernel"])
+        zero_pat = np.asarray(asp.masks["dense"]["kernel"]) == 0
+        np.testing.assert_array_equal(k[zero_pat], 0.0)
+        # replace_masks is a no-op on states without a MaskedState
+        plain = optax.sgd(0.1).init(params)
+        assert replace_masks(plain, asp.masks) == plain
 
     def test_embeddings_never_pruned(self, rng):
         params = {
