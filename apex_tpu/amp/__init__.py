@@ -15,6 +15,9 @@ optimizer object — amp is a *policy* plus *pure state*:
   cannot exist under jit).
 - ``LossScaler`` is a pytree state machine with the reference's dynamic-scale
   schedule (x2 after 2000 clean steps, /2 on overflow; amp/scaler.py:197-217).
+- O1's per-op cast lists are real: ``cast_ops`` patches jnp/lax/jax.nn with
+  FP16/FP32/promote wrappers (apex/amp/lists/torch_overrides.py semantics)
+  while a policy context is active — see amp/cast_engine.py.
 - bf16 is the default half dtype on TPU (fp16 remains available for parity
   experiments).
 """
@@ -35,8 +38,10 @@ from apex_tpu.amp.scaler import (
     unscale_grads,
 )
 from apex_tpu.amp.grad_scaler import GradScaler
+from apex_tpu.amp.cast_engine import cast_ops
 
 __all__ = [
+    "cast_ops",
     "Policy",
     "O0",
     "O1",

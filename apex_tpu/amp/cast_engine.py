@@ -1,0 +1,172 @@
+"""O1 per-op cast engine: namespace patching over jnp/lax/jax.nn.
+
+Reference parity: apex/amp/amp.py:13-120 (init patches the torch namespace
+with casting wrappers) + apex/amp/wrap.py:10-80 (make_cast_wrapper /
+make_promote_wrapper).  The reference installs wrappers once and gates them
+on ``handle.is_active()``; here ``cast_ops(half_dtype)`` is a context
+manager that installs on (outermost) enter and restores on (outermost) exit
+— within jit, whatever was traced inside the context keeps its casts
+compiled in, exactly like a torch function called while the amp handle was
+active.
+
+Autodiff falls out for free: every cast is ``astype``, whose VJP is a cast
+back, so gradients arrive in each input's original dtype — the reference
+asserts the same (test_basic_casts.py run_layer_test: ``x.grad.type() ==
+MATCH_INPUT[typ]``).
+"""
+
+import contextlib
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import cast_lists
+
+_HALF_DTYPES = (jnp.float16, jnp.bfloat16)
+
+
+class _State:
+    """Process-global, like the reference's single amp handle: the patches
+    land on shared modules, so depth/saved must be global too — per-thread
+    bookkeeping over global patching would let one thread's exit strip
+    another thread's active casts (and leak wrappers). ``lock`` serializes
+    enter/exit; the wrappers themselves only read ``depth``."""
+
+    def __init__(self):
+        self.depth = 0
+        self.half_dtype = None
+        self.saved = []  # [(module, name, original)]
+        self.lock = threading.RLock()
+
+
+_state = _State()
+
+
+def _is_float(x):
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _tree_cast(tree, convert):
+    """Apply ``convert`` to float array leaves of (args, kwargs) pytrees;
+    everything else (ints, bools, None, strings, shapes) passes through."""
+    return jax.tree_util.tree_map(
+        lambda x: convert(x) if _is_float(x) else x, tree
+    )
+
+
+def _to_half(x):
+    return x.astype(_state.half_dtype) if x.dtype == jnp.float32 else x
+
+
+def _to_float(x):
+    return x.astype(jnp.float32) if x.dtype in _HALF_DTYPES else x
+
+
+def _make_cast_wrapper(orig, convert):
+    """Ref wrap.make_cast_wrapper (wrap.py:10-29): cast float args, call."""
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if _state.depth == 0:  # context exited but a stale ref survived
+            return orig(*args, **kwargs)
+        args, kwargs = _tree_cast((args, kwargs), convert)
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped_by_apex_tpu_amp__ = True
+    return wrapper
+
+
+def _make_promote_wrapper(orig):
+    """Ref wrap.make_promote_wrapper (wrap.py:45-66): if the float inputs
+    mix half and fp32, cast the halves up; single-type calls untouched.
+    Sequence args (concatenate/stack lists) flatten into the same pytree
+    walk, subsuming the reference's separate sequence_promote."""
+
+    @functools.wraps(orig)
+    def wrapper(*args, **kwargs):
+        if _state.depth == 0:
+            return orig(*args, **kwargs)
+        leaves = [
+            x for x in jax.tree_util.tree_leaves((args, kwargs)) if _is_float(x)
+        ]
+        dtypes = {x.dtype for x in leaves}
+        if jnp.dtype(jnp.float32) in dtypes and dtypes & set(
+            jnp.dtype(d) for d in _HALF_DTYPES
+        ):
+            args, kwargs = _tree_cast((args, kwargs), _to_float)
+        return orig(*args, **kwargs)
+
+    wrapper.__wrapped_by_apex_tpu_amp__ = True
+    return wrapper
+
+
+def _make_half_output_wrapper(orig):
+    """Layer-level ALWAYS_HALF (ref: wrapping torch.conv2d / F.linear whole,
+    bias add included): float32 outputs of an MXU-bound flax layer come out
+    half even though the trailing bias add ran fp32."""
+
+    @functools.wraps(orig)
+    def wrapper(self, *args, **kwargs):
+        out = orig(self, *args, **kwargs)
+        if _state.depth == 0:
+            return out
+        return _tree_cast(out, _to_half)
+
+    wrapper.__wrapped_by_apex_tpu_amp__ = True
+    return wrapper
+
+
+def _patch():
+    for mod, name in cast_lists.FP16_FUNCS:
+        orig = getattr(mod, name)
+        _state.saved.append((mod, name, orig))
+        setattr(mod, name, _make_cast_wrapper(orig, _to_half))
+    for cls, name in cast_lists.FP16_MODULE_CALLS:
+        orig = getattr(cls, name)
+        _state.saved.append((cls, name, orig))
+        setattr(cls, name, _make_half_output_wrapper(orig))
+    for mod, name in cast_lists.FP32_FUNCS:
+        orig = getattr(mod, name)
+        _state.saved.append((mod, name, orig))
+        setattr(mod, name, _make_cast_wrapper(orig, _to_float))
+    for mod, name in cast_lists.PROMOTE_FUNCS + cast_lists.SEQUENCE_CASTS:
+        orig = getattr(mod, name)
+        _state.saved.append((mod, name, orig))
+        setattr(mod, name, _make_promote_wrapper(orig))
+
+
+def _unpatch():
+    for mod, name, orig in reversed(_state.saved):
+        setattr(mod, name, orig)
+    _state.saved.clear()
+
+
+@contextlib.contextmanager
+def cast_ops(half_dtype=jnp.bfloat16):
+    """Activate per-op O1 casting (ref: the active amp handle, amp.py:118).
+
+    Reentrant; nested contexts must agree on the half dtype (the reference
+    has one global handle and the same constraint implicitly).
+    """
+    with _state.lock:
+        if _state.depth > 0 and jnp.dtype(half_dtype) != jnp.dtype(
+            _state.half_dtype
+        ):
+            raise ValueError(
+                f"nested cast_ops with different half dtypes: "
+                f"{_state.half_dtype} active, {half_dtype} requested"
+            )
+        if _state.depth == 0:
+            _state.half_dtype = jnp.dtype(half_dtype)
+            _patch()
+        _state.depth += 1
+    try:
+        yield
+    finally:
+        with _state.lock:
+            _state.depth -= 1
+            if _state.depth == 0:
+                _unpatch()
+                _state.half_dtype = None

@@ -1,0 +1,129 @@
+"""Per-op cast lists for the O1 policy.
+
+Reference parity: apex/amp/lists/torch_overrides.py:7-117 — the three
+categories the reference patches onto the torch namespace:
+
+- FP16_FUNCS (whitelist): tensor-core math — convs and BLAS — runs in half.
+- FP32_FUNCS (blacklist): numerically-sensitive pointwise ops (exp/log/pow
+  family) and reductions run in fp32.
+- CASTS / SEQUENCE_CASTS (promote): multi-input math where mixed half+float
+  inputs are promoted to the widest type before the op.
+
+TPU translation: the namespaces to patch are ``jax.numpy`` / ``jax.lax`` /
+``jax.nn`` instead of ``torch`` — patching ``lax.dot_general`` and
+``lax.conv_general_dilated`` covers every flax layer the way patching
+``torch.conv2d``/``addmm`` covers every ``nn`` module (the reference's own
+note, torch_overrides.py:8-10).  bf16 needs the fp32 blacklist less than
+fp16 does (8 exponent bits), but the contract is kept identical for both so
+O1 behaves the same regardless of half dtype.
+
+Each entry is ``(module, attr_name)``; the engine (cast_engine.py) swaps the
+attribute for a casting wrapper while a policy context is active.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import nn as jnn
+from jax.scipy import special as jsp_special
+
+# Tensor-core (MXU) math -> half.  Ref FP16_FUNCS: conv*, addmm/matmul/mm/mv
+# (torch_overrides.py:7-27).  lax.dot_general / conv_general_dilated are the
+# primitives every jnp/flax matmul and conv lowers through.
+FP16_FUNCS = [
+    (lax, "dot_general"),
+    (lax, "conv_general_dilated"),
+    (lax, "conv"),
+    (lax, "conv_with_general_padding"),
+    (lax, "conv_transpose"),
+    (jnp, "matmul"),
+    (jnp, "dot"),
+    (jnp, "vdot"),
+    (jnp, "inner"),
+    (jnp, "outer"),
+    (jnp, "tensordot"),
+    (jnp, "einsum"),
+]
+
+# Numerically-sensitive -> fp32.  Ref FP32_FUNCS (torch_overrides.py:29-60):
+# the exp/log/trig/pow pointwise family plus reductions.
+FP32_FUNCS = [
+    (jnp, "exp"),
+    (jnp, "expm1"),
+    (jnp, "log"),
+    (jnp, "log1p"),
+    (jnp, "log2"),
+    (jnp, "log10"),
+    (jnp, "cosh"),
+    (jnp, "sinh"),
+    (jnp, "tan"),
+    (jnp, "arccos"),
+    (jnp, "arcsin"),
+    (jnp, "reciprocal"),
+    (jnp, "power"),
+    (jnp, "float_power"),
+    (jnp, "cumprod"),
+    (jnp, "cumsum"),
+    (jnp, "prod"),
+    (jnp, "sum"),
+    (jnp, "std"),
+    (jnp, "var"),
+    (jnp.linalg, "norm"),
+    (lax, "rsqrt"),
+    (jnn, "softmax"),
+    (jnn, "log_softmax"),
+    (jsp_special, "erfinv"),
+    (jax.scipy.special, "logsumexp"),
+]
+
+# Promote-to-widest on mixed half/float inputs.  Ref CASTS
+# (torch_overrides.py:89-108): addcdiv/addcmul/atan2/cross + elementwise
+# add/div/mul + comparisons.  jnp's own promotion already yields the widest
+# float for mixed inputs; patching keeps the behavior explicit and identical
+# even if callers disable jax's implicit promotion (jax_numpy_dtype_promotion
+# = 'strict', where mixed-dtype arithmetic raises instead of promoting).
+PROMOTE_FUNCS = [
+    (jnp, "add"),
+    (jnp, "subtract"),
+    (jnp, "multiply"),
+    (jnp, "divide"),
+    (jnp, "true_divide"),
+    (jnp, "arctan2"),
+    (jnp, "cross"),
+    (jnp, "equal"),
+    (jnp, "not_equal"),
+    (jnp, "greater"),
+    (jnp, "greater_equal"),
+    (jnp, "less"),
+    (jnp, "less_equal"),
+    (jnp, "maximum"),
+    (jnp, "minimum"),
+    (jnp, "where"),
+]
+
+# Sequence versions (ref SEQUENCE_CASTS: cat/stack, torch_overrides.py:110-115).
+# The generic promote wrapper flattens the sequence argument as a pytree, so
+# these share its implementation.
+SEQUENCE_CASTS = [
+    (jnp, "concatenate"),
+    (jnp, "stack"),
+    (jnp, "hstack"),
+    (jnp, "vstack"),
+]
+
+# Layer-level half outputs.  The reference wraps the whole functional layer
+# (torch.conv2d / F.linear include the bias add), so a Linear's output is
+# ALWAYS_HALF.  Patching only lax.dot_general leaves flax's trailing
+# ``y + bias`` (fp32 bias) to promote the result back up — so the flax matmul
+# layers additionally get an output->half wrapper on __call__.
+import flax.linen as _fnn  # noqa: E402
+
+FP16_MODULE_CALLS = [
+    (cls, "__call__")
+    for cls in (
+        getattr(_fnn, name, None)
+        for name in ("Dense", "DenseGeneral", "Einsum", "Conv", "ConvTranspose",
+                     "ConvLocal", "MultiHeadDotProductAttention")
+    )
+    if cls is not None
+]

@@ -8,13 +8,17 @@ four opt levels (frontend.py:104-193):
 - O2: model cast to half, BN kept fp32, fp32 master weights, dynamic scale
 - O3: pure half (speed baseline)
 
-TPU design: there is no torch namespace to patch, so O1's cast lists become a
-*compute dtype* contract — parameters stay fp32 and ``wrap_apply`` casts
-inputs (and, inside flax models, the modules' ``dtype`` argument casts
-compute) to the half type; this is exactly the behavioral contract of the O1
-whitelist (GEMMs/convs in half, reductions in fp32 — our fused ops always
-accumulate fp32, see apex_tpu/ops). The default half dtype is bfloat16 (no
-loss scaling needed) with float16 available for parity.
+TPU design: O1's per-op cast lists are real here, not a blanket compute-dtype
+flag — ``patch_functions`` (the reference's ``patch_torch_functions``,
+frontend.py:132) activates the cast engine (amp/cast_engine.py), which
+patches ``jax.lax.dot_general``/``conv_general_dilated`` (half) and the
+exp/log/pow/reduction family (fp32) over the jnp/lax/jax.nn namespaces while
+the policy's context is active, mirroring apex/amp/lists/torch_overrides.py
+semantics. Params stay fp32 under O1; ``wrap_apply`` additionally casts
+float inputs to the half type (harmless under the op lists — whitelist ops
+would cast them anyway, blacklist ops re-cast to fp32). The default half
+dtype is bfloat16 (no loss scaling needed) with float16 available for
+parity.
 """
 
 import dataclasses
@@ -50,6 +54,7 @@ class Policy:
     master_weights: bool = False
     loss_scale: Any = 1.0  # "dynamic" or float
     keep_fp32_predicate: Callable[[str], bool] = default_keep_fp32_predicate
+    patch_functions: bool = False  # ref: patch_torch_functions (O1 only)
 
     # -- casting helpers --------------------------------------------------
 
@@ -98,15 +103,31 @@ class Policy:
 
         return jax.tree_util.tree_map(_c, tree)
 
+    def cast_context(self):
+        """Per-op cast context (ref: the active amp handle). A no-op
+        nullcontext unless ``patch_functions`` — entering it under O1
+        patches the jnp/lax/jax.nn namespaces with the FP16/FP32/promote
+        wrappers for the duration (amp/cast_engine.py)."""
+        import contextlib
+
+        if not self.enabled or not self.patch_functions or self.compute_dtype is None:
+            return contextlib.nullcontext()
+        from apex_tpu.amp.cast_engine import cast_ops
+
+        return cast_ops(self.compute_dtype)
+
     def wrap_apply(self, apply_fn: Callable) -> Callable:
-        """Wrap a model apply function with input/output casting."""
+        """Wrap a model apply function with input/output casting and, under
+        O1, the per-op cast lists (whatever jit traces inside the wrapper is
+        traced with the patched namespace active)."""
         if not self.enabled or self.compute_dtype is None:
             return apply_fn
 
         def wrapped(params, *args, **kwargs):
             args = self.cast_inputs(args)
             kwargs = self.cast_inputs(kwargs)
-            out = apply_fn(params, *args, **kwargs)
+            with self.cast_context():
+                out = apply_fn(params, *args, **kwargs)
             return self.cast_outputs(out)
 
         return wrapped
@@ -133,6 +154,7 @@ def _mk_level(opt_level, half_dtype):
             keep_batchnorm_fp32=True,
             master_weights=False,
             loss_scale="dynamic" if half_dtype == jnp.float16 else 1.0,
+            patch_functions=True,
         )
     if opt_level == "O2":
         return Policy(

@@ -91,8 +91,12 @@ def distributed_fused_lamb(
         nseg = spec.num_leaves + 1  # + padding bucket
 
         # stage 1: GLOBAL grad norm (clip-after-allreduce, ref
-        # distributed_fused_lamb.py _pipeline_step): local sq sum + psum
-        sq = jax.lax.psum(jnp.sum(gshard * gshard), axis_name)
+        # distributed_fused_lamb.py _pipeline_step): local shard sum-of-
+        # squares through the flat Pallas reduction (the shard is already
+        # one flat buffer — the case where flat wins, BENCH.md), then psum
+        from apex_tpu.optimizers._fused_kernels import sumsq_flat
+
+        sq = jax.lax.psum(sumsq_flat(gshard), axis_name)
         global_norm = jnp.sqrt(sq)
         clip = jnp.where(
             (max_grad_norm > 0) & (global_norm > max_grad_norm),

@@ -9,6 +9,14 @@ a throughput ratio.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "imgs/sec/chip", "vs_baseline": N}
+
+Supervisor contract (VERDICT r2 weak #1: the r2 supervisor's worst case was
+~8100 s and the driver killed it at rc=124 before the fallback could print):
+the TOTAL wall clock is hard-capped at APEX_BENCH_BUDGET seconds (default
+840 = 14 min).  Every subprocess timeout is derived from the remaining
+budget, a fixed reserve is set aside for the CPU fallback, and if literally
+everything fails a last-resort JSON record (value 0, diagnostic attached)
+is printed from the supervisor itself — one parsed line, unconditionally.
 """
 
 import json
@@ -17,12 +25,16 @@ import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import optax
+TOTAL_BUDGET = int(os.environ.get("APEX_BENCH_BUDGET", "840"))
+PROBE_TIMEOUT = 180          # jax.devices() only; hangs reproduce here, cheaply
+FALLBACK_RESERVE = 300       # always kept aside for the CPU-smoke record
+MIN_CHILD_TIMEOUT = 60
 
 
 def make_step(model, opt):
+    import jax
+    import optax
+
     from apex_tpu.models import cross_entropy_loss
 
     # images/labels are step arguments, not closure constants — closed-over
@@ -46,6 +58,9 @@ def make_step(model, opt):
 
 
 def measure(dtype, batch, image_size, warmup=3, iters=10):
+    import jax
+    import jax.numpy as jnp
+
     from apex_tpu.models import ResNet50
     from apex_tpu.optimizers import fused_sgd
 
@@ -79,6 +94,9 @@ def measure(dtype, batch, image_size, warmup=3, iters=10):
 
 
 def run_bench():
+    import jax
+    import jax.numpy as jnp
+
     if os.environ.get("APEX_BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     from apex_tpu.ops._dispatch import on_tpu as _on_tpu
@@ -105,58 +123,115 @@ def run_bench():
     return 0
 
 
+def run_probe():
+    """Init the backend and print its platform — nothing else.  Isolates the
+    known axon failure modes (fast raise AND indefinite hang) in a child the
+    supervisor can kill after PROBE_TIMEOUT instead of burning a full
+    measurement timeout discovering them."""
+    import jax
+
+    print(json.dumps({"probe_platform": jax.devices()[0].platform}))
+    return 0
+
+
 def main():
-    """Supervisor: run the measurement in a child process, retrying on
-    backend-init failure with a fresh process each time (a failed axon init
-    is cached inside a JAX process, and a hung child must be killed so it
-    cannot keep holding the chip). Round 1 died on one transient
-    ``Unable to initialize backend 'axon'`` with no retry — never again.
-    Always emits exactly one JSON line (CPU smoke as the last resort)."""
     if "--run" in sys.argv:
         return run_bench()
+    if "--probe" in sys.argv:
+        return run_probe()
 
-    def attempt(extra_env=None, timeout=2400):
+    deadline = time.monotonic() + TOTAL_BUDGET
+    diagnostics = []
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    def child(args, extra_env=None, timeout=MIN_CHILD_TIMEOUT, tag=""):
+        """Run a subprocess attempt; return its last JSON dict or None.
+        A fresh process per attempt because a failed axon init is cached
+        inside a JAX process, and a hung child must be killed so it cannot
+        keep holding the chip."""
         env = dict(os.environ, **(extra_env or {}))
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--run"],
+                [sys.executable, os.path.abspath(__file__)] + args,
                 capture_output=True, text=True, timeout=timeout, env=env,
             )
-        except subprocess.TimeoutExpired as e:  # child killed -> chip freed
-            sys.stderr.write(f"[bench] child timed out after {timeout}s\n")
-            if e.stderr:
-                sys.stderr.write(e.stderr[-2000:] if isinstance(e.stderr, str) else "")
+        except subprocess.TimeoutExpired as e:
+            tail = e.stderr[-800:] if isinstance(e.stderr, str) else (
+                e.stderr or b"")[-800:].decode("utf-8", "replace")
+            diagnostics.append(
+                f"{tag}: timed out after {int(timeout)}s; stderr_tail={tail!r}"
+            )
+            sys.stderr.write(f"[bench] {tag} timed out after {int(timeout)}s\n{tail}\n")
             return None
         for line in reversed(proc.stdout.strip().splitlines()):
             try:
                 rec = json.loads(line)
-                if isinstance(rec, dict) and "metric" in rec:
+                if isinstance(rec, dict):
                     return rec
             except ValueError:
                 continue
-        sys.stderr.write(
-            f"[bench] child rc={proc.returncode}; stderr tail:\n"
-            + proc.stderr[-3000:] + "\n"
-        )
+        tail = (proc.stderr or "")[-1500:]
+        diagnostics.append(f"{tag}: rc={proc.returncode} stderr_tail={tail!r}")
+        sys.stderr.write(f"[bench] {tag} rc={proc.returncode}; stderr tail:\n{tail}\n")
         return None
 
-    for i in range(3):
-        rec = attempt()
-        if rec is not None:
-            print(json.dumps(rec))
-            return 0
-        sys.stderr.write(f"[bench] attempt {i + 1}/3 failed; retrying\n")
-        time.sleep(15 * (i + 1))
+    # 1) Cheap backend probe: does jax.devices() answer at all, and with
+    #    what?  Up to two tries (a failed axon init can be a transient that a
+    #    fresh process survives — the round-1 lesson), each budget-capped so
+    #    the fallback reserve is untouchable.
+    probe = None
+    for i in range(2):
+        probe_budget = min(PROBE_TIMEOUT, remaining() - FALLBACK_RESERVE)
+        if probe_budget < MIN_CHILD_TIMEOUT:
+            break
+        probe = child(["--probe"], timeout=probe_budget, tag=f"probe {i + 1}/2")
+        if probe is not None:
+            break
 
-    sys.stderr.write("[bench] TPU unavailable after 3 attempts; CPU smoke fallback\n")
-    rec = attempt(extra_env={"APEX_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
-                  timeout=900)
-    if rec is not None:
+    # 2) TPU measurement attempts — only if the probe saw an accelerator, and
+    #    each sized so the fallback reserve survives no matter what.
+    if probe and probe.get("probe_platform") not in (None, "cpu"):
+        for i in range(2):
+            budget = remaining() - FALLBACK_RESERVE
+            if budget < MIN_CHILD_TIMEOUT:
+                break
+            t = budget if i == 1 else budget * 0.6
+            rec = child(["--run"], timeout=max(MIN_CHILD_TIMEOUT, t),
+                        tag=f"tpu attempt {i + 1}/2")
+            if rec is not None and "metric" in rec:
+                print(json.dumps(rec))
+                return 0
+            time.sleep(min(10, max(0, remaining() - FALLBACK_RESERVE)))
+    elif probe:
+        diagnostics.append(f"probe saw platform={probe.get('probe_platform')!r}; "
+                           "skipping TPU attempts")
+
+    # 3) Unconditional CPU-smoke fallback inside the reserve.
+    sys.stderr.write("[bench] no TPU record; CPU smoke fallback\n")
+    rec = child(["--run"],
+                extra_env={"APEX_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
+                timeout=max(MIN_CHILD_TIMEOUT, remaining() - 15),
+                tag="cpu fallback")
+    if rec is not None and "metric" in rec:
         rec["platform"] = "cpu_fallback"
+        rec["diagnostic"] = "; ".join(diagnostics)[-2000:]
         print(json.dumps(rec))
         return 0
-    sys.stderr.write("[bench] CPU fallback also failed\n")
-    return 1
+
+    # 4) Last resort: the supervisor itself emits the record.  One parsed
+    #    JSON line, unconditionally — even with the chip unplugged AND the
+    #    CPU fallback broken.
+    print(json.dumps({
+        "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+        "value": 0.0,
+        "unit": "imgs/sec/chip",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "diagnostic": "; ".join(diagnostics)[-2000:],
+    }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,197 @@
+"""Micro-benchmarks for apex_tpu's fused engines.
+
+What it measures (each as median-of-5 timed blocks after a warmup compile):
+
+1. ``adam``: one full optimizer step of ``fused_adam`` over a synthetic
+   transformer-shaped param tree — ``fuse="tree"`` (per-leaf tree_map, XLA
+   fusion) vs ``fuse="flat"`` (single padded fp32 buffer through
+   ``_fused_kernels.adam_flat``).  This answers the question the reference
+   answers with amp_C.multi_tensor_adam (csrc/multi_tensor_adam.cu): does a
+   single flat kernel beat many small per-tensor updates?
+2. ``l2norm``: global grad norm, tree-based ``multi_tensor_l2norm`` vs
+   ``l2norm_flat`` over the flattened buffer.
+3. ``layer_norm``: ``ops.layer_norm`` Pallas kernel vs the jnp/XLA path.
+4. ``attention``: ``ops.attention`` flash kernel vs the jnp/XLA path.
+
+On a TPU backend the Pallas variants run compiled (Mosaic); on CPU, "auto"
+dispatch resolves every variant to XLA, so the adam/l2norm rows still give a
+real flat-vs-tree comparison while the layer_norm/attention rows collapse to
+XLA-vs-XLA (reported as such).  Results land in BENCH.md.
+
+Usage:  python benchmarks/bench_optimizers.py [--cpu] [--params N] [--json]
+
+``--cpu`` is mandatory knowledge for this environment: the axon sitecustomize
+pins ``jax_platforms='axon,cpu'`` over the JAX_PLATFORMS env var, and a hung
+axon init blocks ``jax.devices()`` indefinitely — only
+``jax.config.update('jax_platforms', 'cpu')`` (what --cpu does) reliably
+forces the CPU backend.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def _timeit(fn, *args, warmup=2, reps=5, inner=10):
+    """Median seconds per call of jitted ``fn`` (block_until_ready fenced)."""
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / inner)
+    return statistics.median(times)
+
+
+def make_param_tree(total_params, key):
+    """Transformer-shaped tree: a few big matmul weights, many small
+    vectors/norms — the shape mix that makes per-tensor launches expensive
+    in the reference and motivates multi_tensor_apply."""
+    hidden = max(128, int((total_params / 60) ** 0.5) // 128 * 128)
+    layers = max(1, total_params // (12 * hidden * hidden + 13 * hidden))
+    tree = {}
+    for i in range(layers):
+        k = jax.random.fold_in(key, i)
+        tree[f"layer_{i}"] = {
+            "attn_qkv": jax.random.normal(k, (hidden, 3 * hidden), jnp.float32) * 0.02,
+            "attn_out": jax.random.normal(k, (hidden, hidden), jnp.float32) * 0.02,
+            "mlp_in": jax.random.normal(k, (hidden, 4 * hidden), jnp.float32) * 0.02,
+            "mlp_out": jax.random.normal(k, (4 * hidden, hidden), jnp.float32) * 0.02,
+            "ln1_scale": jnp.ones((hidden,)),
+            "ln1_bias": jnp.zeros((hidden,)),
+            "ln2_scale": jnp.ones((hidden,)),
+            "ln2_bias": jnp.zeros((hidden,)),
+            "qkv_bias": jnp.zeros((3 * hidden,)),
+            "out_bias": jnp.zeros((hidden,)),
+            "mlp_in_bias": jnp.zeros((4 * hidden,)),
+            "mlp_out_bias": jnp.zeros((hidden,)),
+        }
+    return tree
+
+
+def bench_adam(tree, grads):
+    from apex_tpu.optimizers import fused_adam
+
+    results = {}
+    for mode in ("tree", "flat"):
+        opt = fused_adam(lr=1e-3, weight_decay=0.01, fuse=mode)
+        state = jax.jit(opt.init)(tree)
+
+        @jax.jit
+        def step(g, s, p):
+            upd, s2 = opt.update(g, s, p)
+            import optax
+
+            return optax.apply_updates(p, upd), s2
+
+        results[mode] = _timeit(step, grads, state, tree)
+    return results
+
+
+def bench_l2norm(tree, grads):
+    from apex_tpu.ops.multi_tensor import flatten_pytree, multi_tensor_l2norm
+    from apex_tpu.optimizers._fused_kernels import l2norm_flat
+
+    flat, _ = flatten_pytree(grads, dtype=jnp.float32)
+    tree_fn = jax.jit(lambda g: multi_tensor_l2norm(jax.tree_util.tree_leaves(g)))
+    flat_fn = jax.jit(l2norm_flat)
+    # sanity: both engines agree before we time them
+    a, b = tree_fn(grads), flat_fn(flat)
+    assert jnp.allclose(a, b, rtol=1e-5), (a, b)
+    return {"tree": _timeit(tree_fn, grads), "flat": _timeit(flat_fn, flat)}
+
+
+def bench_layer_norm(batch, hidden, key):
+    from apex_tpu.ops.layer_norm import layer_norm
+
+    x = jax.random.normal(key, (batch, hidden), jnp.float32)
+    w = jnp.ones((hidden,))
+    b = jnp.zeros((hidden,))
+    out = {}
+    for impl in ("xla", "pallas"):
+        fn = jax.jit(lambda x, w, b, impl=impl: layer_norm(x, w, b, impl=impl))
+        out[impl] = _timeit(fn, x, w, b)
+    return out
+
+
+def bench_attention(batch, heads, seq, dim, key):
+    from apex_tpu.ops.attention import flash_attention
+
+    q = jax.random.normal(key, (batch, heads, seq, dim), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), (batch, heads, seq, dim), jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), (batch, heads, seq, dim), jnp.bfloat16)
+    out = {}
+    for impl in ("xla", "pallas"):
+        fn = jax.jit(
+            lambda q, k, v, impl=impl: flash_attention(q, k, v, causal=True, impl=impl)
+        )
+        out[impl] = _timeit(fn, q, k, v)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params", type=int, default=None,
+                    help="approx. total parameter count (default: 30M on TPU, 3M on CPU)")
+    ap.add_argument("--json", action="store_true", help="emit one JSON line only")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (see module docstring)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+    from apex_tpu.ops._dispatch import on_tpu
+
+    tpu = on_tpu()
+    n_params = args.params or (30_000_000 if tpu else 3_000_000)
+
+    key = jax.random.PRNGKey(0)
+    tree = make_param_tree(n_params, key)
+    total = sum(int(x.size) for x in jax.tree_util.tree_leaves(tree))
+    grads = jax.tree_util.tree_map(
+        lambda x: jax.random.normal(jax.random.fold_in(key, 99), x.shape, x.dtype) * 1e-3,
+        tree,
+    )
+
+    if tpu:
+        ln_shape, attn_shape = (8192, 4096), (4, 16, 2048, 128)
+    else:
+        ln_shape, attn_shape = (512, 1024), (1, 4, 256, 64)
+
+    record = {
+        "platform": platform,
+        "pallas_compiled": bool(tpu),  # False => Pallas rows resolved to XLA
+        "n_params": total,
+        "adam_step_s": bench_adam(tree, grads),
+        "l2norm_s": bench_l2norm(tree, grads),
+        "layer_norm_s": bench_layer_norm(*ln_shape, jax.random.fold_in(key, 7)),
+        "attention_s": bench_attention(*attn_shape, jax.random.fold_in(key, 8)),
+    }
+    if args.json:
+        print(json.dumps(record))
+        return
+
+    print(f"platform={platform}  pallas_compiled={tpu}  params={total:,}")
+    for name in ("adam_step_s", "l2norm_s", "layer_norm_s", "attention_s"):
+        row = record[name]
+        (k1, v1), (k2, v2) = row.items()
+        ratio = v1 / v2 if v2 else float("inf")
+        print(f"{name:14s}  {k1}={v1 * 1e3:9.3f} ms   {k2}={v2 * 1e3:9.3f} ms   "
+              f"{k1}/{k2}={ratio:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

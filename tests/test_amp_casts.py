@@ -1,0 +1,214 @@
+"""O1 per-op cast semantics.
+
+Mirrors /root/reference/tests/L0/run_amp/test_basic_casts.py (whitelist ops
+half, blacklist ops float, backward grads match input dtype) and
+test_promotion.py (mixed-input promotion to widest, cat/stack sequence
+promotion) — against the TPU cast engine (apex_tpu/amp/cast_engine.py)
+instead of the patched torch namespace.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.cast_engine import cast_ops
+
+HALF_DTYPES = [jnp.bfloat16, jnp.float16]
+
+
+def _ctx(half):
+    return cast_ops(half)
+
+
+class TestBasicCasts:
+    """Ref TestBasicCasts (test_basic_casts.py:23-140)."""
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    @pytest.mark.parametrize("in_dtype", [jnp.float32, None])
+    def test_matmul_is_half(self, half, in_dtype):
+        in_dtype = in_dtype or half
+        x = jnp.ones((4, 8), in_dtype)
+        w = jnp.ones((8, 4), in_dtype)
+        with _ctx(half):
+            y = jnp.matmul(x, w)
+        assert y.dtype == half  # ALWAYS_HALF
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_dot_general_is_half(self, half):
+        """lax.dot_general is the primitive every flax Dense lowers to —
+        patching it is the analogue of patching torch.addmm."""
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 4), jnp.float32)
+        with _ctx(half):
+            y = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
+        assert y.dtype == half
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_flax_dense_is_half(self, half):
+        """Ref test_linear_is_half: an nn layer (weights held outside the
+        patched function) comes out half because its inner dot is patched."""
+        m = nn.Dense(4)
+        x = jnp.ones((2, 8), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        with _ctx(half):
+            y = m.apply(params, x)
+        assert y.dtype == half
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_conv_is_half(self, half):
+        m = nn.Conv(4, (3, 3))
+        x = jnp.ones((1, 8, 8, 3), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        with _ctx(half):
+            y = m.apply(params, x)
+        assert y.dtype == half
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    @pytest.mark.parametrize("in_dtype", [jnp.float32, None])
+    def test_softmax_is_float(self, half, in_dtype):
+        x = jnp.ones((4, 8), in_dtype or half)
+        with _ctx(half):
+            y = jax.nn.softmax(x, axis=-1)
+        assert y.dtype == jnp.float32  # ALWAYS_FLOAT
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_sum_is_float(self, half):
+        x = jnp.ones((4, 8), half)
+        with _ctx(half):
+            y = jnp.sum(x)
+        assert y.dtype == jnp.float32
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_pow_is_float(self, half):
+        x = jnp.ones((4,), half)
+        with _ctx(half):
+            y = jnp.power(x, 2.0)
+        assert y.dtype == jnp.float32
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_exp_log_are_float(self, half):
+        x = jnp.ones((4,), half)
+        with _ctx(half):
+            assert jnp.exp(x).dtype == jnp.float32
+            assert jnp.log(x + 1.0).dtype == jnp.float32
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_relu_is_match(self, half):
+        """Ref test_relu_is_match: unlisted ops preserve input dtype."""
+        for dt in (half, jnp.float32):
+            x = jnp.ones((4,), dt)
+            with _ctx(half):
+                assert jax.nn.relu(x).dtype == dt
+
+    def test_backward_grads_match_input_dtype(self):
+        """Ref run_layer_test's backward check: d/dx of a whitelist op on an
+        fp32 input arrives fp32 (the cast's VJP casts back)."""
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 4), jnp.float32)
+        with _ctx(jnp.bfloat16):
+            g = jax.grad(lambda a: jnp.matmul(a, w).astype(jnp.float32).sum())(x)
+        assert g.dtype == jnp.float32
+
+    def test_inactive_outside_context(self):
+        x = jnp.ones((4, 8), jnp.float32)
+        w = jnp.ones((8, 4), jnp.float32)
+        assert jnp.matmul(x, w).dtype == jnp.float32
+        with _ctx(jnp.bfloat16):
+            pass
+        assert jnp.matmul(x, w).dtype == jnp.float32
+        assert not hasattr(jnp.matmul, "__wrapped_by_apex_tpu_amp__")
+
+    def test_casts_compile_into_jit(self):
+        """Tracing inside the context bakes the casts into the jaxpr —
+        the compiled fn keeps O1 behavior outside the context (the torch
+        analogue: a cuda graph captured while the handle was active)."""
+        w = jnp.ones((8, 4), jnp.float32)
+        with _ctx(jnp.bfloat16):
+            f = jax.jit(lambda a: jnp.matmul(a, w))
+            y = f(jnp.ones((4, 8), jnp.float32))  # traced inside
+        assert y.dtype == jnp.bfloat16
+        assert f(jnp.ones((4, 8), jnp.float32)).dtype == jnp.bfloat16
+
+
+class TestPromotion:
+    """Ref TestPromotion (test_promotion.py:42-75)."""
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_atan2_matches_widest(self, half):
+        a = jnp.ones((4,), half)
+        b = jnp.ones((4,), jnp.float32)
+        with _ctx(half):
+            assert jnp.arctan2(a, b).dtype == jnp.float32
+            assert jnp.arctan2(b, a).dtype == jnp.float32
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_mul_matches_widest(self, half):
+        a = jnp.ones((4,), half)
+        b = jnp.ones((4,), jnp.float32)
+        with _ctx(half):
+            assert jnp.multiply(a, b).dtype == jnp.float32
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_single_type_untouched(self, half):
+        a = jnp.ones((4,), half)
+        b = jnp.ones((4,), half)
+        with _ctx(half):
+            assert jnp.add(a, b).dtype == half
+
+    @pytest.mark.parametrize("half", HALF_DTYPES)
+    def test_cat_matches_widest(self, half):
+        """Ref test_cat_matches_widest via SEQUENCE_CASTS."""
+        seq = [jnp.ones((4,), half), jnp.ones((4,), jnp.float32)]
+        with _ctx(half):
+            assert jnp.concatenate(seq).dtype == jnp.float32
+            assert jnp.stack(seq).dtype == jnp.float32
+
+    def test_nested_same_dtype_ok_mismatch_raises(self):
+        with _ctx(jnp.bfloat16):
+            with _ctx(jnp.bfloat16):
+                assert jnp.sum(jnp.ones((2,), jnp.bfloat16)).dtype == jnp.float32
+            with pytest.raises(ValueError, match="different half dtypes"):
+                with _ctx(jnp.float16):
+                    pass
+        # fully restored after nesting
+        assert not hasattr(jnp.sum, "__wrapped_by_apex_tpu_amp__")
+
+
+class TestO1Policy:
+    """End-to-end: the O1 policy drives the engine through wrap_apply."""
+
+    def test_o1_has_patch_functions(self):
+        assert amp.O1().patch_functions
+        assert not amp.O2().patch_functions and not amp.O0().patch_functions
+
+    def test_o1_wrap_apply_blacklist_inside_model(self):
+        """A model whose head is a blacklisted op produces fp32 internally
+        under O1 even though inputs were cast half."""
+        policy = amp.O1(jnp.bfloat16)
+        seen = {}
+
+        def apply_fn(params, x):
+            y = jnp.matmul(x, params["w"])  # whitelist -> half
+            seen["mm"] = y.dtype
+            z = jnp.sum(y)  # blacklist -> fp32
+            seen["sum"] = z.dtype
+            return z
+
+        params = {"w": jnp.ones((8, 4), jnp.float32)}
+        out = policy.wrap_apply(apply_fn)(params, jnp.ones((2, 8), jnp.float32))
+        assert seen["mm"] == jnp.bfloat16
+        assert seen["sum"] == jnp.float32
+        assert out.dtype == jnp.float32
+
+    def test_o2_wrap_apply_does_not_patch(self):
+        policy = amp.O2(jnp.bfloat16)
+        seen = {}
+
+        def apply_fn(params, x):
+            seen["sum"] = jnp.sum(x).dtype
+            return x
+
+        policy.wrap_apply(apply_fn)({}, jnp.ones((2,), jnp.float32))
+        assert seen["sum"] == jnp.bfloat16  # no fp32 blacklist under O2
