@@ -56,8 +56,15 @@ def _tree_cast(tree, convert):
     )
 
 
-def _to_half(x):
-    return x.astype(_state.half_dtype) if x.dtype == jnp.float32 else x
+def _to_half_converter(half_dtype):
+    """The half dtype is bound at patch time, not read from ``_state`` at
+    call time — a concurrent outermost exit nulls ``_state.half_dtype``
+    and must not be observable mid-call in another thread."""
+
+    def _to_half(x):
+        return x.astype(half_dtype) if x.dtype == jnp.float32 else x
+
+    return _to_half
 
 
 def _to_float(x):
@@ -102,7 +109,7 @@ def _make_promote_wrapper(orig):
     return wrapper
 
 
-def _make_half_output_wrapper(orig):
+def _make_half_output_wrapper(orig, to_half):
     """Layer-level ALWAYS_HALF (ref: wrapping torch.conv2d / F.linear whole,
     bias add included): float32 outputs of an MXU-bound flax layer come out
     half even though the trailing bias add ran fp32."""
@@ -112,21 +119,22 @@ def _make_half_output_wrapper(orig):
         out = orig(self, *args, **kwargs)
         if _state.depth == 0:
             return out
-        return _tree_cast(out, _to_half)
+        return _tree_cast(out, to_half)
 
     wrapper.__wrapped_by_apex_tpu_amp__ = True
     return wrapper
 
 
 def _patch():
+    to_half = _to_half_converter(_state.half_dtype)
     for mod, name in cast_lists.FP16_FUNCS:
         orig = getattr(mod, name)
         _state.saved.append((mod, name, orig))
-        setattr(mod, name, _make_cast_wrapper(orig, _to_half))
+        setattr(mod, name, _make_cast_wrapper(orig, to_half))
     for cls, name in cast_lists.FP16_MODULE_CALLS:
         orig = getattr(cls, name)
         _state.saved.append((cls, name, orig))
-        setattr(cls, name, _make_half_output_wrapper(orig))
+        setattr(cls, name, _make_half_output_wrapper(orig, to_half))
     for mod, name in cast_lists.FP32_FUNCS:
         orig = getattr(mod, name)
         _state.saved.append((mod, name, orig))

@@ -83,9 +83,11 @@ class Policy:
         dt = self.compute_dtype
 
         def _c(x):
-            x = jnp.asarray(x)
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(dt)
+            # only arrays with a float dtype; Python scalars stay weak-typed
+            # and non-array leaves (strings like mutable=["batch_stats"],
+            # None, ints) pass through untouched
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.asarray(x).astype(dt)
             return x
 
         return jax.tree_util.tree_map(_c, tree)
@@ -96,9 +98,8 @@ class Policy:
             return tree
 
         def _c(x):
-            x = jnp.asarray(x)
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(jnp.float32)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return jnp.asarray(x).astype(jnp.float32)
             return x
 
         return jax.tree_util.tree_map(_c, tree)

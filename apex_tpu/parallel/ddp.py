@@ -31,6 +31,16 @@ def all_reduce_gradients(
     """psum-average a grad pytree over the data-parallel axis.
 
     Call inside shard_map/pmap over ``axis_name`` after ``jax.grad``.
+
+    CAVEAT (differs from torch DDP): this grad-then-allreduce pattern is
+    only correct when the differentiated loss contains NO collectives over
+    ``axis_name``. torch's SyncBatchNorm injects its own all_reduce in its
+    custom backward, so torch DDP composes with it; JAX AD transposes the
+    forward psum instead, and reducing local-loss grads afterwards loses
+    the cross-shard terms. With SyncBatchNorm (or any forward psum over
+    the dp axis), differentiate the GLOBAL loss —
+    ``jax.grad(lambda p: lax.pmean(loss_fn(p), axis_name))`` — and skip
+    this function (tests/test_amp_convergence.py pins both patterns).
     """
     n = jax.lax.psum(1, axis_name)
 
@@ -93,7 +103,11 @@ class DistributedDataParallel:
         )
 
     def value_and_grad(self, *args, **kwargs):
-        """jax.value_and_grad with the gradient allreduce fused in."""
+        """jax.value_and_grad with the gradient allreduce fused in.
+
+        See the ``all_reduce_gradients`` caveat: not for models whose
+        forward psums over the dp axis (e.g. SyncBatchNorm) — there,
+        differentiate the pmean'd global loss directly."""
         vg = jax.value_and_grad(self.loss_fn, *args, **kwargs)
 
         def wrapped(*a, **k):
