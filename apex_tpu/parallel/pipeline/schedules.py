@@ -60,6 +60,41 @@ def _index(tree: Any, i) -> Any:
     )
 
 
+def _scan_ticks(tick, state0, num_ticks: int, tick_block_remat: int):
+    """Scan ``tick`` over ``num_ticks`` ticks, optionally rematerializing in
+    blocks: with ``tick_block_remat = B > 0`` the scan nests — an outer scan
+    over ceil(T/B) blocks whose body (an inner B-tick scan) is
+    ``jax.checkpoint``ed, so differentiation stashes one carry per BLOCK
+    instead of per tick: live boundary-activation memory drops from O(T) to
+    O(T/B + B) at the cost of one forward recompute of each block — the
+    knob that restores the reference 1F1B's O(P) in-flight bound
+    (fwd_bwd_pipelining_without_interleaving.py:345-348) for large M.
+
+    Returns (final_state, ys) like ``lax.scan``; padding ticks (to fill the
+    last block) run the pipeline beyond its useful range, and callers index
+    only real ticks out of ``ys``.
+    """
+    if tick_block_remat and 0 < tick_block_remat < num_ticks:
+        # B >= T degenerates to one checkpointed block: every padding tick
+        # runs a real ppermute + stage computation for zero residual
+        # savings, so fall through to the plain scan instead
+        B = tick_block_remat
+        nblocks = -(-num_ticks // B)
+
+        @jax.checkpoint
+        def block(carry, tblock):
+            return jax.lax.scan(tick, carry, tblock)
+
+        ticks = jnp.arange(nblocks * B).reshape(nblocks, B)
+        state, ys = jax.lax.scan(block, state0, ticks)
+        # un-block the stacked outputs: (nblocks, B, ...) -> (nblocks*B, ...)
+        ys = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), ys
+        )
+        return state, ys
+    return jax.lax.scan(tick, state0, jnp.arange(num_ticks))
+
+
 def pipeline_forward(
     stage_fn: Callable[[Any, Any], Any],
     params: Any,
@@ -67,6 +102,7 @@ def pipeline_forward(
     *,
     axis_name: str = "pp",
     remat: bool = True,
+    tick_block_remat: int = 0,
 ) -> Any:
     """Run M microbatches through the P-stage compiled pipeline.
 
@@ -75,6 +111,13 @@ def pipeline_forward(
     with leading dim M of last-stage outputs — *valid on the last stage
     only* (other stages hold bubble garbage), mirroring how the reference's
     forward_step returns losses only on the final stage (common.py:296-309).
+
+    Memory: the scan carry is ONE boundary activation; per-tick outputs are
+    scan ys (microbatch m exits at the statically-known tick m + P - 1, so
+    collecting them is a static slice, not a carried M-slot buffer — keeping
+    the buffer in the carry would make every tick's residual O(M)).
+    ``tick_block_remat`` bounds the per-tick residuals for large M
+    (_scan_ticks).
     """
     num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
     rank = jax.lax.axis_index(axis_name)
@@ -84,12 +127,8 @@ def pipeline_forward(
     mb0 = _index(microbatches, 0)
     out_shape = jax.eval_shape(stage_fn, params, mb0)
     state0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
-    outbuf0 = jax.tree_util.tree_map(
-        lambda s: jnp.zeros((num_micro,) + s.shape, s.dtype), out_shape
-    )
 
-    def tick(carry, t):
-        state, outbuf = carry
+    def tick(state, t):
         recv = p2p.send_forward_recv_forward(state, axis_name)
         mb = _index(microbatches, jnp.clip(t, 0, num_micro - 1))
         is_first = rank == 0
@@ -97,21 +136,15 @@ def pipeline_forward(
             lambda a, b: jnp.where(is_first, a, b), mb, recv
         )
         y = body(params, x)
-        out_idx = t - (num_stages - 1)
-        valid = out_idx >= 0  # t < M + P - 1 already bounds out_idx < M
-        idx = jnp.maximum(out_idx, 0)
+        return y, y
 
-        def update(buf, leaf):
-            old = jax.lax.dynamic_index_in_dim(buf, idx, 0, keepdims=False)
-            new = jnp.where(valid, leaf, old)
-            return jax.lax.dynamic_update_index_in_dim(buf, new, idx, 0)
-
-        outbuf = jax.tree_util.tree_map(update, outbuf, y)
-        return (y, outbuf), None
-
-    ticks = jnp.arange(num_micro + num_stages - 1)
-    (_, outputs), _ = jax.lax.scan(tick, (state0, outbuf0), ticks)
-    return outputs
+    num_ticks = num_micro + num_stages - 1
+    _, ys = _scan_ticks(tick, state0, num_ticks, tick_block_remat)
+    # microbatch m's last-stage output was produced at tick m + (P-1)
+    return jax.tree_util.tree_map(
+        lambda a: jax.lax.slice_in_dim(a, num_stages - 1, num_ticks, axis=0),
+        ys,
+    )
 
 
 def pipeline_forward_interleaved(
@@ -122,6 +155,7 @@ def pipeline_forward_interleaved(
     num_model_chunks: int,
     axis_name: str = "pp",
     remat: bool = True,
+    tick_block_remat: int = 0,
 ) -> Any:
     """Genuinely interleaved virtual-PP forward: ONE scan over
     T = V*M + P - 1 ticks, one chunk-computation per rank per tick.
@@ -143,6 +177,12 @@ def pipeline_forward_interleaved(
     reference asserts (:118).
 
     Returns last-stage outputs (leading dim M), valid on rank P-1 only.
+
+    Memory: like ``pipeline_forward``, the carry is one boundary activation
+    and outputs are scan ys gathered post-scan — on the last rank,
+    microbatch m (group k = m // P, slot i = m % P) clears the final global
+    stage at the statically-known tick k*V*P + (V-1)*P + i + (P-1), so the
+    gather indices are a host-side constant.
     """
     num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
     rank = jax.lax.axis_index(axis_name)
@@ -153,20 +193,26 @@ def pipeline_forward_interleaved(
             f"interleaved schedule requires num_microbatches ({num_micro}) "
             f"% pipeline size ({num_stages}) == 0"
         )
-    body = jax.checkpoint(stage_fn) if remat else stage_fn
+    def chunk_fn(chunks, v, x):
+        # the chunk gather lives INSIDE the rematerialized body: saved as a
+        # residual it would cost one full chunk's params PER TICK — measured
+        # 133 MiB vs 2 MiB at M=128 on the toy config (BENCH.md, pipeline
+        # memory table); rematerialized it costs nothing extra
+        pv = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            chunks,
+        )
+        return stage_fn(pv, x)
+
+    body = jax.checkpoint(chunk_fn) if remat else chunk_fn
 
     mb0 = _index(microbatches, 0)
-    p0 = jax.tree_util.tree_map(lambda a: a[0], params_chunks)
-    out_shape = jax.eval_shape(stage_fn, p0, mb0)
+    out_shape = jax.eval_shape(body, params_chunks, 0, mb0)
     state0 = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), out_shape
     )
-    outbuf0 = jax.tree_util.tree_map(
-        lambda s: jnp.zeros((num_micro,) + s.shape, s.dtype), out_shape
-    )
 
-    def tick(carry, t):
-        state, outbuf = carry
+    def tick(state, t):
         recv = p2p.ring_forward(state, axis_name)
         u = t - rank
         uc = jnp.clip(u, 0, V * num_micro - 1)
@@ -178,43 +224,38 @@ def pipeline_forward_interleaved(
         x = jax.tree_util.tree_map(
             lambda a, b: jnp.where(takes_input, a, b), mb, recv
         )
-        pv = jax.tree_util.tree_map(
-            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
-            params_chunks,
-        )
-        y = body(pv, x)
-        # the final global stage V*P - 1 lives on rank P-1, chunk V-1
-        is_out = (
-            (u >= 0) & (u < V * num_micro)
-            & (rank == num_stages - 1) & (v == V - 1)
-        )
+        y = body(params_chunks, v, x)
+        return y, y
 
-        def update(buf, leaf):
-            old = jax.lax.dynamic_index_in_dim(buf, m, 0, keepdims=False)
-            new = jnp.where(is_out, leaf, old)
-            return jax.lax.dynamic_update_index_in_dim(buf, new, m, 0)
-
-        outbuf = jax.tree_util.tree_map(update, outbuf, y)
-        return (y, outbuf), None
-
-    ticks = jnp.arange(V * num_micro + num_stages - 1)
-    (_, outputs), _ = jax.lax.scan(tick, (state0, outbuf0), ticks)
-    return outputs
+    num_ticks = V * num_micro + num_stages - 1
+    _, ys = _scan_ticks(tick, state0, num_ticks, tick_block_remat)
+    # exit tick of microbatch m on the last rank (u = t - (P-1)):
+    #   u_out = (m // P)*V*P + (V-1)*P + (m % P)
+    ms = jnp.arange(num_micro)
+    exit_ticks = (
+        (ms // num_stages) * V * num_stages
+        + (V - 1) * num_stages
+        + ms % num_stages
+        + num_stages
+        - 1
+    )
+    return jax.tree_util.tree_map(lambda a: a[exit_ticks], ys)
 
 
 def _stages_forward(
     stage_fn, stages_params, h, *, axis_name: str, remat: bool,
-    num_model_chunks: int,
+    num_model_chunks: int, tick_block_remat: int = 0,
 ):
     """Forward through this rank's chunk(s): the plain pipeline for V=1,
     the single-scan interleaved schedule for V>1."""
     if num_model_chunks == 1:
         return pipeline_forward(
-            stage_fn, stages_params, h, axis_name=axis_name, remat=remat
+            stage_fn, stages_params, h, axis_name=axis_name, remat=remat,
+            tick_block_remat=tick_block_remat,
         )
     return pipeline_forward_interleaved(
         stage_fn, stages_params, h, num_model_chunks=num_model_chunks,
-        axis_name=axis_name, remat=remat,
+        axis_name=axis_name, remat=remat, tick_block_remat=tick_block_remat,
     )
 
 
@@ -287,6 +328,7 @@ def forward_backward_pipelining_without_interleaving(
     *,
     axis_name: str = "pp",
     remat: bool = True,
+    tick_block_remat: int = 0,
     grad_sync_fn: Optional[Callable[[Any], Any]] = None,
 ):
     """Compiled 1F1B-equivalent schedule (ref:
@@ -301,7 +343,8 @@ def forward_backward_pipelining_without_interleaving(
     """
     def total_loss(p):
         outs = pipeline_forward(
-            stage_fn, p, microbatches, axis_name=axis_name, remat=remat
+            stage_fn, p, microbatches, axis_name=axis_name, remat=remat,
+            tick_block_remat=tick_block_remat,
         )
         return _publish_losses(jax.vmap(loss_fn)(outs, targets), axis_name)
 
@@ -321,6 +364,7 @@ def forward_backward_pipelining_with_interleaving(
     num_model_chunks: int,
     axis_name: str = "pp",
     remat: bool = True,
+    tick_block_remat: int = 0,
     grad_sync_fn: Optional[Callable[[Any], Any]] = None,
 ):
     """Virtual-pipeline (interleaved) schedule (ref:
@@ -337,6 +381,7 @@ def forward_backward_pipelining_with_interleaving(
         outs = _stages_forward(
             stage_fn, chunks, microbatches, axis_name=axis_name,
             remat=remat, num_model_chunks=num_model_chunks,
+            tick_block_remat=tick_block_remat,
         )
         return _publish_losses(jax.vmap(loss_fn)(outs, targets), axis_name)
 
@@ -359,6 +404,7 @@ def forward_backward_with_pre_post(
     axis_name: str = "pp",
     remat: bool = True,
     num_model_chunks: int = 1,
+    tick_block_remat: int = 0,
     grad_sync_fn: Optional[Callable[[Any], Any]] = None,
 ):
     """Full-model pipeline step: embedding + stages + head in one backward.
@@ -383,6 +429,7 @@ def forward_backward_with_pre_post(
         outs = _stages_forward(
             stage_fn, p["stages"], h, axis_name=axis_name, remat=remat,
             num_model_chunks=num_model_chunks,
+            tick_block_remat=tick_block_remat,
         )
         losses = jax.vmap(
             lambda y, t: post_loss_fn(p["post"], y, t)

@@ -271,6 +271,87 @@ class TestPipelineSchedules:
             lengths = scan_lengths(jax.make_jaxpr(run)(params, mbs))
             assert lengths == [vpp * num_micro + pp - 1]
 
+    @pytest.mark.parametrize("num_micro", [5, 8])
+    def test_tick_block_remat_grads_match_1f1b(self, rng, num_micro):
+        """tick_block_remat is a pure memory/recompute trade: loss and
+        grads must be bit-comparable to the unblocked scan, including when
+        the block size does not divide the tick count (padding ticks)."""
+        pp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+        params = make_stage_params(rng, pp)
+        mbs = jax.random.normal(
+            jax.random.fold_in(rng, 1), (num_micro, MICRO_B, HID)
+        )
+        targets = jax.random.normal(
+            jax.random.fold_in(rng, 2), (num_micro, MICRO_B, HID)
+        )
+        pspec = {"w": P("pp", None, None), "b": P("pp", None)}
+
+        def make_run(block):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(pspec, P(), P()),
+                out_specs=(P(), pspec), check_vma=False,
+            )
+            def run(stacked, mbs, targets):
+                local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+                loss, _, grads = forward_backward_pipelining_without_interleaving(
+                    stage_fn, loss_fn, local, mbs, targets,
+                    axis_name="pp", tick_block_remat=block,
+                )
+                return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+            return run
+
+        loss0, grads0 = make_run(0)(params, mbs, targets)
+        for block in (3, 16):  # non-dividing (pads) and over-long (one block)
+            loss_b, grads_b = make_run(block)(params, mbs, targets)
+            np.testing.assert_allclose(loss_b, loss0, rtol=1e-6)
+            for k in ("w", "b"):
+                np.testing.assert_allclose(
+                    grads_b[k], grads0[k], rtol=1e-5, atol=1e-7
+                )
+
+    def test_tick_block_remat_grads_match_interleaved(self, rng):
+        pp, vpp, num_micro = 2, 2, 4
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+        params = {
+            "w": jax.random.normal(rng, (vpp, HID, HID)) * 0.5,
+            "b": jnp.zeros((vpp, HID)),
+        }
+        mbs = jax.random.normal(
+            jax.random.fold_in(rng, 1), (num_micro, MICRO_B, HID)
+        )
+        targets = jax.random.normal(
+            jax.random.fold_in(rng, 2), (num_micro, MICRO_B, HID)
+        )
+
+        def make_run(block):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(P(), P(), P()),
+                out_specs=(P(), P()), check_vma=False,
+            )
+            def run(chunks, mbs, targets):
+                loss, _, grads = forward_backward_pipelining_with_interleaving(
+                    stage_fn, loss_fn, chunks, mbs, targets,
+                    num_model_chunks=vpp, axis_name="pp",
+                    tick_block_remat=block,
+                )
+                return loss, grads
+
+            return run
+
+        loss0, grads0 = make_run(0)(params, mbs, targets)
+        loss_b, grads_b = make_run(4)(params, mbs, targets)  # T=9 pads to 12
+        np.testing.assert_allclose(loss_b, loss0, rtol=1e-6)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(grads_b[k], grads0[k], rtol=1e-5, atol=1e-7)
+
     def test_interleaved_requires_divisible_microbatches(self, rng):
         pp, vpp = 2, 2
         mesh = parallel_state.initialize_model_parallel(
