@@ -12,6 +12,7 @@ from apex_tpu.contrib.sparsity.asp import (
 from apex_tpu.contrib.sparsity.permutation import (
     apply_permutation,
     invert_permutation,
+    exhaustive_search,
     permute_and_mask,
     search_for_good_permutation,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "replace_masks",
     "apply_permutation",
     "invert_permutation",
+    "exhaustive_search",
     "permute_and_mask",
     "search_for_good_permutation",
     "create_mask",

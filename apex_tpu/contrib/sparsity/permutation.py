@@ -1,17 +1,29 @@
 """Channel-permutation search for accuracy-preserving 2:4 pruning.
 
-Reference parity: apex.contrib.sparsity.permutation_lib (~2.3k LoC + CUDA
-search kernels): permuting the input channels of a weight matrix before
-2:4 pruning can raise the retained magnitude substantially, and an inverse
-permutation on the previous layer keeps the network function unchanged.
+Reference parity: apex.contrib.sparsity.permutation_lib (~1.7k LoC of
+fx-graph plumbing) driving permutation_search_kernels/exhaustive_search.py
+(the actual algorithm + CUDA enumeration kernels): permuting the input
+channels of a weight matrix before 2:4 pruning can raise the retained
+magnitude substantially, and an inverse permutation on the previous layer
+keeps the network function unchanged.
 
-TPU design: the reference's exhaustive stripe-group search (with CUDA
-enumeration kernels) is replaced by a bounded greedy column-swap search in
-numpy — same objective (maximize total |w| retained by the 2:4 mask after
-permutation), deterministic, and fast enough at the channel counts that
-matter. The permutation is applied/undone with plain ``jnp.take``.
+Two search engines:
+
+- ``exhaustive_search`` (the default): the reference's bounded stripe-group
+  exhaustive search (exhaustive_search.py Exhaustive_Search :311) —
+  enumerate the 35 canonical regroupings of every stripe pair (8 columns
+  into two groups of 4), greedily apply the best non-overlapping ones,
+  rebuild only the pairs touching changed stripes, iterate to a fixed
+  point, with optional random perturbations to escape local minima
+  (escape_attempts). The CUDA build_permute_map kernel becomes one
+  vectorized numpy gather+partition over (pairs, 35 perms).
+- ``search_for_good_permutation``: the round-1 bounded greedy column-swap
+  search, kept as the cheap fallback.
+
+The permutation is applied/undone with plain ``jnp.take``.
 """
 
+import itertools
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -67,6 +79,172 @@ def search_for_good_permutation(
     return perm
 
 
+def _unique_group_permutations(C: int, M: int = 4) -> np.ndarray:
+    """All canonical regroupings of C columns into C/M groups of M.
+
+    Ref exhaustive_search.py:17-80 (is_canonical / generate_unique_
+    combinations): within-group order and group order don't affect the 2:4
+    objective, so a unique combination is a sorted list of sorted groups —
+    C=8, M=4 gives 35 (the count the reference's CUDA kernel enumerates).
+    """
+    out = []
+
+    def build(perm, remaining):
+        if not remaining:
+            out.append(list(perm))
+            return
+        for k, col in enumerate(remaining):
+            if len(perm) % M == 0:
+                # new group: canonical iff every smaller col already used
+                # and the group leader exceeds the previous group's leader
+                if any(v < col and v in remaining for v in range(col)):
+                    continue
+                if perm and col < perm[-M]:
+                    continue
+            elif col < perm[-1]:
+                continue
+            build(perm + [col], remaining[:k] + remaining[k + 1 :])
+
+    build([0], list(range(1, C)))
+    return np.array(out, dtype=np.int64)
+
+
+def _kept_per_perm(subset: np.ndarray, perms: np.ndarray) -> np.ndarray:
+    """Retained |w| of ``subset`` (rows, C) under each canonical perm
+    (P, C): one gather + partition, the numpy twin of the reference's
+    build_permute_map CUDA kernel. Returns (P,)."""
+    a = np.abs(subset)[:, perms]  # (rows, P, C)
+    g = a.reshape(a.shape[0], a.shape[1], -1, 4)
+    return np.partition(g, 2, axis=-1)[..., 2:].sum(axis=(0, 2, 3))
+
+
+def exhaustive_search(
+    matrix,
+    stripe_group_size: int = 8,
+    escape_attempts: int = 0,
+    seed: int = 0,
+) -> np.ndarray:
+    """Bounded stripe-group exhaustive permutation search (ref
+    Exhaustive_Search, exhaustive_search.py:311).
+
+    ``stripe_group_size`` columns (= window of stripe_group_size/4 stripes,
+    default one stripe pair) are regrouped exhaustively at a time; the
+    greedy outer loop applies the best non-overlapping windows, then only
+    re-searches windows touching a changed stripe, until no window improves
+    (ref build_stripe_map/use_stripe_map). ``escape_attempts`` random
+    cross-half swaps perturb out of local minima like the reference's
+    sm_perturbations.
+    """
+    mat = np.array(matrix, dtype=np.float32, copy=True)
+    rows, cols = mat.shape
+    if cols % 4 != 0:
+        raise ValueError(f"cols ({cols}) not divisible by 4")
+    if stripe_group_size % 4 != 0 or not 8 <= stripe_group_size <= 12:
+        # window=1 has exactly one canonical regrouping (a silent no-op) and
+        # window>=4 enumerates >2.6M perms per window (an effective hang)
+        raise ValueError(
+            f"stripe_group_size ({stripe_group_size}) must be 8 or 12"
+        )
+    window = stripe_group_size // 4
+    num_stripes = cols // 4
+    perm = np.arange(cols)
+    if num_stripes < window:
+        return perm
+    rng = np.random.RandomState(seed)
+
+    perms = _unique_group_permutations(4 * window, 4)  # (35, 8) for pairs
+    groups = [np.array(g) for g in
+              itertools.combinations(range(num_stripes), window)]
+    group_cols = np.stack(
+        [(g[:, None] * 4 + np.arange(4)[None, :]).ravel() for g in
+         (np.asarray(g) for g in groups)]
+    )  # (G, 4*window)
+
+    n_groups = len(groups)
+    best_gain = np.full(n_groups, -1.0)
+    best_perm = np.zeros((n_groups, 4 * window), dtype=np.int64)
+    stale = np.ones(n_groups, dtype=bool)
+    escapes_left = escape_attempts
+
+    def total_retained():
+        a = np.abs(mat).reshape(rows, -1, 4)
+        return float(np.partition(a, 2, axis=-1)[..., 2:].sum())
+
+    # perturbations can leave the walk below its high-water mark, so the
+    # best-seen permutation is what gets returned (the reference returns
+    # whatever state the walk ends in; keeping the argmax is strictly safer)
+    best_seen_perm = perm.copy()
+    best_seen_val = total_retained()
+
+    while True:
+        # (re)build the stripe map for stale windows (ref build_stripe_map)
+        for gi in np.nonzero(stale)[0]:
+            ci = group_cols[gi]
+            kept = _kept_per_perm(mat[:, ci], perms)
+            b = kept[0]  # perms[0] is the identity regrouping
+            j = int(np.argmax(kept))
+            best_gain[gi] = kept[j] - b
+            best_perm[gi] = perms[j]
+        stale[:] = False
+
+        # greedy non-overlapping application (ref use_stripe_map)
+        order = np.argsort(-best_gain)
+        used_stripes: set = set()
+        applied = False
+        for gi in order:
+            if best_gain[gi] <= 1e-6:
+                break
+            g = groups[gi]
+            if any(int(s) in used_stripes for s in g):
+                continue
+            p = best_perm[gi]
+            ci = group_cols[gi]
+            mat[:, ci] = mat[:, ci[p]]
+            perm[ci] = perm[ci[p]]
+            applied = True
+            # a stripe actually changed unless its new group is the same
+            # aligned contiguous run it started as (ref use_stripe_map)
+            for s in range(window):
+                grp = p[s * 4 : (s + 1) * 4]
+                if grp[0] % 4 != 0 or not np.array_equal(
+                    grp, np.arange(grp[0], grp[0] + 4)
+                ):
+                    used_stripes.add(int(g[s]))
+
+        if used_stripes:
+            touched = np.array(
+                [any(int(s) in used_stripes for s in g) for g in groups]
+            )
+            stale |= touched
+        if applied:
+            val = total_retained()
+            if val > best_seen_val:
+                best_seen_val = val
+                best_seen_perm = perm.copy()
+        if not applied:
+            if escapes_left > 0:
+                escapes_left -= 1
+                # ref perturbation: swap one column across window halves
+                gi = rng.randint(n_groups)
+                ci = group_cols[gi]
+                # swap one column between two DISTINCT stripes of the window
+                # (a within-stripe swap never changes the 2:4 objective and
+                # would burn the escape attempt on a no-op)
+                s_a, s_b = rng.choice(window, size=2, replace=False)
+                src = s_a * 4 + rng.randint(4)
+                dst = s_b * 4 + rng.randint(4)
+                mat[:, [ci[src], ci[dst]]] = mat[:, [ci[dst], ci[src]]]
+                perm[[ci[src], ci[dst]]] = perm[[ci[dst], ci[src]]]
+                touched = np.array(
+                    [groups[gi][src // 4] in g or groups[gi][dst // 4] in g
+                     for g in groups]
+                )
+                stale |= touched
+                continue
+            break
+    return best_seen_perm
+
+
 def apply_permutation(tensor, perm, axis: int = -1):
     return jnp.take(tensor, jnp.asarray(perm), axis=axis)
 
@@ -77,11 +255,29 @@ def invert_permutation(perm) -> np.ndarray:
     return inv
 
 
-def permute_and_mask(matrix, max_iters: int = 1000) -> Tuple[np.ndarray, jnp.ndarray]:
+def permute_and_mask(
+    matrix, max_iters: int = 1000, method: str = "auto",
+    escape_attempts: int = 10,
+) -> Tuple[np.ndarray, jnp.ndarray]:
     """Convenience: search a permutation, return (perm, mask in ORIGINAL
     column order). masked = matrix * mask keeps the permuted-2:4 structure:
-    hardware sees 2:4 after applying ``perm`` to the columns."""
-    perm = search_for_good_permutation(matrix, max_iters=max_iters)
+    hardware sees 2:4 after applying ``perm`` to the columns.
+
+    ``method``:
+    - "auto" (default): stripe-group exhaustive up to 256 columns (~2 s at
+      128², ~16 s at 256²; the stale-window rebuild grows ~cols² so real
+      transformer widths would take hours), greedy (``max_iters`` swaps,
+      sub-second at any width) beyond;
+    - "exhaustive" / "greedy": force one engine.
+    """
+    if method == "auto":
+        method = "exhaustive" if np.shape(matrix)[-1] <= 256 else "greedy"
+    if method == "exhaustive":
+        perm = exhaustive_search(matrix, escape_attempts=escape_attempts)
+    elif method == "greedy":
+        perm = search_for_good_permutation(matrix, max_iters=max_iters)
+    else:
+        raise ValueError(f"unknown method {method!r}; expected auto|exhaustive|greedy")
     permuted = apply_permutation(jnp.asarray(matrix), perm, axis=-1)
     mask_p = mn_1d_best(permuted, 4, 2)
     mask = apply_permutation(mask_p, invert_permutation(perm), axis=-1)
