@@ -17,6 +17,11 @@ all-gather over the 'dp' mesh axis inside shard_map.
 """
 
 from apex_tpu.optimizers.fused_adam import fused_adam, FusedAdam
+from apex_tpu.optimizers.fused_adam_swa import (
+    fused_adam_swa,
+    swa_params,
+    FusedAdamSWA,
+)
 from apex_tpu.optimizers.fused_lamb import fused_lamb, FusedLAMB, FusedMixedPrecisionLamb
 from apex_tpu.optimizers.fused_sgd import fused_sgd, FusedSGD
 from apex_tpu.optimizers.fused_novograd import fused_novograd, FusedNovoGrad
@@ -35,6 +40,9 @@ from apex_tpu.optimizers.distributed_fused_lamb import (
 __all__ = [
     "fused_adam",
     "FusedAdam",
+    "fused_adam_swa",
+    "swa_params",
+    "FusedAdamSWA",
     "fused_lamb",
     "FusedLAMB",
     "FusedMixedPrecisionLamb",

@@ -1,0 +1,180 @@
+"""Fused Adam + stochastic weight averaging (openfold).
+
+Reference parity: apex.contrib.openfold_triton.fused_adam_swa.FusedAdamSWA
+(fused_adam_swa.py:208) — one kernel that per step (a) clips grads by a
+scale, (b) runs Adam on fp32 state params, (c) EMA-averages the result into
+a second fp32 SWA param stream (``_swa_math``: first step copies, then
+``swa += (1-decay)*(param-swa)``), and (d) re-materializes the bf16 compute
+params. The three ``adam_math_mode``s collapse to two on inspection:
+kApexAdam and kPyTorchAdam share identical update algebra
+((m/bc1)/(sqrt(v/bc2)+eps) == (1/bc1)*m/(sqrt(v)/sqrt(bc2)+eps)) with L2
+weight decay folded into the grad, while kApexAdamW applies decoupled
+decay — so the knob maps onto ``adam_w_mode`` exactly like fused_adam.
+
+TPU design: an optax-style transform whose state carries the fp32 master
+params AND the SWA stream; ``update`` returns deltas in the compute dtype
+(the bf16 re-materialization) and the caller reads averaged weights with
+``swa_params(state)``. Everything is one fused XLA computation under the
+caller's jit — the Triton chunk machinery (:120-200) has no TPU meaning.
+"""
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# ref fused_adam_swa.py:30-32
+kApexAdam = 0
+kApexAdamW = 1
+kPyTorchAdam = 2
+_ADAM_MODES = {
+    kApexAdam: False,  # adam_w_mode=False: L2 decay into the grad
+    kApexAdamW: True,  # decoupled decay
+    kPyTorchAdam: False,  # same algebra as kApexAdam (see module docstring)
+    "apex": False,
+    "apexw": True,
+    "pytorch": False,
+}
+
+
+class FusedAdamSWAState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any  # fp32
+    exp_avg_sq: Any  # fp32
+    master: Any  # fp32 state params (ref ``params`` group)
+    swa: Any  # fp32 averaged params (ref ``swa_params`` group)
+    n_averaged: jax.Array
+
+
+def fused_adam_swa(
+    swa_decay_rate: float,
+    lr: float = 1e-3,
+    bias_correction: bool = True,
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    adam_math_mode=kPyTorchAdam,
+    weight_decay: float = 0.0,
+    grad_clip_scale: float = 1.0,
+) -> optax.GradientTransformation:
+    """Optax transform with FusedAdamSWA semantics.
+
+    ``params`` passed to init/update are the COMPUTE params (bf16 in
+    openfold); fp32 masters and the SWA stream live in the state, mirroring
+    the reference's three parallel param lists (fused_adam_swa.py:210-213).
+    """
+    if adam_math_mode not in _ADAM_MODES:
+        raise ValueError(
+            f"Unknown Adam math mode {adam_math_mode!r}; expected "
+            f"kApexAdam(0) / kApexAdamW(1) / kPyTorchAdam(2)"
+        )
+    adam_w_mode = _ADAM_MODES[adam_math_mode]
+    beta1, beta2 = betas
+
+    def init_fn(params):
+        f32 = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, jnp.float32), t
+        )
+        zeros = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), params
+        )
+        return FusedAdamSWAState(
+            step=jnp.zeros((), jnp.int32),
+            exp_avg=zeros,
+            exp_avg_sq=jax.tree_util.tree_map(jnp.copy, zeros),
+            master=f32(params),
+            swa=f32(params),
+            n_averaged=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(grads, state, params=None):
+        if params is None:
+            raise ValueError("fused_adam_swa requires params")
+        step = state.step + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - beta1**stepf if bias_correction else jnp.asarray(1.0)
+        bc2 = 1.0 - beta2**stepf if bias_correction else jnp.asarray(1.0)
+
+        def one(g, p, m, v, s):
+            g = g.astype(jnp.float32) * grad_clip_scale  # ref grad-clip step
+            if not adam_w_mode and weight_decay != 0.0:
+                g = g + weight_decay * p
+            m = beta1 * m + (1.0 - beta1) * g
+            v = beta2 * v + (1.0 - beta2) * g * g
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if adam_w_mode and weight_decay != 0.0:
+                upd = upd + weight_decay * p
+            p = p - lr * upd
+            # _swa_math: copy on the first average, EMA afterwards
+            s = jnp.where(
+                state.n_averaged == 0, p, s + (1.0 - swa_decay_rate) * (p - s)
+            )
+            return p, m, v, s
+
+        g_leaves, treedef = jax.tree_util.tree_flatten(grads)
+        results = [
+            one(g, p, m, v, s)
+            for g, p, m, v, s in zip(
+                g_leaves,
+                treedef.flatten_up_to(state.master),
+                treedef.flatten_up_to(state.exp_avg),
+                treedef.flatten_up_to(state.exp_avg_sq),
+                treedef.flatten_up_to(state.swa),
+            )
+        ]
+        master, m, v, swa = (
+            jax.tree_util.tree_unflatten(treedef, [r[i] for r in results])
+            for i in range(4)
+        )
+        # updates re-materialize the compute params from the new masters
+        updates = jax.tree_util.tree_map(
+            lambda new, p: new.astype(p.dtype) - p, master, params
+        )
+        return updates, FusedAdamSWAState(
+            step=step, exp_avg=m, exp_avg_sq=v, master=master, swa=swa,
+            n_averaged=state.n_averaged + 1,
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def swa_params(state: FusedAdamSWAState, like: Any = None) -> Any:
+    """The averaged weights (ref swa_param_groups), optionally cast to the
+    dtypes of ``like`` (e.g. the bf16 compute params for evaluation)."""
+    if like is None:
+        return state.swa
+    return jax.tree_util.tree_map(
+        lambda s, p: s.astype(p.dtype), state.swa, like
+    )
+
+
+class FusedAdamSWA:
+    """Class-style wrapper mirroring the reference constructor; the three
+    param lists are implicit (masters/SWA live in optimizer state)."""
+
+    def __new__(
+        cls,
+        swa_decay_rate: float,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas: Tuple[float, float] = (0.9, 0.999),
+        eps: float = 1e-8,
+        adam_math_mode=kPyTorchAdam,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        capturable: bool = False,
+        master_weights: bool = False,
+        **_unused,
+    ):
+        if amsgrad:
+            raise NotImplementedError("amsgrad is not supported by FusedAdamSWA")
+        del capturable, master_weights  # inherent under jit / state-carried
+        return fused_adam_swa(
+            swa_decay_rate=swa_decay_rate,
+            lr=lr,
+            bias_correction=bias_correction,
+            betas=betas,
+            eps=eps,
+            adam_math_mode=adam_math_mode,
+            weight_decay=weight_decay,
+        )

@@ -100,13 +100,70 @@ class SyncBatchNorm(nn.Module):
         return y.astype(self.dtype or x.dtype)
 
 
-def convert_syncbn_model(*args, **kwargs):
-    """The reference performs module surgery BN -> SyncBN
-    (parallel/__init__.py:21). flax modules are immutable; select
-    SyncBatchNorm at model-construction time instead (our models take a
-    ``norm`` factory — see apex_tpu.models.resnet)."""
-    raise NotImplementedError(
-        "flax modules are declarative: construct models with "
-        "apex_tpu.parallel.SyncBatchNorm directly (see apex_tpu.models.resnet "
-        "norm= argument) instead of post-hoc surgery."
-    )
+def convert_syncbn_model(module, axis_names: Sequence[str] = ("dp",)):
+    """Module surgery BatchNorm -> SyncBatchNorm (ref:
+    apex.parallel.convert_syncbn_model, parallel/__init__.py:21-44, which
+    walks the torch module tree replacing BatchNorm instances).
+
+    flax modules are frozen dataclasses, so "surgery" is a recursive
+    ``clone`` with replaced fields:
+
+    - ``flax.linen.BatchNorm`` field values become ``SyncBatchNorm`` with
+      the same hyperparameters (momentum converted between flax's
+      ``new = m*old + (1-m)*batch`` and the torch convention used here);
+    - already-sync norms and modules exposing a ``bn_axes`` field (e.g.
+      apex_tpu.models.ResNet, contrib bottlenecks) are re-pointed at
+      ``axis_names``;
+    - nested module fields (including lists/tuples/dicts of modules)
+      recurse.
+
+    Parameter/batch-stats pytrees are structurally unchanged, so existing
+    variables keep working — same as the reference, which moves the torch
+    state dict across. Limitation (documented, inherent): submodules
+    constructed inline inside an ``@nn.compact`` body are invisible to any
+    post-hoc walk; modules like that should take a norm factory or
+    ``bn_axes`` argument instead (apex_tpu.models.resnet does).
+    """
+    def convert_value(v):
+        if isinstance(v, SyncBatchNorm):
+            return v.clone(axis_names=tuple(axis_names))
+        if isinstance(v, nn.BatchNorm):
+            if v.axis != -1:
+                # SyncBatchNorm normalizes the LAST axis; converting a
+                # channels-not-last BatchNorm would silently normalize the
+                # wrong axis AND change param shapes under the caller's
+                # existing variables
+                raise NotImplementedError(
+                    f"convert_syncbn_model: BatchNorm(axis={v.axis}) is not "
+                    "channels-last; transpose the model or construct "
+                    "SyncBatchNorm directly"
+                )
+            extra = (v.axis_name,) if v.axis_name else ()
+            return SyncBatchNorm(
+                use_running_average=v.use_running_average,
+                momentum=1.0 - v.momentum,  # flax -> torch convention
+                epsilon=v.epsilon,
+                use_scale=v.use_scale,
+                use_bias=v.use_bias,
+                axis_names=tuple(axis_names) + extra,
+                dtype=v.dtype,
+            )
+        if isinstance(v, nn.Module):
+            return convert_syncbn_model(v, axis_names=axis_names)
+        if isinstance(v, (list, tuple)):
+            return type(v)(convert_value(x) for x in v)
+        if isinstance(v, dict):
+            return {k: convert_value(x) for k, x in v.items()}
+        return v
+
+    updates = {}
+    for name in getattr(module, "__dataclass_fields__", {}):
+        if name in ("parent", "name"):
+            continue
+        old = getattr(module, name)
+        new = convert_value(old)
+        if name == "bn_axes":
+            new = tuple(axis_names)
+        if new is not old:
+            updates[name] = new
+    return module.clone(**updates) if updates else module

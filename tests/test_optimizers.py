@@ -114,6 +114,138 @@ class TestFusedAdam:
         assert max(jax.tree_util.tree_leaves(diffs)) > 1e-5
 
 
+class TestFusedAdamSWA:
+    """Ref apex/contrib/openfold_triton/fused_adam_swa.py:208 + its test
+    (tests/L0/run_openfold_triton/test_fused_adam_swa.py): Adam on fp32
+    masters, EMA into the SWA stream, bf16 compute params re-materialized."""
+
+    def _grads_fn(self):
+        gkey = jax.random.PRNGKey(7)
+        return lambda i, p: jax.tree_util.tree_map(
+            lambda x: jax.random.normal(
+                jax.random.fold_in(gkey, i), x.shape, jnp.float32
+            ).astype(x.dtype),
+            p,
+        )
+
+    @pytest.mark.parametrize("mode,wd_mode", [("apex", False), ("apexw", True),
+                                              ("pytorch", False)])
+    def test_master_trajectory_matches_fused_adam(self, rng, mode, wd_mode):
+        from apex_tpu.optimizers import fused_adam_swa
+
+        params = _params(rng)
+        grads_fn = self._grads_fn()
+        tx = fused_adam_swa(swa_decay_rate=0.9, lr=1e-2, weight_decay=0.1,
+                            adam_math_mode=mode)
+        state = tx.init(params)
+        p = dict(params)
+        for i in range(5):
+            updates, state = tx.update(grads_fn(i, p), state, p)
+            p = optax.apply_updates(p, updates)
+        ref = _run(
+            fused_adam(lr=1e-2, weight_decay=0.1, adam_w_mode=wd_mode),
+            dict(params), grads_fn,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            state.master, ref,
+        )
+        # compute params track the master cast to their dtype
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b.astype(a.dtype)), rtol=1e-6
+            ),
+            p, state.master,
+        )
+
+    def test_swa_math(self, rng):
+        """_swa_math (fused_adam_swa.py:120-131): first average copies,
+        then swa += (1-decay)*(param-swa)."""
+        from apex_tpu.optimizers import fused_adam_swa
+
+        params = _params(rng)
+        grads_fn = self._grads_fn()
+        decay = 0.75
+        tx = fused_adam_swa(swa_decay_rate=decay, lr=1e-2)
+        state = tx.init(params)
+        p = dict(params)
+        updates, state = tx.update(grads_fn(0, p), state, p)
+        p = optax.apply_updates(p, updates)
+        # n_averaged was 0 -> swa is a copy of the new master
+        jax.tree_util.tree_map(
+            lambda s, m: np.testing.assert_array_equal(
+                np.asarray(s), np.asarray(m)
+            ),
+            state.swa, state.master,
+        )
+        swa1 = state.swa
+        m1 = state.master
+        updates, state = tx.update(grads_fn(1, p), state, p)
+        expected = jax.tree_util.tree_map(
+            lambda s, m1_, m2: s + (1.0 - decay) * (m2 - s),
+            swa1, m1, state.master,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6
+            ),
+            state.swa, expected,
+        )
+        assert int(state.n_averaged) == 2
+
+    def test_bf16_compute_params(self, rng):
+        """The openfold configuration: bf16 compute params + fp32 state."""
+        from apex_tpu.optimizers import fused_adam_swa, swa_params
+
+        params = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16), _params(rng)
+        )
+        tx = fused_adam_swa(swa_decay_rate=0.9, lr=1e-2)
+        state = tx.init(params)
+        assert all(
+            l.dtype == jnp.float32
+            for l in jax.tree_util.tree_leaves((state.master, state.swa))
+        )
+        updates, state = tx.update(self._grads_fn()(0, params), state, params)
+        assert all(
+            l.dtype == jnp.bfloat16 for l in jax.tree_util.tree_leaves(updates)
+        )
+        avg = swa_params(state, like=params)
+        assert all(
+            l.dtype == jnp.bfloat16 for l in jax.tree_util.tree_leaves(avg)
+        )
+
+    def test_grad_clip_scale(self, rng):
+        from apex_tpu.optimizers import fused_adam_swa
+
+        params = _params(rng)
+        grads_fn = self._grads_fn()
+        halved = lambda i, p: jax.tree_util.tree_map(
+            lambda g: 2.0 * g, grads_fn(i, p)
+        )
+        a = fused_adam_swa(swa_decay_rate=0.9, lr=1e-2, grad_clip_scale=0.5)
+        b = fused_adam_swa(swa_decay_rate=0.9, lr=1e-2)
+        sa, sb = a.init(params), b.init(params)
+        ua, sa = a.update(halved(0, params), sa, params)
+        ub, sb = b.update(grads_fn(0, params), sb, params)
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6
+            ),
+            ua, ub,
+        )
+
+    def test_rejects_unknown_mode_and_amsgrad(self, rng):
+        from apex_tpu.optimizers import FusedAdamSWA, fused_adam_swa
+
+        with pytest.raises(ValueError, match="math mode"):
+            fused_adam_swa(swa_decay_rate=0.9, adam_math_mode="nope")
+        with pytest.raises(NotImplementedError):
+            FusedAdamSWA(swa_decay_rate=0.9, amsgrad=True)
+
+
 class TestFusedSGD:
     @pytest.mark.parametrize("momentum,nesterov", [(0.0, False), (0.9, False), (0.9, True)])
     def test_matches_torch_semantics(self, rng, momentum, nesterov):

@@ -107,3 +107,63 @@ class TestResNet:
         y_dp = fwd_sync(variables, x)
         y_ref, _ = model_local.apply(variables, x, train=True, mutable=["batch_stats"])
         np.testing.assert_allclose(y_dp, y_ref, rtol=2e-3, atol=2e-3)
+
+
+class TestConvertSyncbnModel:
+    """Ref apex.parallel.convert_syncbn_model (parallel/__init__.py:21-44):
+    post-hoc BN -> SyncBN surgery with state carried across unchanged."""
+
+    def test_repoints_bn_axes_and_preserves_variables(self, rng):
+        from apex_tpu.parallel import convert_syncbn_model
+
+        model = tiny_resnet()  # local BN (bn_axes=())
+        converted = convert_syncbn_model(model, axis_names=("dp",))
+        assert converted.bn_axes == ("dp",)
+        assert model.bn_axes == ()  # original untouched (frozen dataclass)
+
+        # same variable structure: the torch version moves state dicts over;
+        # here the SAME variables apply to both models
+        x = jax.random.normal(rng, (4, 16, 16, 3))
+        variables = model.init(rng, x)
+        mesh = parallel_state.initialize_model_parallel()  # dp=8
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P("dp")),
+            out_specs=P("dp"), check_vma=False,
+        )
+        def fwd(v, xl):
+            y, _ = converted.apply(v, xl, train=True, mutable=["batch_stats"])
+            return y
+
+        x8 = jax.random.normal(rng, (16, 16, 16, 3))
+        y_conv = fwd(variables, x8)
+        y_ref, _ = model.apply(variables, x8, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(y_conv, y_ref, rtol=2e-3, atol=2e-3)
+
+    def test_converts_flax_batchnorm_field(self):
+        import flax.linen as nn
+
+        from apex_tpu.parallel import SyncBatchNorm, convert_syncbn_model
+
+        class WithBN(nn.Module):
+            norm: nn.Module = None
+
+            @nn.compact
+            def __call__(self, x):
+                return self.norm(x)
+
+        m = WithBN(norm=nn.BatchNorm(use_running_average=False, momentum=0.9))
+        c = convert_syncbn_model(m, axis_names=("dp",))
+        assert isinstance(c.norm, SyncBatchNorm)
+        assert c.norm.axis_names == ("dp",)
+        # flax momentum 0.9 (new = 0.9*old + 0.1*batch) -> torch-convention 0.1
+        np.testing.assert_allclose(c.norm.momentum, 0.1)
+
+    def test_identity_when_nothing_to_convert(self):
+        import flax.linen as nn
+
+        from apex_tpu.parallel import convert_syncbn_model
+
+        m = nn.Dense(4)
+        assert convert_syncbn_model(m) is m
