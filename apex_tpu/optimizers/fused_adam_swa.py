@@ -161,6 +161,7 @@ class FusedAdamSWA:
         eps: float = 1e-8,
         adam_math_mode=kPyTorchAdam,
         weight_decay: float = 0.0,
+        grad_clip_scale: float = 1.0,
         amsgrad: bool = False,
         capturable: bool = False,
         master_weights: bool = False,
@@ -177,4 +178,5 @@ class FusedAdamSWA:
             eps=eps,
             adam_math_mode=adam_math_mode,
             weight_decay=weight_decay,
+            grad_clip_scale=grad_clip_scale,
         )

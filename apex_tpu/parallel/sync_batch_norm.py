@@ -42,6 +42,7 @@ class SyncBatchNorm(nn.Module):
     axis_names: Sequence[str] = ("dp",)
     dtype: Optional[jnp.dtype] = None
     scale_init: Callable = nn.initializers.ones_init()
+    bias_init: Callable = nn.initializers.zeros_init()
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -95,7 +96,7 @@ class SyncBatchNorm(nn.Module):
             scale = self.param("scale", self.scale_init, (features,), jnp.float32)
             y = y * scale
         if self.use_bias:
-            bias = self.param("bias", nn.initializers.zeros_init(), (features,), jnp.float32)
+            bias = self.param("bias", self.bias_init, (features,), jnp.float32)
             y = y + bias
         return y.astype(self.dtype or x.dtype)
 
@@ -138,6 +139,12 @@ def convert_syncbn_model(module, axis_names: Sequence[str] = ("dp",)):
                     "channels-last; transpose the model or construct "
                     "SyncBatchNorm directly"
                 )
+            if v.axis_index_groups is not None:
+                raise NotImplementedError(
+                    "convert_syncbn_model: axis_index_groups (subgroup "
+                    "sync) has no SyncBatchNorm equivalent; construct the "
+                    "sync norm directly"
+                )
             extra = (v.axis_name,) if v.axis_name else ()
             return SyncBatchNorm(
                 use_running_average=v.use_running_average,
@@ -147,6 +154,8 @@ def convert_syncbn_model(module, axis_names: Sequence[str] = ("dp",)):
                 use_bias=v.use_bias,
                 axis_names=tuple(axis_names) + extra,
                 dtype=v.dtype,
+                scale_init=v.scale_init,
+                bias_init=v.bias_init,
             )
         if isinstance(v, nn.Module):
             return convert_syncbn_model(v, axis_names=axis_names)

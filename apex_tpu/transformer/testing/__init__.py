@@ -1,0 +1,51 @@
+"""Megatron-style testing harness (args + globals + helpers).
+
+Reference parity: apex/transformer/testing — the argument system its
+standalone LM scripts and L0 transformer tests build on. The standalone
+training-script role is filled by examples/gpt_pretrain.py and
+examples/imagenet (see README component map).
+"""
+
+from apex_tpu.transformer.testing.arguments import (
+    parse_args,
+    transformer_config_from_args,
+    validate_args,
+)
+from apex_tpu.transformer.testing.commons import (
+    IdentityLayer,
+    TEST_SUCCESS_MESSAGE,
+    initialize_distributed,
+    model_provider_func,
+    print_separator,
+    set_random_seed,
+)
+from apex_tpu.transformer.testing.global_vars import (
+    destroy_global_variables,
+    get_args,
+    get_current_global_batch_size,
+    get_num_microbatches,
+    get_tensorboard_writer,
+    get_timers,
+    set_global_variables,
+    update_num_microbatches,
+)
+
+__all__ = [
+    "parse_args",
+    "validate_args",
+    "transformer_config_from_args",
+    "set_random_seed",
+    "initialize_distributed",
+    "print_separator",
+    "model_provider_func",
+    "IdentityLayer",
+    "TEST_SUCCESS_MESSAGE",
+    "get_args",
+    "get_num_microbatches",
+    "get_current_global_batch_size",
+    "update_num_microbatches",
+    "get_tensorboard_writer",
+    "get_timers",
+    "set_global_variables",
+    "destroy_global_variables",
+]

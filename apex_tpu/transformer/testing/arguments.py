@@ -1,0 +1,554 @@
+"""Megatron-style argument system.
+
+Reference parity: apex/transformer/testing/arguments.py:23 (parse_args over
+14 argument groups, 188 flags, plus the post-parse derivation/validation
+block :60-320). Flag names, groups, defaults, and the derivation rules are
+kept identical so reference launch commands work verbatim; the handful of
+CUDA-only knobs (DDP impl, contiguous buffers, NCCL backend) are accepted
+for compatibility and recorded on the namespace, where the TPU runtime
+simply has no use for them (XLA owns those decisions).
+
+TPU adaptations in the derivation block:
+- ``world_size`` comes from ``jax.device_count()`` when no WORLD_SIZE env
+  is present (SPMD: one process sees all chips);
+- ``params_dtype`` is a jnp dtype (fp16/bf16 flags map like the reference);
+- ``checkpoint_activations``/``recompute_*`` map onto the ``remat`` knobs
+  of the compiled schedules (schedules.py) rather than torch checkpointing.
+"""
+
+import argparse
+import os
+
+import jax.numpy as jnp
+
+
+def parse_args(extra_args_provider=None, defaults={}, override_args={},
+               ignore_unknown_args=False, args=None):
+    """Parse all arguments (ref arguments.py:23-120).
+
+    ``args``: optional explicit argv list (the reference reads sys.argv;
+    tests pass lists).
+    """
+    parser = argparse.ArgumentParser(description="Megatron-LM Arguments",
+                                     allow_abbrev=False)
+
+    parser = _add_network_size_args(parser)
+    parser = _add_regularization_args(parser)
+    parser = _add_training_args(parser)
+    parser = _add_initialization_args(parser)
+    parser = _add_learning_rate_args(parser)
+    parser = _add_checkpointing_args(parser)
+    parser = _add_mixed_precision_args(parser)
+    parser = _add_distributed_args(parser)
+    parser = _add_validation_args(parser)
+    parser = _add_data_args(parser)
+    parser = _add_autoresume_args(parser)
+    parser = _add_biencoder_args(parser)
+    parser = _add_vision_args(parser)
+    parser = _add_logging_args(parser)
+    parser.add_argument("--cpu-offload", action="store_true", default=False,
+                        help="Turns on CPU offloading")
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        parsed, _ = parser.parse_known_args(args)
+    else:
+        parsed = parser.parse_args(args)
+
+    # apply defaults that were not explicitly set on the command line
+    for key, value in defaults.items():
+        if getattr(parsed, key, None) is None:
+            setattr(parsed, key, value)
+
+    return validate_args(parsed, override_args)
+
+
+def validate_args(args, override_args={}):
+    """The reference's post-parse derivation block (arguments.py:60-320)."""
+    args.rank = int(os.getenv("RANK", "0"))
+    world = os.getenv("WORLD_SIZE")
+    if world is not None:
+        args.world_size = int(world)
+    else:
+        try:
+            import jax
+
+            args.world_size = jax.device_count()
+        except Exception:  # backend not initialized / unavailable
+            args.world_size = 1
+
+    for key in override_args:
+        setattr(args, key, override_args[key])
+
+    # tensor/pipeline sizes clamp to the world like the reference
+    args.tensor_model_parallel_size = min(
+        args.tensor_model_parallel_size, args.world_size
+    )
+    assert args.world_size % args.tensor_model_parallel_size == 0, (
+        f"world size ({args.world_size}) is not divisible by tensor model "
+        f"parallel size ({args.tensor_model_parallel_size})"
+    )
+    args.pipeline_model_parallel_size = min(
+        args.pipeline_model_parallel_size,
+        args.world_size // args.tensor_model_parallel_size,
+    )
+    args.transformer_pipeline_model_parallel_size = (
+        args.pipeline_model_parallel_size - 1
+        if args.standalone_embedding_stage
+        else args.pipeline_model_parallel_size
+    )
+    model_parallel_size = (
+        args.pipeline_model_parallel_size * args.tensor_model_parallel_size
+    )
+    assert args.world_size % model_parallel_size == 0, (
+        f"world size ({args.world_size}) is not divisible by tensor "
+        f"({args.tensor_model_parallel_size}) times pipeline "
+        f"({args.pipeline_model_parallel_size}) parallel sizes"
+    )
+    args.data_parallel_size = args.world_size // model_parallel_size
+    if args.pipeline_model_parallel_size > 1:
+        if args.pipeline_model_parallel_split_rank is not None:
+            assert (
+                args.pipeline_model_parallel_split_rank
+                < args.pipeline_model_parallel_size
+            ), "split rank needs to be less than pipeline model parallel size"
+
+    # deprecated arguments (ref :104-118)
+    assert args.batch_size is None, (
+        "--batch-size argument is no longer valid, use --micro-batch-size"
+    )
+    del args.batch_size
+    assert args.warmup is None, (
+        "--warmup argument is no longer valid, use --lr-warmup-fraction"
+    )
+    del args.warmup
+    assert args.model_parallel_size is None, (
+        "--model-parallel-size is no longer valid, "
+        "use --tensor-model-parallel-size"
+    )
+    del args.model_parallel_size
+
+    # recompute knobs (ref :119-127); full/uniform == schedules remat=True
+    if args.checkpoint_activations:
+        args.recompute_granularity = "full"
+        args.recompute_method = "uniform"
+    del args.checkpoint_activations
+    if args.recompute_activations:
+        args.recompute_granularity = "selective"
+    del args.recompute_activations
+
+    # batch sizes (ref :143-151)
+    assert args.micro_batch_size is not None
+    assert args.micro_batch_size > 0
+    if args.global_batch_size is None:
+        args.global_batch_size = args.micro_batch_size * args.data_parallel_size
+    assert args.global_batch_size > 0
+
+    # virtual pipeline (ref :152-162)
+    if args.num_layers_per_virtual_pipeline_stage is not None:
+        assert args.pipeline_model_parallel_size > 2, (
+            "pipeline-model-parallel size should be greater than 2 with "
+            "interleaved schedule"
+        )
+        assert (
+            args.num_layers % args.num_layers_per_virtual_pipeline_stage == 0
+        ), "number of layers is not divisible by number of layers per virtual pipeline stage"
+        args.virtual_pipeline_model_parallel_size = (
+            args.num_layers // args.pipeline_model_parallel_size
+        ) // args.num_layers_per_virtual_pipeline_stage
+    else:
+        args.virtual_pipeline_model_parallel_size = None
+
+    # params dtype (ref :165-180); bf16 is the TPU-native half
+    args.params_dtype = jnp.float32
+    if args.fp16:
+        assert not args.bf16
+        args.params_dtype = jnp.float16
+    if args.bf16:
+        assert not args.fp16
+        args.params_dtype = jnp.bfloat16
+        if not args.accumulate_allreduce_grads_in_fp32:
+            args.accumulate_allreduce_grads_in_fp32 = True
+
+    if args.dataloader_type is None:
+        args.dataloader_type = "single"
+    args.consumed_train_samples = 0
+    args.consumed_valid_samples = 0
+
+    # iteration-based vs sample-based training (ref :205-235)
+    if args.train_iters:
+        assert args.train_samples is None, (
+            "expected iteration-based training"
+        )
+        assert args.lr_decay_samples is None, (
+            "expected iteration-based learning rate decay"
+        )
+        assert args.lr_warmup_samples == 0, (
+            "expected iteration-based learning rate warmup"
+        )
+        assert args.rampup_batch_size is None, (
+            "expected no batch-size rampup for iteration-based training"
+        )
+        if args.lr_warmup_fraction is not None:
+            assert args.lr_warmup_iters == 0, (
+                "can only specify one of lr-warmup-fraction and lr-warmup-iters"
+            )
+    if args.train_samples:
+        assert args.train_iters is None, "expected sample-based training"
+        assert args.lr_decay_iters is None, (
+            "expected sample-based learning rate decay"
+        )
+        assert args.lr_warmup_iters == 0, (
+            "expected sample-based learning rate warmup"
+        )
+        if args.lr_warmup_fraction is not None:
+            assert args.lr_warmup_samples == 0, (
+                "can only specify one of lr-warmup-fraction and lr-warmup-samples"
+            )
+
+    # consistency checks (ref :240-280)
+    if args.ffn_hidden_size is None:
+        args.ffn_hidden_size = 4 * args.hidden_size
+    if args.kv_channels is None:
+        assert args.hidden_size % args.num_attention_heads == 0
+        args.kv_channels = args.hidden_size // args.num_attention_heads
+    if args.seq_length is not None:
+        assert args.encoder_seq_length is None
+        args.encoder_seq_length = args.seq_length
+    else:
+        assert args.encoder_seq_length is not None
+        args.seq_length = args.encoder_seq_length
+    if args.seq_length is not None and args.max_position_embeddings is not None:
+        assert args.max_position_embeddings >= args.seq_length
+    if args.decoder_seq_length is not None and args.max_position_embeddings is not None:
+        assert args.max_position_embeddings >= args.decoder_seq_length
+    if args.lr is not None and args.min_lr is not None:
+        assert args.min_lr <= args.lr
+    if args.save is not None and args.save_interval is not None:
+        assert args.save_interval > 0
+    if args.fp32_residual_connection:
+        assert args.fp16 or args.bf16, (
+            "residual connection in fp32 only supported when using fp16 or bf16"
+        )
+    if args.recompute_granularity == "selective":
+        assert args.recompute_method is None, (
+            "recompute method is not yet supported for selective recomputing granularity"
+        )
+
+    # sequence parallelism needs tensor parallelism (ref :300-310)
+    if args.sequence_parallel:
+        assert args.tensor_model_parallel_size > 1, (
+            "sequence parallelism requires tensor parallelism"
+        )
+
+    return args
+
+
+def transformer_config_from_args(args):
+    """Map a parsed namespace onto ``TransformerConfig`` (the reference's
+    tests thread args into their transformer layers field by field)."""
+    from apex_tpu.transformer import TransformerConfig
+
+    return TransformerConfig(
+        num_layers=args.num_layers,
+        hidden_size=args.hidden_size,
+        num_attention_heads=args.num_attention_heads,
+        vocab_size=args.padded_vocab_size
+        if getattr(args, "padded_vocab_size", None)
+        else args.make_vocab_size_divisible_by,
+        max_position_embeddings=args.max_position_embeddings,
+        ffn_hidden_size=args.ffn_hidden_size,
+        hidden_dropout=args.hidden_dropout,
+        attention_dropout=args.attention_dropout,
+        layernorm_epsilon=args.layernorm_epsilon,
+        sequence_parallel=args.sequence_parallel,
+        compute_dtype=args.params_dtype,
+    )
+
+
+def _add_network_size_args(parser):
+    group = parser.add_argument_group(title="network size")
+    group.add_argument("--num-layers", type=int, default=None)
+    group.add_argument("--hidden-size", type=int, default=None)
+    group.add_argument("--ffn-hidden-size", type=int, default=None)
+    group.add_argument("--num-attention-heads", type=int, default=None)
+    group.add_argument("--kv-channels", type=int, default=None)
+    group.add_argument("--max-position-embeddings", type=int, default=None)
+    group.add_argument("--make-vocab-size-divisible-by", type=int, default=128)
+    group.add_argument("--layernorm-epsilon", type=float, default=1e-5)
+    group.add_argument("--apply-residual-connection-post-layernorm",
+                       action="store_true")
+    group.add_argument("--openai-gelu", action="store_true")
+    group.add_argument("--onnx-safe", type=bool, default=None)
+    group.add_argument("--bert-no-binary-head", action="store_false",
+                       dest="bert_binary_head")
+    group.add_argument("--num-experts", type=int, default=None)
+    return parser
+
+
+def _add_regularization_args(parser):
+    group = parser.add_argument_group(title="regularization")
+    group.add_argument("--attention-dropout", type=float, default=0.1)
+    group.add_argument("--hidden-dropout", type=float, default=0.1)
+    group.add_argument("--weight-decay", type=float, default=0.01)
+    group.add_argument("--start-weight-decay", type=float)
+    group.add_argument("--end-weight-decay", type=float)
+    group.add_argument("--weight-decay-incr-style", type=str, default="constant",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--clip-grad", type=float, default=1.0)
+    group.add_argument("--adam-beta1", type=float, default=0.9)
+    group.add_argument("--adam-beta2", type=float, default=0.999)
+    group.add_argument("--adam-eps", type=float, default=1e-8)
+    group.add_argument("--sgd-momentum", type=float, default=0.9)
+    return parser
+
+
+def _add_training_args(parser):
+    group = parser.add_argument_group(title="training")
+    group.add_argument("--micro-batch-size", type=int, default=None)
+    group.add_argument("--batch-size", type=int, default=None,
+                       help="Old batch size parameter, do not use. Use --micro-batch-size instead")
+    group.add_argument("--global-batch-size", type=int, default=None)
+    group.add_argument("--rampup-batch-size", nargs="*", default=None)
+    group.add_argument("--recompute-activations", action="store_true")
+    group.add_argument("--recompute-granularity", type=str, default=None,
+                       choices=["full", "selective"])
+    group.add_argument("--distribute-saved-activations", action="store_true")
+    group.add_argument("--recompute-method", type=str, default=None,
+                       choices=["uniform", "block"])
+    group.add_argument("--recompute-num-layers", type=int, default=1)
+    group.add_argument("--checkpoint-activations", action="store_true")
+    group.add_argument("--train-iters", type=int, default=None)
+    group.add_argument("--train-samples", type=int, default=None)
+    group.add_argument("--log-interval", type=int, default=100)
+    group.add_argument("--exit-interval", type=int, default=None)
+    group.add_argument("--exit-duration-in-mins", type=int, default=None)
+    group.add_argument("--tensorboard-dir", type=str, default=None)
+    group.add_argument("--no-masked-softmax-fusion", action="store_false",
+                       dest="masked_softmax_fusion")
+    group.add_argument("--no-bias-gelu-fusion", action="store_false",
+                       dest="bias_gelu_fusion")
+    group.add_argument("--no-bias-dropout-fusion", action="store_false",
+                       dest="bias_dropout_fusion")
+    group.add_argument("--optimizer", type=str, default="adam",
+                       choices=["adam", "sgd"])
+    group.add_argument("--dataloader-type", type=str, default=None,
+                       choices=["single", "cyclic"])
+    group.add_argument("--no-async-tensor-model-parallel-allreduce",
+                       action="store_true")
+    group.add_argument("--no-persist-layer-norm", action="store_true")
+    group.add_argument("--sequence-parallel", action="store_true")
+    group.add_argument("--no-gradient-accumulation-fusion",
+                       action="store_false",
+                       dest="gradient_accumulation_fusion")
+    return parser
+
+
+def _add_initialization_args(parser):
+    group = parser.add_argument_group(title="initialization")
+    group.add_argument("--seed", type=int, default=1234)
+    group.add_argument("--init-method-std", type=float, default=0.02)
+    group.add_argument("--init-method-xavier-uniform", action="store_true")
+    return parser
+
+
+def _add_learning_rate_args(parser):
+    group = parser.add_argument_group(title="learning rate")
+    group.add_argument("--lr", type=float, default=None)
+    group.add_argument("--lr-decay-style", type=str, default="linear",
+                       choices=["constant", "linear", "cosine"])
+    group.add_argument("--lr-decay-iters", type=int, default=None)
+    group.add_argument("--lr-decay-samples", type=int, default=None)
+    group.add_argument("--lr-warmup-fraction", type=float, default=None)
+    group.add_argument("--lr-warmup-iters", type=int, default=0)
+    group.add_argument("--lr-warmup-samples", type=int, default=0)
+    group.add_argument("--warmup", type=int, default=None,
+                       help="Old lr warmup argument, do not use. Use --lr-warmup-fraction instead")
+    group.add_argument("--min-lr", type=float, default=0.0)
+    group.add_argument("--override-lr-scheduler", action="store_true")
+    group.add_argument("--use-checkpoint-lr-scheduler", action="store_true")
+    return parser
+
+
+def _add_checkpointing_args(parser):
+    group = parser.add_argument_group(title="checkpointing")
+    group.add_argument("--save", type=str, default=None)
+    group.add_argument("--save-interval", type=int, default=None)
+    group.add_argument("--no-save-optim", action="store_true", default=None)
+    group.add_argument("--no-save-rng", action="store_true", default=None)
+    group.add_argument("--load", type=str, default=None)
+    group.add_argument("--no-load-optim", action="store_true", default=None)
+    group.add_argument("--no-load-rng", action="store_true", default=None)
+    group.add_argument("--finetune", action="store_true")
+    return parser
+
+
+def _add_mixed_precision_args(parser):
+    group = parser.add_argument_group(title="mixed precision")
+    group.add_argument("--fp16", action="store_true")
+    group.add_argument("--bf16", action="store_true")
+    group.add_argument("--loss-scale", type=float, default=None)
+    group.add_argument("--initial-loss-scale", type=float, default=2**32)
+    group.add_argument("--min-loss-scale", type=float, default=1.0)
+    group.add_argument("--loss-scale-window", type=float, default=1000)
+    group.add_argument("--hysteresis", type=int, default=2)
+    group.add_argument("--fp32-residual-connection", action="store_true")
+    group.add_argument("--no-query-key-layer-scaling", action="store_false",
+                       dest="apply_query_key_layer_scaling")
+    group.add_argument("--attention-softmax-in-fp32", action="store_true")
+    group.add_argument("--accumulate-allreduce-grads-in-fp32",
+                       action="store_true")
+    group.add_argument("--fp16-lm-cross-entropy", action="store_true")
+    return parser
+
+
+def _add_distributed_args(parser):
+    group = parser.add_argument_group(title="distributed")
+    group.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    group.add_argument("--pipeline-model-parallel-split-rank", type=int,
+                       default=None)
+    group.add_argument("--model-parallel-size", type=int, default=None,
+                       help="Old model parallel argument, do not use. Use --tensor-model-parallel-size instead")
+    group.add_argument("--num-layers-per-virtual-pipeline-stage", type=int,
+                       default=None)
+    group.add_argument("--distributed-backend", default="xla",
+                       choices=["nccl", "gloo", "ucc", "xla"])
+    group.add_argument("--DDP-impl", default="local",
+                       choices=["local", "torch"])
+    group.add_argument("--no-contiguous-buffers-in-local-ddp",
+                       action="store_false",
+                       dest="use_contiguous_buffers_in_local_ddp")
+    group.add_argument("--no-scatter-gather-tensors-in-pipeline",
+                       action="store_false",
+                       dest="scatter_gather_tensors_in_pipeline")
+    group.add_argument("--local_rank", type=int, default=None)
+    group.add_argument("--lazy-mpu-init", type=bool, default=None)
+    group.add_argument("--use-cpu-initialization", action="store_true",
+                       default=None)
+    group.add_argument("--empty-unused-memory-level", default=0, type=int,
+                       choices=[0, 1, 2])
+    group.add_argument("--standalone-embedding-stage", action="store_true",
+                       default=False)
+    return parser
+
+
+def _add_validation_args(parser):
+    group = parser.add_argument_group(title="validation")
+    group.add_argument("--eval-iters", type=int, default=100)
+    group.add_argument("--eval-interval", type=int, default=1000)
+    return parser
+
+
+def _add_data_args(parser):
+    group = parser.add_argument_group(title="data and dataloader")
+    group.add_argument("--data-path", nargs="*", default=None)
+    group.add_argument("--split", type=str, default="969, 30, 1")
+    group.add_argument("--vocab-file", type=str, default=None)
+    group.add_argument("--merge-file", type=str, default=None)
+    group.add_argument("--vocab-extra-ids", type=int, default=0)
+    group.add_argument("--seq-length", type=int, default=None)
+    group.add_argument("--encoder-seq-length", type=int, default=None)
+    group.add_argument("--decoder-seq-length", type=int, default=None)
+    group.add_argument("--retriever-seq-length", type=int, default=256)
+    group.add_argument("--sample-rate", type=float, default=1.0)
+    group.add_argument("--mask-prob", type=float, default=0.15)
+    group.add_argument("--short-seq-prob", type=float, default=0.1)
+    group.add_argument("--mmap-warmup", action="store_true")
+    group.add_argument("--num-workers", type=int, default=2)
+    group.add_argument("--tokenizer-type", type=str, default=None,
+                       choices=["BertWordPieceLowerCase", "BertWordPieceCase",
+                                "GPT2BPETokenizer"])
+    group.add_argument("--data-impl", type=str, default="infer",
+                       choices=["lazy", "cached", "mmap", "infer"])
+    group.add_argument("--reset-position-ids", action="store_true")
+    group.add_argument("--reset-attention-mask", action="store_true")
+    group.add_argument("--eod-mask-loss", action="store_true")
+    return parser
+
+
+def _add_autoresume_args(parser):
+    group = parser.add_argument_group(title="autoresume")
+    group.add_argument("--adlr-autoresume", action="store_true")
+    group.add_argument("--adlr-autoresume-interval", type=int, default=1000)
+    return parser
+
+
+def _add_biencoder_args(parser):
+    group = parser.add_argument_group(title="biencoder")
+    group.add_argument("--ict-head-size", type=int, default=None)
+    group.add_argument("--biencoder-projection-dim", type=int, default=0)
+    group.add_argument("--biencoder-shared-query-context-model",
+                       action="store_true")
+    group.add_argument("--ict-load", type=str, default=None)
+    group.add_argument("--bert-load", type=str, default=None)
+    group.add_argument("--titles-data-path", type=str, default=None)
+    group.add_argument("--query-in-block-prob", type=float, default=0.1)
+    group.add_argument("--use-one-sent-docs", action="store_true")
+    group.add_argument("--evidence-data-path", type=str, default=None)
+    group.add_argument("--retriever-report-topk-accuracies", nargs="+",
+                       type=int, default=[])
+    group.add_argument("--retriever-score-scaling", action="store_true")
+    group.add_argument("--block-data-path", type=str, default=None)
+    group.add_argument("--embedding-path", type=str, default=None)
+    group.add_argument("--indexer-batch-size", type=int, default=128)
+    group.add_argument("--indexer-log-interval", type=int, default=1000)
+    return parser
+
+
+def _add_vision_args(parser):
+    group = parser.add_argument_group(title="vision")
+    group.add_argument("--num-classes", type=int, default=1000)
+    group.add_argument("--img-h", type=int, default=224)
+    group.add_argument("--img-w", type=int, default=224)
+    group.add_argument("--num-channels", type=int, default=3)
+    group.add_argument("--patch-dim", type=int, default=16)
+    group.add_argument("--classes-fraction", type=float, default=1.0)
+    group.add_argument("--data-per-class-fraction", type=float, default=1.0)
+    group.add_argument("--no-data-sharding", action="store_false",
+                       dest="data_sharding")
+    group.add_argument("--head-lr-mult", type=float, default=1.0)
+    group.add_argument("--vision-pretraining", action="store_true")
+    group.add_argument("--vision-pretraining-type", type=str, default="classify",
+                       choices=["classify", "inpaint", "dino"])
+    group.add_argument("--vision-backbone-type", type=str, default="vit",
+                       choices=["vit", "mit", "swin"])
+    group.add_argument("--swin-backbone-type", type=str, default="tiny",
+                       choices=["tiny", "base", "h3"])
+    group.add_argument("--mask-type", type=str, default="random",
+                       choices=["random", "row"])
+    group.add_argument("--mask-factor", type=float, default=1.0)
+    group.add_argument("--iter-per-epoch", type=int, default=1250)
+    group.add_argument("--dino-local-img-size", type=int, default=96)
+    group.add_argument("--dino-local-crops-number", type=int, default=10)
+    group.add_argument("--dino-head-hidden-size", type=int, default=2048)
+    group.add_argument("--dino-bottleneck-size", type=int, default=256)
+    group.add_argument("--dino-freeze-last-layer", type=float, default=1)
+    group.add_argument("--dino-norm-last-layer", action="store_true")
+    group.add_argument("--dino-warmup-teacher-temp", type=float, default=0.04)
+    group.add_argument("--dino-teacher-temp", type=float, default=0.07)
+    group.add_argument("--dino-warmup-teacher-temp-epochs", type=int, default=30)
+    return parser
+
+
+def _add_logging_args(parser):
+    group = parser.add_argument_group(title="logging")
+    group.add_argument("--log-params-norm", action="store_true")
+    group.add_argument("--log-num-zeros-in-grad", action="store_true")
+    group.add_argument("--tensorboard-log-interval", type=int, default=1)
+    group.add_argument("--tensorboard-queue-size", type=int, default=1000)
+    group.add_argument("--log-timers-to-tensorboard", action="store_true")
+    group.add_argument("--log-batch-size-to-tensorboard", action="store_true")
+    group.add_argument("--no-log-learnig-rate-to-tensorboard",
+                       action="store_false",
+                       dest="log_learning_rate_to_tensorboard")
+    group.add_argument("--no-log-loss-scale-to-tensorboard",
+                       action="store_false",
+                       dest="log_loss_scale_to_tensorboard")
+    group.add_argument("--log-validation-ppl-to-tensorboard",
+                       action="store_true")
+    group.add_argument("--log-memory-to-tensorboard", action="store_true")
+    group.add_argument("--log-world-size-to-tensorboard", action="store_true")
+    return parser

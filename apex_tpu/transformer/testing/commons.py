@@ -1,0 +1,106 @@
+"""Test-harness helpers.
+
+Reference parity: apex/transformer/testing/commons.py — the shared pieces
+its L0 transformer tests import: ``set_random_seed`` (:242),
+``initialize_distributed`` (:250, torch.distributed init → here the mesh
+init), toy pipeline model providers (:45-230), ``print_separator`` (:291)
+and the success banner (distributed_test_base.py's
+TEST_SUCCESS_MESSAGE).
+"""
+
+import random
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.parallel import parallel_state
+from apex_tpu.transformer.testing import global_vars
+
+TEST_SUCCESS_MESSAGE = ">> passed the test :-)"
+
+
+def set_random_seed(seed: int):
+    """Seed every host RNG and return the jax PRNG key (ref commons.py:242
+    seeds python/numpy/torch/model-parallel-cuda; jax's functional PRNG
+    replaces the last two — fold the tp rank in where per-rank streams are
+    needed, parallel/random.py)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def initialize_distributed(backend: str = "xla"):
+    """Mesh-based analogue of torch.distributed init (ref commons.py:250).
+
+    Accepts the reference's backend names for call-site compatibility;
+    everything maps to one jax device mesh. Parallel sizes come from the
+    global args when set (the reference reads RANK/WORLD_SIZE env)."""
+    if backend not in ("nccl", "ucc", "gloo", "xla"):
+        raise RuntimeError(f"unknown backend {backend}")
+    try:
+        args = global_vars.get_args()
+        tp = args.tensor_model_parallel_size
+        pp = args.pipeline_model_parallel_size
+        vpp = args.virtual_pipeline_model_parallel_size
+    except AssertionError:  # args not initialized: single-axis dp mesh
+        tp = pp = 1
+        vpp = None
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tp,
+        pipeline_model_parallel_size=pp,
+        virtual_pipeline_model_parallel_size=vpp,
+    )
+
+
+def print_separator(message: str):
+    filler_len = (78 - len(message)) // 2
+    filler = "-" * filler_len
+    print("\n" + filler + f" {message} " + filler, flush=True)
+
+
+# -- toy pipeline models (ref commons.py:45-230) ---------------------------
+
+def mlp_provider_func(hidden_size: int = 16):
+    """Toy per-stage MLP for pipeline tests (ref MyLayer/MyModel :45-82):
+    returns (params_init_fn, stage_fn) usable with the compiled schedules."""
+
+    def init_fn(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w": jax.random.normal(k1, (hidden_size, hidden_size)) * 0.1,
+            "b": jnp.zeros((hidden_size,)),
+        }
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    return init_fn, stage_fn
+
+
+def model_provider_func(hidden_size: int, pre_process: bool,
+                        post_process: bool):
+    """Ref commons.py:155-163 signature: builds one pipeline chunk with
+    pre/post flags — used with schedules.build_model."""
+    init_fn, stage_fn = mlp_provider_func(hidden_size)
+    return {
+        "init_fn": init_fn,
+        "stage_fn": stage_fn,
+        "pre_process": pre_process,
+        "post_process": post_process,
+    }
+
+
+class IdentityLayer:
+    """Ref commons.py:234-239: a trainable scaled-identity used by the
+    cross-entropy and grad tests."""
+
+    def __init__(self, size, scale: float = 1.0, key: Optional[jax.Array] = None):
+        key = key if key is not None else jax.random.PRNGKey(0)
+        self.weight = scale * jax.random.normal(key, size)
+
+    def __call__(self):
+        return self.weight
+
+    forward = __call__

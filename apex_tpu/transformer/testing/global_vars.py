@@ -1,0 +1,149 @@
+"""Megatron-style global variables.
+
+Reference parity: apex/transformer/testing/global_vars.py:26-200 — the
+process-global (args, microbatch calculator, tensorboard writer, timers)
+registry with initialize-once semantics. The torch.distributed rank checks
+become no-ops in SPMD (one process), and the timers are
+apex_tpu.utils.Timers (jax.profiler-annotated) instead of CUDA-event
+timers.
+"""
+
+from apex_tpu.parallel.pipeline.microbatches import (
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.testing.arguments import parse_args
+from apex_tpu.utils.timers import Timers
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+_GLOBAL_TIMERS = None
+
+
+def get_args():
+    """Return arguments."""
+    _ensure_var_is_initialized(_GLOBAL_ARGS, "args")
+    return _GLOBAL_ARGS
+
+
+def get_num_microbatches() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size() -> int:
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    """Update the number of microbatches from consumed samples (no effect
+    unless rampup_batch_size is set; ref global_vars.py:48-60)."""
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(
+        consumed_samples, consistency_check
+    )
+
+
+def get_tensorboard_writer():
+    """Can be None; no initialization check (ref :69)."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    """Can be None; no initialization check (ref :75)."""
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def get_timers():
+    _ensure_var_is_initialized(_GLOBAL_TIMERS, "timers")
+    return _GLOBAL_TIMERS
+
+
+def set_global_variables(extra_args_provider=None, args_defaults={},
+                         override_args={}, ignore_unknown_args=False,
+                         args=None):
+    """Set args, microbatch calculator, tensorboard writer, and timers."""
+    parsed = _parse_args(
+        extra_args_provider=extra_args_provider,
+        defaults=args_defaults,
+        override_args=override_args,
+        ignore_unknown_args=ignore_unknown_args,
+        args=args,
+    )
+    _build_num_microbatches_calculator(parsed)
+    _set_tensorboard_writer(parsed)
+    _set_timers()
+    return parsed
+
+
+def destroy_global_variables():
+    """Reset every global (tests re-initialize per case; the reference
+    leaks these across a process, which its spawn-per-test model hides)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    global _GLOBAL_TENSORBOARD_WRITER, _GLOBAL_ADLR_AUTORESUME, _GLOBAL_TIMERS
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _GLOBAL_ADLR_AUTORESUME = None
+    _GLOBAL_TIMERS = None
+
+
+def _parse_args(extra_args_provider=None, defaults={}, override_args={},
+                ignore_unknown_args=False, args=None):
+    global _GLOBAL_ARGS
+    _ensure_var_is_not_initialized(_GLOBAL_ARGS, "args")
+    _GLOBAL_ARGS = parse_args(
+        extra_args_provider=extra_args_provider,
+        defaults=defaults,
+        override_args=override_args,
+        ignore_unknown_args=ignore_unknown_args,
+        args=args,
+    )
+    return _GLOBAL_ARGS
+
+
+def _build_num_microbatches_calculator(args):
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _ensure_var_is_not_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    )
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank=args.rank,
+        rampup_batch_size=args.rampup_batch_size,
+        global_batch_size=args.global_batch_size,
+        micro_batch_size=args.micro_batch_size,
+        data_parallel_size=args.data_parallel_size,
+    )
+
+
+def _set_tensorboard_writer(args):
+    global _GLOBAL_TENSORBOARD_WRITER
+    _ensure_var_is_not_initialized(
+        _GLOBAL_TENSORBOARD_WRITER, "tensorboard writer"
+    )
+    if getattr(args, "tensorboard_dir", None) and args.rank == (
+        args.world_size - 1
+    ):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            _GLOBAL_TENSORBOARD_WRITER = SummaryWriter(
+                log_dir=args.tensorboard_dir,
+                max_queue=args.tensorboard_queue_size,
+            )
+        except ModuleNotFoundError:
+            pass  # ref prints "no tensorboard, skipping" (:149-156)
+
+
+def _set_timers():
+    global _GLOBAL_TIMERS
+    _ensure_var_is_not_initialized(_GLOBAL_TIMERS, "timers")
+    _GLOBAL_TIMERS = Timers()
+
+
+def _ensure_var_is_initialized(var, name):
+    assert var is not None, f"{name} is not initialized."
+
+
+def _ensure_var_is_not_initialized(var, name):
+    assert var is None, f"{name} is already initialized."

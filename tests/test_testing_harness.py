@@ -1,0 +1,149 @@
+"""Megatron argument-system and globals tests.
+
+Mirrors how the reference's L0 transformer tests drive
+apex/transformer/testing (arguments.py parse_args + global_vars
+set_global_variables) — reference launch flags must parse verbatim and
+derive the same quantities.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.transformer import testing
+from apex_tpu.transformer.testing import global_vars
+
+BASE = [
+    "--num-layers", "8",
+    "--hidden-size", "64",
+    "--num-attention-heads", "8",
+    "--max-position-embeddings", "128",
+    "--seq-length", "128",
+    "--micro-batch-size", "2",
+]
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    global_vars.destroy_global_variables()
+    yield
+    global_vars.destroy_global_variables()
+
+
+class TestParseArgs:
+    def test_reference_launch_command_parses(self):
+        """A realistic reference launch line (standalone_gpt.py style)."""
+        args = testing.parse_args(args=BASE + [
+            "--global-batch-size", "16",
+            "--tensor-model-parallel-size", "2",
+            "--pipeline-model-parallel-size", "2",
+            "--lr", "1e-4", "--min-lr", "1e-5",
+            "--train-iters", "100",
+            "--bf16",
+            "--sequence-parallel",
+        ])
+        assert args.num_layers == 8 and args.global_batch_size == 16
+        assert args.tensor_model_parallel_size == 2
+        assert args.params_dtype == jnp.bfloat16
+
+    def test_world_size_derivations(self):
+        args = testing.parse_args(
+            args=BASE + ["--tensor-model-parallel-size", "2",
+                         "--pipeline-model-parallel-size", "2"],
+            override_args={"world_size": 8},
+        )
+        assert args.data_parallel_size == 2
+        # global batch defaults to micro * dp (ref :146-150)
+        assert args.global_batch_size == 2 * 2
+
+    def test_ffn_and_kv_defaults(self):
+        args = testing.parse_args(args=BASE)
+        assert args.ffn_hidden_size == 4 * 64  # ref :242
+        assert args.kv_channels == 64 // 8  # ref :246
+
+    def test_virtual_pipeline_derivation(self):
+        args = testing.parse_args(
+            args=BASE + ["--pipeline-model-parallel-size", "4",
+                         "--num-layers-per-virtual-pipeline-stage", "1"],
+            override_args={"world_size": 4},
+        )
+        # V = (L / P) / layers_per_vstage = (8/4)/1 = 2 (ref :152-162)
+        assert args.virtual_pipeline_model_parallel_size == 2
+
+    def test_fp16_bf16_exclusive(self):
+        with pytest.raises(AssertionError):
+            testing.parse_args(args=BASE + ["--fp16", "--bf16"])
+
+    def test_deprecated_flags_rejected(self):
+        with pytest.raises(AssertionError, match="micro-batch-size"):
+            testing.parse_args(args=BASE + ["--batch-size", "4"])
+        with pytest.raises(AssertionError, match="tensor-model-parallel-size"):
+            testing.parse_args(args=BASE + ["--model-parallel-size", "2"])
+
+    def test_checkpoint_activations_maps_to_recompute(self):
+        args = testing.parse_args(args=BASE + ["--checkpoint-activations"])
+        assert args.recompute_granularity == "full"
+        assert args.recompute_method == "uniform"
+        assert not hasattr(args, "checkpoint_activations")
+
+    def test_sequence_parallel_requires_tp(self):
+        with pytest.raises(AssertionError, match="tensor parallelism"):
+            testing.parse_args(args=BASE + ["--sequence-parallel"],
+                               override_args={"world_size": 1})
+
+    def test_iteration_vs_sample_based_exclusive(self):
+        with pytest.raises(AssertionError):
+            testing.parse_args(args=BASE + ["--train-iters", "10",
+                                            "--train-samples", "100"])
+
+    def test_extra_args_provider_and_defaults(self):
+        def extra(parser):
+            parser.add_argument("--my-flag", type=int, default=None)
+            return parser
+
+        args = testing.parse_args(
+            extra_args_provider=extra,
+            args=BASE,
+            defaults={"my_flag": 7, "lr": 3e-4},
+        )
+        assert args.my_flag == 7 and args.lr == 3e-4
+
+    def test_bf16_forces_fp32_grad_accumulation(self):
+        args = testing.parse_args(args=BASE + ["--bf16"])
+        assert args.accumulate_allreduce_grads_in_fp32  # ref :174-180
+
+    def test_transformer_config_from_args(self):
+        args = testing.parse_args(args=BASE + ["--bf16"])
+        cfg = testing.transformer_config_from_args(args)
+        assert cfg.num_layers == 8 and cfg.hidden_size == 64
+        assert cfg.compute_dtype == jnp.bfloat16
+
+
+class TestGlobalVars:
+    def test_lifecycle(self):
+        testing.set_global_variables(
+            args=BASE + ["--global-batch-size", "8"],
+            override_args={"world_size": 2},
+        )
+        args = testing.get_args()
+        assert args.micro_batch_size == 2 and args.data_parallel_size == 2
+        assert testing.get_num_microbatches() == 8 // (2 * 2)
+        assert testing.get_current_global_batch_size() == 8
+        assert testing.get_timers() is not None
+        assert testing.get_tensorboard_writer() is None
+        with pytest.raises(AssertionError, match="already initialized"):
+            testing.set_global_variables(args=BASE)
+
+    def test_get_args_before_init_raises(self):
+        with pytest.raises(AssertionError, match="not initialized"):
+            testing.get_args()
+
+    def test_rampup_microbatch_updates(self):
+        testing.set_global_variables(
+            args=BASE + ["--global-batch-size", "16",
+                         "--rampup-batch-size", "4", "4", "32",
+                         "--train-samples", "64"],
+            override_args={"world_size": 1, "data_parallel_size": 1},
+        )
+        assert testing.get_current_global_batch_size() == 4
+        testing.update_num_microbatches(32, consistency_check=False)
+        assert testing.get_current_global_batch_size() > 4
