@@ -291,9 +291,25 @@ class TestASPRegression:
             return optax.apply_updates(params, updates), state
 
         params, state = step(params, state)  # trace with all-ones masks
-        asp.compute_sparse_masks(params)
+        # late compute MUST take the live opt_state (r2 weak #7: the
+        # silent-dense path is unrepresentable, not a warning)
+        asp2 = ASP()
+        asp2.init_model_for_pruning(params)
+        opt2 = asp2.init_optimizer_for_pruning(optax.sgd(0.1))
+        state2 = opt2.init(params)
+        with pytest.raises(RuntimeError, match="stay dense"):
+            asp2.compute_sparse_masks(params)
+        # the sanctioned repair: retry with the live state, flag clears,
+        # and refresh_opt_state keeps working as the manual form
+        _, state2 = asp2.compute_sparse_masks(params, state2)
+        asp2.compute_sparse_masks(params)  # no longer raises
+        state2b = asp2.refresh_opt_state(state2)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            state2b, state2,
+        )
+        _, state = asp.compute_sparse_masks(params, state)
         params = prune(params, asp.masks)
-        state = asp.refresh_opt_state(state)
         params, state = step(params, state)  # same trace, new masks
         k = np.asarray(params["dense"]["kernel"])
         zero_pat = np.asarray(asp.masks["dense"]["kernel"]) == 0
