@@ -229,10 +229,19 @@ class ASP:
             self._dense_init = False
         return replace_masks(opt_state, self._masks)
 
-    def prune_trained_model(self, params: Any) -> Any:
-        """One-shot recipe (ref asp.py:292): compute masks + prune."""
+    def prune_trained_model(self, params: Any, opt_state: Any = None) -> Any:
+        """One-shot recipe (ref asp.py:292): compute masks + prune.
+
+        After a dense training run whose optimizer was initialized on
+        placeholder masks, pass the live ``opt_state`` — you get back
+        ``(pruned_params, refreshed_opt_state)`` for sparse fine-tuning;
+        without an optimizer in play the return is just the pruned params.
+        """
         if self._masks is None:
             self.init_model_for_pruning(params)
+        if opt_state is not None:
+            _, new_state = self.compute_sparse_masks(params, opt_state)
+            return prune(params, self._masks), new_state
         self.compute_sparse_masks(params)
         return prune(params, self._masks)
 

@@ -1,0 +1,114 @@
+"""Standalone BERT pretraining driven by the Megatron argument system.
+
+Reference parity: apex/transformer/testing/standalone_bert.py (the
+runnable BERT its pipeline tests launch). Uses apex_tpu.models.BertModel
+(LM head + optional NSP binary head) over a dp x tp mesh with the
+no-pipelining gradient-accumulation schedule — the configuration the
+reference's bert_model_provider exercises most; pipelined BERT follows the
+GPT layout (standalone_gpt.py) if needed.
+
+Run (virtual CPU mesh):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python -m apex_tpu.transformer.testing.standalone_bert \
+        --num-layers 2 --hidden-size 64 --num-attention-heads 4 \
+        --seq-length 32 --max-position-embeddings 32 \
+        --micro-batch-size 2 --global-batch-size 8 \
+        --tensor-model-parallel-size 2 --train-iters 3
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.bert import BertModel
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel import parallel_state
+from apex_tpu.parallel.ddp import all_reduce_gradients
+from apex_tpu.parallel.pipeline import forward_backward_no_pipelining
+from apex_tpu.transformer.testing import global_vars
+from apex_tpu.transformer.testing.arguments import parse_args
+from apex_tpu.transformer.testing.standalone_gpt import gpt_config_from_args
+
+
+def run_bert(args=None, log=print):
+    if args is None:
+        args = global_vars.get_args()
+    if args.pipeline_model_parallel_size > 1:
+        raise NotImplementedError(
+            "standalone_bert covers the dp x tp configuration; pipelined "
+            "runs follow standalone_gpt's layout"
+        )
+    tp = args.tensor_model_parallel_size
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=tp
+    )
+    dp = parallel_state.get_data_parallel_world_size()
+    cfg = gpt_config_from_args(args)
+    model = BertModel(config=cfg, add_binary_head=args.bert_binary_head)
+
+    seq, mb = args.seq_length, args.micro_batch_size
+    num_micro = max(1, args.global_batch_size // (mb * dp))
+    steps = args.train_iters or 3
+    key = jax.random.PRNGKey(args.seed)
+    tokens = jax.random.randint(
+        key, (steps, num_micro, mb * dp, seq), 0, cfg.vocab_size
+    )
+    labels = jax.random.randint(
+        jax.random.fold_in(key, 1), (steps, num_micro, mb * dp, seq), 0,
+        cfg.vocab_size,
+    )
+
+    opt = fused_adam(lr=args.lr or 1e-3, betas=(args.adam_beta1, args.adam_beta2),
+                     eps=args.adam_eps, weight_decay=args.weight_decay)
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, None, "dp"), P(None, None, "dp")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def train(tokens, labels):
+        params = model.init(
+            jax.random.PRNGKey(args.seed), tokens[0, 0], lm_labels=labels[0, 0]
+        )["params"]
+        opt_state = opt.init(params)
+
+        def fwd(p, batch):
+            toks, labs = batch
+            lm_loss, _ = model.apply({"params": p}, toks, lm_labels=labs)
+            return jnp.mean(lm_loss)
+
+        def one_step(carry, batch):
+            params, opt_state = carry
+            loss, _, grads = forward_backward_no_pipelining(
+                fwd, params, batch,
+                grad_sync_fn=lambda g: all_reduce_gradients(g, axis_name="dp"),
+            )
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jax.lax.pmean(loss, "dp")
+
+        _, losses = jax.lax.scan(one_step, (params, opt_state), (tokens, labels))
+        return losses
+
+    losses = jax.device_get(train(tokens, labels))
+    for i, l in enumerate(losses):
+        log(f"iteration {i:4d} | lm loss {float(l):.4f}")
+    parallel_state.destroy_model_parallel()
+    return [float(l) for l in losses]
+
+
+def main(argv=None):
+    args = parse_args(args=argv)
+    return run_bert(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -318,6 +318,27 @@ class TestASPRegression:
         plain = optax.sgd(0.1).init(params)
         assert replace_masks(plain, asp.masks) == plain
 
+    def test_prune_trained_model_after_dense_training(self, rng):
+        """The reference one-shot recipe (ref asp.py:292) after a dense run
+        whose optimizer was initialized on placeholder masks: passing the
+        live opt_state returns (pruned_params, refreshed_state)."""
+        params = {"dense": {"kernel": jax.random.normal(rng, (32, 16))}}
+        asp = ASP()
+        asp.init_model_for_pruning(params)
+        opt = asp.init_optimizer_for_pruning(optax.sgd(0.1))
+        state = opt.init(params)  # placeholder masks
+        pruned, state = asp.prune_trained_model(params, state)
+        k = np.asarray(pruned["dense"]["kernel"])
+        assert ((np.abs(k).T.reshape(-1, 4) > 0).sum(axis=1) <= 2).all()
+        # the refreshed state drives sparse updates from here on
+        grads = jax.tree_util.tree_map(jnp.ones_like, pruned)
+        updates, state = opt.update(grads, state, pruned)
+        after = optax.apply_updates(pruned, updates)
+        zero_pat = np.asarray(asp.masks["dense"]["kernel"]) == 0
+        np.testing.assert_array_equal(
+            np.asarray(after["dense"]["kernel"])[zero_pat], 0.0
+        )
+
     def test_embeddings_never_pruned(self, rng):
         params = {
             "embedding": {"embedding": jax.random.normal(rng, (64, 32))},
