@@ -38,10 +38,24 @@ from apex_tpu.amp.scaler import (
     unscale_grads,
 )
 from apex_tpu.amp.grad_scaler import GradScaler
-from apex_tpu.amp.cast_engine import cast_ops
+from apex_tpu.amp.cast_engine import (
+    cast_ops,
+    float_function,
+    half_function,
+    promote_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
+)
 
 __all__ = [
     "cast_ops",
+    "half_function",
+    "float_function",
+    "promote_function",
+    "register_half_function",
+    "register_float_function",
+    "register_promote_function",
     "Policy",
     "O0",
     "O1",

@@ -127,28 +127,116 @@ def _make_half_output_wrapper(orig, to_half):
 
 def _patch():
     to_half = _to_half_converter(_state.half_dtype)
-    for mod, name in cast_lists.FP16_FUNCS:
+    # user registrations OVERRIDE the built-in lists (ref amp.py:84-90
+    # wraps user entries first and torch's wrap layer is idempotent per
+    # name): a built-in entry also present in a user registry is skipped,
+    # otherwise e.g. register_float_function on an FP16-whitelisted op
+    # would round-trip fp32 args through the half dtype before upcasting
+    user = {
+        (id(mod), name)
+        for mod, name in (
+            _USER_FP16_REGISTRY + _USER_FP32_REGISTRY + _USER_PROMOTE_REGISTRY
+        )
+    }
+
+    def install(mod, name, make):
         orig = getattr(mod, name)
         _state.saved.append((mod, name, orig))
-        setattr(mod, name, _make_cast_wrapper(orig, to_half))
-    for cls, name in cast_lists.FP16_MODULE_CALLS:
-        orig = getattr(cls, name)
-        _state.saved.append((cls, name, orig))
-        setattr(cls, name, _make_half_output_wrapper(orig, to_half))
-    for mod, name in cast_lists.FP32_FUNCS:
-        orig = getattr(mod, name)
-        _state.saved.append((mod, name, orig))
-        setattr(mod, name, _make_cast_wrapper(orig, _to_float))
-    for mod, name in cast_lists.PROMOTE_FUNCS + cast_lists.SEQUENCE_CASTS:
-        orig = getattr(mod, name)
-        _state.saved.append((mod, name, orig))
-        setattr(mod, name, _make_promote_wrapper(orig))
+        setattr(mod, name, make(orig))
+
+    try:
+        for mod, name in _USER_FP16_REGISTRY:
+            install(mod, name, lambda o: _make_cast_wrapper(o, to_half))
+        for mod, name in _USER_FP32_REGISTRY:
+            install(mod, name, lambda o: _make_cast_wrapper(o, _to_float))
+        for mod, name in _USER_PROMOTE_REGISTRY:
+            install(mod, name, _make_promote_wrapper)
+        for mod, name in cast_lists.FP16_FUNCS:
+            if (id(mod), name) not in user:
+                install(mod, name, lambda o: _make_cast_wrapper(o, to_half))
+        for cls, name in cast_lists.FP16_MODULE_CALLS:
+            install(cls, name, lambda o: _make_half_output_wrapper(o, to_half))
+        for mod, name in cast_lists.FP32_FUNCS:
+            if (id(mod), name) not in user:
+                install(mod, name, lambda o: _make_cast_wrapper(o, _to_float))
+        for mod, name in cast_lists.PROMOTE_FUNCS + cast_lists.SEQUENCE_CASTS:
+            if (id(mod), name) not in user:
+                install(mod, name, _make_promote_wrapper)
+    except Exception:
+        # a registered attribute vanished since registration (module
+        # reload, monkeypatch teardown): unwind everything installed so
+        # far — a partial patch leaking past the context is worse than
+        # the raise
+        _unpatch()
+        raise
 
 
 def _unpatch():
     for mod, name, orig in reversed(_state.saved):
         setattr(mod, name, orig)
     _state.saved.clear()
+
+
+# -- user registries (ref amp/amp.py:33-71) --------------------------------
+# Namespace entries registered here join the built-in lists at the next
+# (outermost) cast_ops enter — the analogue of calling register_* before
+# amp.init().  Decorator forms wrap one callable directly, gated on the
+# active context like every other wrapper.
+
+_USER_FP16_REGISTRY = []
+_USER_FP32_REGISTRY = []
+_USER_PROMOTE_REGISTRY = []
+
+
+def _check_has(module, name):
+    if not hasattr(module, name):
+        raise ValueError(f"No function named {name} in module {module}.")
+
+
+def register_half_function(module, name):
+    """Force-half a namespace function under O1 (ref amp.py:45-52)."""
+    _check_has(module, name)
+    _USER_FP16_REGISTRY.append((module, name))
+
+
+def register_float_function(module, name):
+    """Force-fp32 a namespace function under O1 (ref amp.py:55-63)."""
+    _check_has(module, name)
+    _USER_FP32_REGISTRY.append((module, name))
+
+
+def register_promote_function(module, name):
+    """Promote-on-mixed for a namespace function under O1 (ref amp.py:66-70)."""
+    _check_has(module, name)
+    _USER_PROMOTE_REGISTRY.append((module, name))
+
+
+def half_function(fn):
+    """Decorator: run ``fn`` with float args cast to the active half dtype
+    whenever a cast context is active (ref amp.py:33-35)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        # snapshot BOTH fields: a concurrent outermost exit nulls
+        # half_dtype, and reading it after the depth check would race
+        half_dtype = _state.half_dtype
+        if _state.depth == 0 or half_dtype is None:
+            return fn(*args, **kwargs)
+        args, kwargs = _tree_cast((args, kwargs), _to_half_converter(half_dtype))
+        return fn(*args, **kwargs)
+
+    wrapper.__wrapped_by_apex_tpu_amp__ = True
+    return wrapper
+
+
+def float_function(fn):
+    """Decorator: run ``fn`` with half args cast to fp32 under O1."""
+    return _make_cast_wrapper(fn, _to_float)
+
+
+def promote_function(fn):
+    """Decorator: promote mixed half/fp32 args to fp32 under O1."""
+    return _make_promote_wrapper(fn)
 
 
 @contextlib.contextmanager

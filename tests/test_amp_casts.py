@@ -212,3 +212,112 @@ class TestO1Policy:
 
         policy.wrap_apply(apply_fn)({}, jnp.ones((2,), jnp.float32))
         assert seen["sum"] == jnp.bfloat16  # no fp32 blacklist under O2
+
+
+class TestUserRegistries:
+    """Ref amp/amp.py:33-71: user-annotated functions join the cast lists."""
+
+    def test_half_and_float_decorators(self):
+        from apex_tpu.amp import float_function, half_function
+
+        @half_function
+        def my_matmul(a, b):
+            return a @ b
+
+        @float_function
+        def my_reduce(x):
+            return x.sum()
+
+        a = jnp.ones((4, 4), jnp.float32)
+        h = jnp.ones((4,), jnp.bfloat16)
+        # inactive outside a context
+        assert my_matmul(a, a).dtype == jnp.float32
+        assert my_reduce(h).dtype == jnp.bfloat16
+        with _ctx(jnp.bfloat16):
+            assert my_matmul(a, a).dtype == jnp.bfloat16
+            assert my_reduce(h).dtype == jnp.float32
+
+    def test_promote_decorator(self):
+        from apex_tpu.amp import promote_function
+
+        @promote_function
+        def my_mix(a, b):
+            return a * b
+
+        with _ctx(jnp.bfloat16):
+            out = my_mix(jnp.ones((2,), jnp.bfloat16), jnp.ones((2,), jnp.float32))
+        assert out.dtype == jnp.float32
+
+    def test_register_namespace_functions(self):
+        import types
+
+        from apex_tpu.amp import (
+            register_float_function,
+            register_half_function,
+            register_promote_function,
+        )
+
+        ns = types.SimpleNamespace(
+            mm=lambda a, b: a @ b,
+            red=lambda x: x.sum(),
+            mix=lambda a, b: a + b,
+        )
+        register_half_function(ns, "mm")
+        register_float_function(ns, "red")
+        register_promote_function(ns, "mix")
+        a32 = jnp.ones((4, 4), jnp.float32)
+        h = jnp.ones((4,), jnp.bfloat16)
+        with _ctx(jnp.bfloat16):
+            assert ns.mm(a32, a32).dtype == jnp.bfloat16
+            assert ns.red(h).dtype == jnp.float32
+            assert ns.mix(h, jnp.ones((4,), jnp.float32)).dtype == jnp.float32
+        # restored on exit, like the built-in lists
+        assert ns.mm(a32, a32).dtype == jnp.float32
+        assert ns.red(h).dtype == jnp.bfloat16
+
+    def test_register_missing_name_raises(self):
+        import types
+
+        from apex_tpu.amp import register_half_function
+
+        with pytest.raises(ValueError, match="No function named"):
+            register_half_function(types.SimpleNamespace(), "nope")
+
+    def test_user_registration_overrides_builtin_list(self):
+        """register_float_function on an FP16-whitelisted op must NOT
+        round-trip args through the half dtype (precision check: 1+2^-12
+        survives fp32 but rounds to 1.0 in bf16)."""
+        from apex_tpu.amp import register_float_function
+        from apex_tpu.amp import cast_engine
+
+        register_float_function(jnp, "einsum")
+        try:
+            a = jnp.full((1, 1), 1.0 + 2.0**-12, jnp.float32)
+            with _ctx(jnp.bfloat16):
+                out = jnp.einsum("ij,jk->ik", a, a)
+            assert out.dtype == jnp.float32
+            assert float(out[0, 0]) > 1.0  # bf16 truncation would give 1.0
+        finally:
+            cast_engine._USER_FP32_REGISTRY.remove((jnp, "einsum"))
+
+    def test_patch_failure_unwinds_cleanly(self):
+        import types
+
+        from apex_tpu.amp import register_half_function
+        from apex_tpu.amp import cast_engine
+
+        ns = types.SimpleNamespace(fn=lambda x: x)
+        register_half_function(ns, "fn")
+        del ns.fn  # vanishes before the next context enter
+        try:
+            with pytest.raises(AttributeError):
+                with _ctx(jnp.bfloat16):
+                    pass
+            # nothing leaked: built-ins restored, a fresh context works
+            assert not hasattr(jnp.matmul, "__wrapped_by_apex_tpu_amp__")
+            ns.fn = lambda x: x
+            with _ctx(jnp.bfloat16):
+                x = jnp.ones((2, 2), jnp.float32)
+                assert jnp.matmul(x, x).dtype == jnp.bfloat16
+        finally:
+            cast_engine._USER_FP16_REGISTRY.remove((ns, "fn"))
