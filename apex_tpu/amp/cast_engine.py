@@ -132,11 +132,15 @@ def _patch():
     # name): a built-in entry also present in a user registry is skipped,
     # otherwise e.g. register_float_function on an FP16-whitelisted op
     # would round-trip fp32 args through the half dtype before upcasting
+    # snapshot the registries: _patch runs under _state.lock (cast_ops
+    # holds it) and register_* also takes it, but a stable view keeps the
+    # skip-set and the iteration consistent with each other regardless
+    user_fp16 = list(_USER_FP16_REGISTRY)
+    user_fp32 = list(_USER_FP32_REGISTRY)
+    user_promote = list(_USER_PROMOTE_REGISTRY)
     user = {
         (id(mod), name)
-        for mod, name in (
-            _USER_FP16_REGISTRY + _USER_FP32_REGISTRY + _USER_PROMOTE_REGISTRY
-        )
+        for mod, name in user_fp16 + user_fp32 + user_promote
     }
 
     def install(mod, name, make):
@@ -145,17 +149,19 @@ def _patch():
         setattr(mod, name, make(orig))
 
     try:
-        for mod, name in _USER_FP16_REGISTRY:
+        for mod, name in user_fp16:
             install(mod, name, lambda o: _make_cast_wrapper(o, to_half))
-        for mod, name in _USER_FP32_REGISTRY:
+        for mod, name in user_fp32:
             install(mod, name, lambda o: _make_cast_wrapper(o, _to_float))
-        for mod, name in _USER_PROMOTE_REGISTRY:
+        for mod, name in user_promote:
             install(mod, name, _make_promote_wrapper)
         for mod, name in cast_lists.FP16_FUNCS:
             if (id(mod), name) not in user:
                 install(mod, name, lambda o: _make_cast_wrapper(o, to_half))
         for cls, name in cast_lists.FP16_MODULE_CALLS:
-            install(cls, name, lambda o: _make_half_output_wrapper(o, to_half))
+            if (id(cls), name) not in user:
+                install(cls, name,
+                        lambda o: _make_half_output_wrapper(o, to_half))
         for mod, name in cast_lists.FP32_FUNCS:
             if (id(mod), name) not in user:
                 install(mod, name, lambda o: _make_cast_wrapper(o, _to_float))
@@ -193,22 +199,33 @@ def _check_has(module, name):
         raise ValueError(f"No function named {name} in module {module}.")
 
 
+def _register(registry, module, name):
+    """Latest registration wins: the same (module, name) is removed from
+    every registry first (otherwise an earlier half registration would
+    stack under a later float one and re-truncate the upcast args), and
+    the lock serializes against a concurrent ``_patch``."""
+    _check_has(module, name)
+    with _state.lock:
+        for reg in (_USER_FP16_REGISTRY, _USER_FP32_REGISTRY,
+                    _USER_PROMOTE_REGISTRY):
+            if (module, name) in reg:
+                reg.remove((module, name))
+        registry.append((module, name))
+
+
 def register_half_function(module, name):
     """Force-half a namespace function under O1 (ref amp.py:45-52)."""
-    _check_has(module, name)
-    _USER_FP16_REGISTRY.append((module, name))
+    _register(_USER_FP16_REGISTRY, module, name)
 
 
 def register_float_function(module, name):
     """Force-fp32 a namespace function under O1 (ref amp.py:55-63)."""
-    _check_has(module, name)
-    _USER_FP32_REGISTRY.append((module, name))
+    _register(_USER_FP32_REGISTRY, module, name)
 
 
 def register_promote_function(module, name):
     """Promote-on-mixed for a namespace function under O1 (ref amp.py:66-70)."""
-    _check_has(module, name)
-    _USER_PROMOTE_REGISTRY.append((module, name))
+    _register(_USER_PROMOTE_REGISTRY, module, name)
 
 
 def half_function(fn):

@@ -321,3 +321,39 @@ class TestUserRegistries:
                 assert jnp.matmul(x, x).dtype == jnp.bfloat16
         finally:
             cast_engine._USER_FP16_REGISTRY.remove((ns, "fn"))
+
+    def test_user_override_on_flax_module_call(self):
+        """A float registration on a listed flax layer must defeat the
+        built-in half-output wrapper too."""
+        from apex_tpu.amp import register_float_function
+        from apex_tpu.amp import cast_engine
+
+        register_float_function(nn.Dense, "__call__")
+        try:
+            m = nn.Dense(4)
+            x = jnp.ones((2, 8), jnp.float32)
+            params = m.init(jax.random.PRNGKey(0), x)
+            with _ctx(jnp.bfloat16):
+                assert m.apply(params, x).dtype == jnp.float32
+        finally:
+            cast_engine._USER_FP32_REGISTRY.remove((nn.Dense, "__call__"))
+        # built-in behavior restored
+        params = nn.Dense(4).init(jax.random.PRNGKey(0), jnp.ones((2, 8)))
+        with _ctx(jnp.bfloat16):
+            assert nn.Dense(4).apply(params, jnp.ones((2, 8))).dtype == jnp.bfloat16
+
+    def test_latest_registration_wins(self):
+        import types
+
+        from apex_tpu.amp import register_float_function, register_half_function
+        from apex_tpu.amp import cast_engine
+
+        ns = types.SimpleNamespace(f=lambda x: x)
+        register_half_function(ns, "f")
+        register_float_function(ns, "f")  # most recent intent: fp32
+        try:
+            with _ctx(jnp.bfloat16):
+                assert ns.f(jnp.ones((2,), jnp.bfloat16)).dtype == jnp.float32
+        finally:
+            cast_engine._USER_FP32_REGISTRY.remove((ns, "f"))
+        assert (ns, "f") not in cast_engine._USER_FP16_REGISTRY
