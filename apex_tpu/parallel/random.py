@@ -95,3 +95,53 @@ def distribute_saved_activations_policy():
     shard_map with SP enabled — saved residuals are then already 1/tp-sized,
     which is what the reference's distribute_saved_activations achieves."""
     return jax.checkpoint_policies.dots_saveable
+
+
+def checkpoint_distributed(fn: Callable, axis_name: str = "tp"):
+    """Checkpoint with the saved boundary activation PARTITIONED over the
+    tensor-parallel ranks (ref random.py:246-266: CheckpointFunction with
+    ``distribute_saved_activations`` splits the saved input across the TP
+    group and all-gathers it before recompute).
+
+    The wrapped function's first argument (sequence-major, replicated over
+    ``axis_name`` — the SP-off case the reference targets) is scattered
+    along dim 0 OUTSIDE the checkpoint boundary and gathered back inside:
+    autodiff then stashes only the 1/tp shard. The memory saving costs
+    three all-gathers per step (forward primal, backward recompute, and
+    the scatter's cotangent transpose) — the price of (tp-1)/tp of every
+    boundary. Must run inside shard_map with ``axis_name`` bound, and dim 0
+    must divide by the axis size (asserted — a silent floor-split would
+    drop rows).
+
+    Measured (BENCH.md): wins when MANY segments stash boundaries (the
+    per-layer remat pattern — 3.7x less live memory at 16 segments,
+    tp=8); for a SINGLE segment the transient all-gather buffer outweighs
+    the one saved boundary (0.84x), so don't wrap a whole network in one
+    call.
+    """
+    from apex_tpu.parallel.mappings import (
+        gather_from_sequence_parallel_region,
+        scatter_to_sequence_parallel_region,
+    )
+
+    inner = jax.checkpoint(
+        lambda shard, *args: fn(
+            gather_from_sequence_parallel_region(
+                shard, axis_name, to_model_parallel=False
+            ),
+            *args,
+        )
+    )
+
+    @functools.wraps(fn)
+    def wrapped(x, *args):
+        n = jax.lax.psum(1, axis_name)
+        if x.shape[0] % n != 0:
+            raise ValueError(
+                f"checkpoint_distributed: leading dim ({x.shape[0]}) not "
+                f"divisible by {axis_name} size ({n}); the split would "
+                "silently drop rows"
+            )
+        return inner(scatter_to_sequence_parallel_region(x, axis_name), *args)
+
+    return wrapped

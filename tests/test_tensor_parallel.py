@@ -17,6 +17,7 @@ from jax import shard_map
 
 from apex_tpu.models import GPTModel, gpt_loss_fn
 from apex_tpu.parallel import parallel_state
+from apex_tpu.parallel.random import checkpoint_distributed
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.parallel.layers import (
     ColumnParallelLinear,
@@ -274,3 +275,54 @@ class TestGPTTensorParallel:
         l_np, l_sp, g_np, g_sp = run(tokens, labels, amask)
         np.testing.assert_allclose(l_np, l_sp, rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(g_np, g_sp, rtol=1e-4, atol=1e-6)
+
+
+class TestCheckpointDistributed:
+    def test_value_and_grads_match_plain_checkpoint(self, rng):
+        """ref random.py:246-266 distribute_saved_activations: partitioning
+        the saved boundary activation over tp must not change math."""
+        tp = 2
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=tp, devices=jax.devices()[:tp]
+        )
+        w = jax.random.normal(rng, (16, 16)) * 0.3
+        x = jax.random.normal(jax.random.fold_in(rng, 1), (8, 16))
+
+        def fn(x, w):
+            return jnp.sum(jnp.tanh(x @ w) ** 2)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False,
+        )
+        def run(x, w):
+            return jax.value_and_grad(
+                lambda w_: checkpoint_distributed(fn)(x, w_)
+            )(w)
+
+        loss, grads = run(x, w)
+        ref_loss, ref_grads = jax.value_and_grad(lambda w_: fn(x, w_))(w)
+        np.testing.assert_allclose(loss, ref_loss, rtol=1e-6)
+        np.testing.assert_allclose(grads, ref_grads, rtol=1e-5, atol=1e-7)
+
+    def test_grad_wrt_boundary_input(self, rng):
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=2, devices=jax.devices()[:2]
+        )
+        x = jax.random.normal(rng, (8, 16))
+
+        def fn(x):
+            return jnp.sum(jnp.sin(x) * x)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def run(x):
+            return jax.grad(lambda x_: checkpoint_distributed(fn)(x_))(x)
+
+        np.testing.assert_allclose(
+            run(x), jax.grad(lambda x_: fn(x_))(x), rtol=1e-5, atol=1e-7
+        )
