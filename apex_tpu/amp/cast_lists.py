@@ -19,6 +19,12 @@ O1 behaves the same regardless of half dtype.
 
 Each entry is ``(module, attr_name)``; the engine (cast_engine.py) swaps the
 attribute for a casting wrapper while a policy context is active.
+
+No BANNED_FUNCS list (ref functional_overrides.py bans F.binary_cross_entropy
+under fp16 unless allow_banned): the hazard is ``log`` of half-precision
+probabilities, and ``jnp.log``/``log_softmax`` are already force-fp32 here
+while the jax-ecosystem BCE (optax.sigmoid_binary_cross_entropy) works on
+logits — the dangerous call shape has no unpatched spelling to ban.
 """
 
 import jax

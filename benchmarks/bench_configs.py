@@ -197,8 +197,10 @@ def bench_bert(tpu):
             "value": round(sps * batch, 2), "unit": "seq/sec"}
 
 
-def bench_gpt_tp(tpu):
-    """Config 5: GPT through the parallel transformer layer on a tp mesh."""
+def bench_gpt_tp(tpu, force_tp=None):
+    """Config 5: GPT through the parallel transformer layer on a tp mesh.
+    ``force_tp`` drives the --sweep-tp scaling table (the reference's
+    tests/L0/run_transformer/gpt_scaling_test.py role)."""
     import jax.numpy as jnp
     import optax
 
@@ -211,7 +213,7 @@ def bench_gpt_tp(tpu):
     from jax.sharding import PartitionSpec as P
 
     n_dev = len(jax.devices())
-    tp = 8 if (tpu and n_dev >= 8) else min(2, n_dev)
+    tp = force_tp or (8 if (tpu and n_dev >= 8) else min(2, n_dev))
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size=tp, devices=jax.devices()[:tp]
     )
@@ -224,8 +226,9 @@ def bench_gpt_tp(tpu):
         )  # GPT-2 345M
         batch, seq = 8, 1024
     else:
+        # smoke shape divides through tp=8 (heads % tp, hidden % (tp*heads))
         cfg = TransformerConfig(
-            num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_layers=2, hidden_size=128, num_attention_heads=8,
             vocab_size=512, max_position_embeddings=64,
             hidden_dropout=0.0, attention_dropout=0.0,
             sequence_parallel=tp > 1,
@@ -282,6 +285,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--configs", default="mlp,dp,bert,gpt")
+    ap.add_argument("--sweep-tp", action="store_true",
+                    help="run the gpt config over tp in {1,2,4,8} (clamped "
+                         "to device count) — the reference's "
+                         "gpt_scaling_test.py sweep as a harness")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -289,6 +296,15 @@ def main():
     from apex_tpu.ops._dispatch import on_tpu
 
     tpu = on_tpu()
+    if args.sweep_tp:
+        n_dev = len(jax.devices())
+        for tp in (1, 2, 4, 8):
+            if tp > n_dev:
+                break
+            rec = bench_gpt_tp(tpu, force_tp=tp)
+            rec["platform"] = platform
+            print(json.dumps(rec))
+        return
     for name in args.configs.split(","):
         rec = CONFIGS[name](tpu)
         rec["platform"] = platform
