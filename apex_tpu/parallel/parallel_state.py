@@ -42,6 +42,36 @@ TENSOR_AXIS = "tp"
 AXIS_ORDER = (DATA_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
 
 
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+):
+    """Multi-host/controller bring-up (the reference's
+    ``torch.distributed.init_process_group`` role, commons.py:250 +
+    parallel_state's NCCL group machinery).
+
+    Wraps ``jax.distributed.initialize`` — with no arguments it reads the
+    standard cluster environment (TPU pod metadata / COORDINATOR_ADDRESS /
+    SLURM), after which ``jax.devices()`` spans every host and
+    ``initialize_model_parallel`` lays the global mesh over them (dp
+    outermost → DCN; tp innermost → ICI). A no-op when already initialized
+    or single-process.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError as e:  # already initialized -> idempotent like ref
+        if "already" not in str(e).lower():
+            raise
+    return jax.process_count(), jax.process_index()
+
+
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
@@ -49,15 +79,26 @@ def initialize_model_parallel(
     pipeline_model_parallel_split_rank: Optional[int] = None,
     context_parallel_size: int = 1,
     devices: Optional[Sequence] = None,
+    num_slices: int = 1,
 ) -> Mesh:
     """Build the global mesh (ref: parallel_state.py:155).
 
     ``devices`` defaults to ``jax.devices()``; data-parallel size is whatever
     remains after tp*pp*cp, exactly like the reference computes
     data_parallel_size = world_size // (tp*pp) (parallel_state.py:241).
+
+    Topology: with default devices, ``mesh_utils.create_device_mesh``
+    arranges the axes along the physical ICI torus (the analogue of the
+    reference's IB/Socket-aware NCCL group construction,
+    parallel_state.py:108-153). ``num_slices > 1`` builds a HYBRID mesh for
+    multi-slice/multi-host pods: the data-parallel axis is split so its
+    outer factor crosses DCN while everything else stays on ICI
+    (``mesh_utils.create_hybrid_device_mesh``). An explicit ``devices`` list
+    (tests, sub-meshes) keeps the plain reshape.
     """
     global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
     global _PIPELINE_SPLIT_RANK
+    explicit = devices is not None
     if devices is None:
         devices = jax.devices()
     world = len(devices)
@@ -71,7 +112,28 @@ def initialize_model_parallel(
             f"world size ({world}) is not divisible by tp ({tp}) x pp ({pp}) x cp ({cp})"
         )
     dp = world // (tp * pp * cp)
-    arr = np.asarray(devices).reshape(dp, pp, cp, tp)
+    if num_slices > 1:
+        if dp % num_slices != 0:
+            raise RuntimeError(
+                f"data-parallel size ({dp}) is not divisible by num_slices "
+                f"({num_slices}); only dp crosses DCN"
+            )
+        from jax.experimental import mesh_utils
+
+        per_slice = (dp // num_slices, pp, cp, tp)
+        arr = mesh_utils.create_hybrid_device_mesh(
+            per_slice, (num_slices, 1, 1, 1), devices=devices
+        )
+    elif explicit:
+        arr = np.asarray(devices).reshape(dp, pp, cp, tp)
+    else:
+        from jax.experimental import mesh_utils
+
+        try:
+            arr = mesh_utils.create_device_mesh((dp, pp, cp, tp),
+                                                devices=devices)
+        except Exception:  # no topology info (CPU backends) -> plain order
+            arr = np.asarray(devices).reshape(dp, pp, cp, tp)
     _MESH = Mesh(arr, AXIS_ORDER)
     _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size
     _VIRTUAL_PIPELINE_RANK = 0 if virtual_pipeline_model_parallel_size else None
