@@ -23,6 +23,7 @@ and traced values (``lax.axis_index``) inside shard_map otherwise — matching
 how the reference's per-process ints generalize to SPMD.
 """
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -56,19 +57,31 @@ def initialize_distributed(
     standard cluster environment (TPU pod metadata / COORDINATOR_ADDRESS /
     SLURM), after which ``jax.devices()`` spans every host and
     ``initialize_model_parallel`` lays the global mesh over them (dp
-    outermost → DCN; tp innermost → ICI). A no-op when already initialized
-    or single-process.
+    outermost → DCN; tp innermost → ICI).
+
+    Idempotent and single-process-safe by explicit checks, not exception
+    matching: already-initialized returns immediately, and with no
+    arguments AND no cluster environment there is nothing to coordinate,
+    so the call is a no-op returning ``(process_count, process_index)``
+    (jax's auto-detection would otherwise raise on a dev box).
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
+    if jax.distributed.is_initialized():
+        return jax.process_count(), jax.process_index()
+    cluster_env = any(
+        v in os.environ
+        for v in (
+            "COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
+            "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
         )
-    except RuntimeError as e:  # already initialized -> idempotent like ref
-        if "already" not in str(e).lower():
-            raise
+    )
+    if coordinator_address is None and num_processes is None and not cluster_env:
+        return jax.process_count(), jax.process_index()
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
     return jax.process_count(), jax.process_index()
 
 
@@ -113,6 +126,11 @@ def initialize_model_parallel(
         )
     dp = world // (tp * pp * cp)
     if num_slices > 1:
+        if explicit:
+            raise ValueError(
+                "num_slices > 1 needs the full device topology; it cannot "
+                "be combined with an explicit devices list"
+            )
         if dp % num_slices != 0:
             raise RuntimeError(
                 f"data-parallel size ({dp}) is not divisible by num_slices "
@@ -129,11 +147,15 @@ def initialize_model_parallel(
     else:
         from jax.experimental import mesh_utils
 
-        try:
+        if devices and devices[0].platform == "cpu":
+            # CPU backends carry no topology; plain order, no mesh_utils
+            arr = np.asarray(devices).reshape(dp, pp, cp, tp)
+        else:
+            # on real hardware a failure here (unmappable factorization)
+            # must surface — silently falling back to enumeration order
+            # would put tp collectives on slow links with no diagnostic
             arr = mesh_utils.create_device_mesh((dp, pp, cp, tp),
                                                 devices=devices)
-        except Exception:  # no topology info (CPU backends) -> plain order
-            arr = np.asarray(devices).reshape(dp, pp, cp, tp)
     _MESH = Mesh(arr, AXIS_ORDER)
     _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size
     _VIRTUAL_PIPELINE_RANK = 0 if virtual_pipeline_model_parallel_size else None
