@@ -344,15 +344,15 @@ class TestMeshConstruction:
                 tensor_model_parallel_size=2, num_slices=3
             )
 
-    def test_initialize_distributed_single_process_noop(self):
-        """No args + no cluster env = deterministic no-op (1, 0), even
-        with backends long since initialized — no exception matching."""
-        import os
-
+    def test_initialize_distributed_single_process_noop(self, monkeypatch):
+        """No args + no cluster env = deterministic no-op, even with
+        backends long since initialized — no exception matching. (The
+        cluster vars are scrubbed: this machine's TPU relay exports
+        TPU_WORKER_HOSTNAMES without being a multi-host cluster.)"""
         for v in ("COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS",
                   "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES",
                   "MEGASCALE_COORDINATOR_ADDRESS"):
-            assert v not in os.environ  # precondition of this test env
+            monkeypatch.delenv(v, raising=False)
         n, i = parallel_state.initialize_distributed()
         assert (n, i) == (jax.process_count(), jax.process_index())
         # idempotent second call
