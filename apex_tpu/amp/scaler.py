@@ -30,6 +30,10 @@ class LossScalerState:
     # running count of skipped steps, for observability parity with
     # _amp_state verbosity messages
     skipped: jax.Array  # i32 scalar
+    # overflows tolerated before the next backoff (ref
+    # csrc/update_scale_hysteresis.cu: decremented per overflow, scale
+    # halves only at zero, refilled on any clean step)
+    hysteresis_tracker: jax.Array  # i32 scalar
 
 
 class LossScaler:
@@ -50,6 +54,7 @@ class LossScaler:
         growth_interval: int = 2000,
         min_loss_scale: float = 1.0,
         max_loss_scale: float = 2.0**24,
+        hysteresis: int = 1,
     ):
         self.dynamic = loss_scale == "dynamic"
         self._static_scale = 1.0 if self.dynamic else float(loss_scale)
@@ -59,12 +64,17 @@ class LossScaler:
         self.growth_interval = growth_interval
         self.min_loss_scale = min_loss_scale
         self.max_loss_scale = max_loss_scale
+        # hysteresis=1 reproduces the plain schedule exactly (every
+        # overflow backs off); >1 tolerates transient overflow bursts
+        # (ref csrc/update_scale_hysteresis.cu, --hysteresis flag)
+        self.hysteresis = int(hysteresis)
 
     def init(self) -> LossScalerState:
         return LossScalerState(
             scale=jnp.asarray(self.init_scale, jnp.float32),
             growth_tracker=jnp.asarray(0, jnp.int32),
             skipped=jnp.asarray(0, jnp.int32),
+            hysteresis_tracker=jnp.asarray(self.hysteresis, jnp.int32),
         )
 
     # -- core ops ---------------------------------------------------------
@@ -83,18 +93,29 @@ class LossScaler:
         return out, found_inf
 
     def update(self, state: LossScalerState, found_inf) -> LossScalerState:
-        """Dynamic scale update (ref: scaler.py:197-217 update_scale)."""
+        """Dynamic scale update (ref: scaler.py:197-217 update_scale, with
+        the hysteresis gate of csrc/update_scale_hysteresis.cu:13-47)."""
         if not self.dynamic:
             return state.replace(
                 skipped=state.skipped + jnp.asarray(found_inf, jnp.int32)
             )
         found_inf = jnp.asarray(found_inf)
+        # hysteresis: each overflow decrements; at zero the scale backs
+        # off, and KEEPS backing off on further consecutive overflows —
+        # only a clean step refills the allowance (exact kernel semantics:
+        # the tracker is reset solely in the found_inf<=0 branch, :44-46)
+        hys = jnp.where(
+            found_inf,
+            jnp.maximum(state.hysteresis_tracker - 1, 0),
+            self.hysteresis,
+        )
+        backoff = jnp.logical_and(found_inf, hys <= 0)
         backed_off = jnp.maximum(
             state.scale * self.backoff_factor, self.min_loss_scale
         )
         tracker = jnp.where(found_inf, 0, state.growth_tracker + 1)
         grow = jnp.logical_and(~found_inf, tracker >= self.growth_interval)
-        scale = jnp.where(found_inf, backed_off, state.scale)
+        scale = jnp.where(backoff, backed_off, state.scale)
         scale = jnp.where(
             grow, jnp.minimum(scale * self.growth_factor, self.max_loss_scale), scale
         )
@@ -103,6 +124,7 @@ class LossScaler:
             scale=scale,
             growth_tracker=tracker,
             skipped=state.skipped + jnp.asarray(found_inf, jnp.int32),
+            hysteresis_tracker=hys,
         )
 
     # -- checkpointing (ref: amp/frontend.py:367-404) ---------------------
@@ -112,6 +134,7 @@ class LossScaler:
             "loss_scale": float(state.scale),
             "unskipped": int(state.growth_tracker),
             "skipped": int(state.skipped),
+            "hysteresis_tracker": int(state.hysteresis_tracker),
             "dynamic": self.dynamic,
         }
 
@@ -120,6 +143,9 @@ class LossScaler:
             scale=jnp.asarray(d["loss_scale"], jnp.float32),
             growth_tracker=jnp.asarray(d.get("unskipped", 0), jnp.int32),
             skipped=jnp.asarray(d.get("skipped", 0), jnp.int32),
+            hysteresis_tracker=jnp.asarray(
+                d.get("hysteresis_tracker", self.hysteresis), jnp.int32
+            ),
         )
 
 

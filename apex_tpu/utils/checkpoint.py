@@ -37,7 +37,14 @@ def save_checkpoint(directory: str, step: int, tree: Any, overwrite: bool = True
 def load_checkpoint(directory: str, step: Optional[int] = None, target: Any = None) -> Any:
     """Restore the pytree saved at ``step`` (default: latest). ``target``
     (a pytree of like-shaped arrays) restores dtypes/shardings exactly —
-    pass the freshly-initialized state for a true resume."""
+    pass the freshly-initialized state for a true resume.
+
+    Structure migration: a raw-pytree restore requires the saved and
+    target trees to match. When a state dataclass gains a field across
+    versions (e.g. LossScalerState.hysteresis_tracker), resume older
+    checkpoints through the component's ``state_dict``/``load_state_dict``
+    pair, which is tolerant of missing keys (amp/scaler.py), instead of
+    the raw tree."""
     directory = os.path.abspath(directory)
     if step is None:
         step = latest_step(directory)

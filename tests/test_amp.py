@@ -179,3 +179,55 @@ class TestAmpOptimizer:
             params, state, loss = step(params, state)
             losses.append(float(loss) / float(state.scaler.scale))
         assert losses[-1] < losses[0] * 0.5
+
+
+class TestHysteresis:
+    """Ref csrc/update_scale_hysteresis.cu: overflows decrement a tracker;
+    the scale backs off only at zero; clean steps refill the allowance."""
+
+    def test_hysteresis_tolerates_transient_overflows(self):
+        from apex_tpu.amp import LossScaler
+
+        s = LossScaler(loss_scale="dynamic", init_scale=1024.0, hysteresis=3)
+        st = s.init()
+        st = s.update(st, True)   # 1st overflow: tolerated
+        assert float(st.scale) == 1024.0
+        st = s.update(st, True)   # 2nd: tolerated
+        assert float(st.scale) == 1024.0
+        st = s.update(st, True)   # 3rd: allowance exhausted -> backoff
+        assert float(st.scale) == 512.0
+        # consecutive overflows past exhaustion keep backing off (kernel
+        # :44-46 refills the tracker only on a clean step)
+        st = s.update(st, True)
+        assert float(st.scale) == 256.0
+        st = s.update(st, False)  # clean -> refill
+        st = s.update(st, True)
+        assert float(st.scale) == 256.0  # tolerated again
+
+    def test_clean_step_refills_allowance(self):
+        from apex_tpu.amp import LossScaler
+
+        s = LossScaler(loss_scale="dynamic", init_scale=1024.0, hysteresis=2)
+        st = s.init()
+        st = s.update(st, True)    # one down
+        st = s.update(st, False)   # clean -> refill
+        st = s.update(st, True)    # one down again (not two)
+        assert float(st.scale) == 1024.0
+        st = s.update(st, True)    # exhausted -> backoff
+        assert float(st.scale) == 512.0
+
+    def test_default_hysteresis_matches_plain_schedule(self):
+        from apex_tpu.amp import LossScaler
+
+        s = LossScaler(loss_scale="dynamic", init_scale=1024.0)
+        st = s.init()
+        st = s.update(st, True)
+        assert float(st.scale) == 512.0  # hysteresis=1: every overflow backs off
+
+    def test_state_dict_round_trips_hysteresis(self):
+        from apex_tpu.amp import LossScaler
+
+        s = LossScaler(loss_scale="dynamic", hysteresis=2)
+        st = s.update(s.init(), True)
+        st2 = s.load_state_dict(s.state_dict(st))
+        assert int(st2.hysteresis_tracker) == int(st.hysteresis_tracker) == 1
