@@ -1,0 +1,95 @@
+"""ASP 2:4 structured sparsity end to end: train dense, prune with
+channel-permutation search, fine-tune sparse.
+
+The reference recipe (apex/contrib/sparsity/README.md + asp.py:292
+prune_trained_model): dense training → compute 2:4 masks (optionally after
+a permutation search that raises retained magnitude) → masked fine-tuning
+so the optimizer keeps parameters exactly on the sparse subspace.
+
+Run:  python examples/sparsity/prune_mlp.py [--steps N]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.contrib.sparsity import ASP, permute_and_mask, prune
+from apex_tpu.optimizers import fused_adam
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    args = ap.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
+        "in": {"kernel": jax.random.normal(k1, (64, 128)) * 0.1},
+        "hid": {"kernel": jax.random.normal(k2, (128, 128)) * 0.1},
+        "out": {"kernel": jax.random.normal(k3, (128, 1)) * 0.1},
+    }
+    x = jax.random.normal(jax.random.fold_in(key, 9), (512, 64))
+    w_true = jax.random.normal(jax.random.fold_in(key, 10), (64,))
+    y = (x @ w_true)[:, None]
+
+    def apply_fn(p, x):
+        h = jnp.tanh(x @ p["in"]["kernel"])
+        h = jnp.tanh(h @ p["hid"]["kernel"])
+        return h @ p["out"]["kernel"]
+
+    def loss_fn(p):
+        return jnp.mean((apply_fn(p, x) - y) ** 2)
+
+    asp = ASP()
+    asp.init_model_for_pruning(params)
+    opt = asp.init_optimizer_for_pruning(fused_adam(lr=3e-3))
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if i % 50 == 0:
+            print(f"dense   step {i:4d} loss {float(loss):.5f}")
+    dense_loss = float(loss_fn(params))
+
+    # one-shot prune; masks enter the live optimizer state
+    pruned, state = asp.prune_trained_model(params, state)
+    pruned_loss = float(loss_fn(pruned))
+
+    # permutation search recovers magnitude the naive mask would drop
+    k = params["hid"]["kernel"]
+    _, mask = permute_and_mask(jnp.asarray(k).T)
+    naive = prune({"k": k}, {"k": jnp.asarray(asp.masks["hid"]["kernel"])})
+    permuted_kept = float(jnp.abs(k.T * mask).sum())
+    naive_kept = float(jnp.abs(naive["k"]).sum())
+    print(f"hid layer retained |w|: naive 2:4 {naive_kept:.2f}, "
+          f"permuted {permuted_kept:.2f} "
+          f"({permuted_kept / max(naive_kept, 1e-9):.3f}x)")
+
+    params = pruned
+    for i in range(args.steps):
+        params, state, loss = step(params, state)
+        if i % 50 == 0:
+            print(f"sparse  step {i:4d} loss {float(loss):.5f}")
+
+    # the masked optimizer kept every pruned weight at exactly zero
+    for name in ("in", "hid", "out"):
+        kzero = jnp.asarray(asp.masks[name]["kernel"]) == 0
+        assert bool(
+            jnp.all(jnp.asarray(params[name]["kernel"])[kzero] == 0.0)
+        ), f"{name}: pruned weights drifted off zero"
+    print(f"dense loss {dense_loss:.5f} -> post-prune {pruned_loss:.5f} "
+          f"-> fine-tuned {float(loss_fn(params)):.5f}; "
+          "2:4 zeros preserved through training")
+
+
+if __name__ == "__main__":
+    main()
