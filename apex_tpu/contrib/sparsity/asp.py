@@ -154,10 +154,12 @@ class ASP:
             import warnings
 
             warnings.warn(
-                "ASP: optimizer state initialized before "
-                "compute_sparse_masks — it holds all-ones placeholder "
-                "masks. compute_sparse_masks will now require the live "
-                "opt_state and hand back the refreshed one.",
+                "ASP: optimizer state initialized before masks were "
+                "computed — fine for the dense-train-then-prune recipe; "
+                "just pass this opt_state to compute_sparse_masks / "
+                "prune_trained_model later (it returns the refreshed "
+                "state). Until then training runs dense on the all-ones "
+                "placeholder masks.",
                 stacklevel=3,
             )
         else:

@@ -214,6 +214,27 @@ def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
     return _VIRTUAL_PIPELINE_WORLD_SIZE
 
 
+def get_amax_reduction_axes() -> tuple:
+    """Axes of the FP8 amax-reduction group (ref parallel_state.py:280-292:
+    tp x dp ranks sharing a pipeline stage — every rank that sees a shard
+    of the same activations; 'cp' joins for the same reason dp does).
+    Use inside shard_map: ``amax = amax_reduction(local_amax)``."""
+    return (DATA_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+
+
+def amax_reduction(local_amax):
+    """pmax of a local |activation|-max over the amax group (the delayed-
+    scaling statistic FP8 recipes synchronize; ref use_fp8 groups)."""
+    out = local_amax
+    for ax in get_amax_reduction_axes():
+        if _MESH is not None and int(get_mesh().shape[ax]) > 1:
+            try:
+                out = jax.lax.pmax(out, ax)
+            except NameError:  # axis not bound (outside shard_map)
+                pass
+    return out
+
+
 # -- ranks ------------------------------------------------------------------
 
 

@@ -363,3 +363,36 @@ class TestMeshConstruction:
             parallel_state.initialize_model_parallel(
                 devices=jax.devices()[:4], num_slices=2
             )
+
+
+class TestAmaxReduction:
+    def test_pmax_over_dp_and_tp(self, rng):
+        """Ref parallel_state.py:280-292: the amax group spans tp x dp
+        within a pipeline stage — every rank holding a shard of the same
+        activations agrees on one scaling statistic."""
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=2, pipeline_model_parallel_size=2
+        )
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=P("dp", "tp"), out_specs=P("dp", "tp"),
+            check_vma=False,
+        )
+        def reduce(x):
+            return parallel_state.amax_reduction(jnp.max(jnp.abs(x)))[
+                None, None
+            ]
+
+        x = jax.random.normal(rng, (4, 8))
+        out = np.asarray(reduce(x))
+        # every (dp, tp) shard agrees on the global max over dp x tp shards
+        assert (out == out.flat[0]).all()
+        np.testing.assert_allclose(out.flat[0], np.abs(np.asarray(x)).max(),
+                                   rtol=1e-6)
+
+    def test_noop_outside_shard_map(self):
+        parallel_state.initialize_model_parallel()
+        v = jnp.asarray(3.0)
+        np.testing.assert_allclose(parallel_state.amax_reduction(v), 3.0)
