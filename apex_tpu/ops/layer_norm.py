@@ -31,8 +31,13 @@ from apex_tpu.ops._dispatch import resolve_impl
 
 
 def _pick_block_rows(rows: int, hidden: int) -> int:
-    # keep x + y + dx blocks comfortably inside ~16MB VMEM (fp32 math)
-    budget = 1 << 20  # elements of fp32 per block operand
+    # Sized from a measured v5e failure, not theory: at 1<<20 elements/block
+    # (4MB fp32) the bwd kernel's fp32 temporaries (x, dy, xhat, dyw, dx —
+    # Mosaic stack-allocates each) blew the 16MB scoped-vmem limit by 32KB at
+    # hidden=4096.  1<<18 (1MB fp32 per operand block) keeps the ~10-copy
+    # working set near 10MB with double-buffering headroom; LN is HBM-bound,
+    # so narrower blocks cost nothing measurable.
+    budget = 1 << 18  # elements of fp32 per block operand
     br = max(8, min(512, budget // max(hidden, 1)))
     br = (br // 8) * 8
     return max(8, min(br, ((rows + 7) // 8) * 8))

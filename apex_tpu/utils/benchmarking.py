@@ -1,0 +1,135 @@
+"""Device-time measurement that survives the axon TPU relay.
+
+Measured relay behavior on this environment (2026-07-30, TPU v5 lite):
+
+- ``jax.block_until_ready`` does NOT wait for device execution — a 4096^3
+  bf16 matmul "completed" in 21 us (6,638 TFLOP/s, 34x the chip's peak), and
+  a chain of ten 256MB elementwise passes in 20 us.  Execution is deferred
+  until data is actually fetched to the host.
+- A synchronous dispatch+fetch round-trip costs ~73 ms (tunnel RTT), so
+  per-call wall-clock timing with a fetch measures the tunnel, not the chip.
+- Compile requests are size-limited (HTTP 413): closing over a large array
+  bakes it into the HLO as a constant and the remote compile is rejected.
+  Benchmark inputs must be passed as jit ARGUMENTS.
+
+The only trustworthy measurement is therefore a **slope**: run K data-
+dependent iterations inside ONE jitted ``lax.fori_loop``/``scan``, force
+completion with a small host fetch, and difference two K values so the RTT,
+dispatch, compile-cache, and fetch costs cancel.  Calibration on the real
+chip: 4096^3 bf16 matmul -> 0.758 ms/iter = 181 TFLOP/s (92% of the v5e's
+197 TFLOP/s peak), i.e. the method's overhead is within a few percent.
+
+This is the TPU-relay analogue of the reference's CUDA-event timing
+(tests/L0/run_mlp/test_mlp.py:135-207 uses wall clock + torch.cuda
+synchronize; CUDA's synchronize actually synchronizes — the relay's doesn't).
+"""
+
+import time
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["fetch", "chained_seconds_per_iter", "seconds_per_iter"]
+
+
+def fetch(out):
+    """Force real device execution by materializing every output leaf on the
+    host; returns the numpy leaves.  Outputs must be small (scalars/short
+    vectors) — fetching a large array would time the tunnel's transfer
+    instead of the computation."""
+    return [np.asarray(leaf) for leaf in jax.tree_util.tree_leaves(out)]
+
+
+def _best_of(fn, args, reps):
+    out = fetch(fn(*args))  # compile + first run outside the timed region
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fetch(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def chained_seconds_per_iter(
+    build: Callable[[int], Callable],
+    args: Sequence,
+    reps: int = 5,
+    target_signal: float = 0.4,
+    max_span: int = 1024,
+    return_output: bool = False,
+):
+    """Seconds per iteration of the loop body that ``build(k)`` chains k times.
+
+    ``build(k)`` must return a function of ``*args`` whose (small) output
+    data-depends on all k iterations — typically ``lax.fori_loop``/``scan``
+    with the iterate as the carry, reduced via a FULL ``sum`` at the end.
+    The result is the slope ``(t(k2) - t(k1)) / (k2 - k1)`` over
+    best-of-``reps`` synchronized runs, which cancels every per-call constant
+    (tunnel RTT, dispatch, fetch) and leaves pure device time.
+
+    The span ``k2 - k1`` is sized adaptively: the relay's RTT jitters by
+    ~±15 ms between calls (measured), so a fixed 20-iteration span turns a
+    1.5 ms/iter loop into pure noise — even negative slopes.  A rough pass
+    estimates the per-iteration time, then the span is chosen so the slope
+    signal is ~``target_signal`` seconds, i.e. an order of magnitude above
+    the jitter.
+
+    Raises ``RuntimeError`` if the final slope comes out non-positive even
+    at ``max_span`` — a garbage measurement must never be silently recorded
+    as a (nonsensical, huge) throughput.
+
+    With ``return_output=True``, returns ``(seconds, last_output)`` where
+    ``last_output`` is the fetched numpy output of the longest chain —
+    callers use it as a correctness gate on the exact computation timed.
+    """
+    t1, _ = _best_of(jax.jit(build(1)), args, reps)
+    span = 32
+    while True:
+        t2, out = _best_of(jax.jit(build(1 + span)), args, reps)
+        signal = t2 - t1
+        # accept once the slope signal dwarfs the jitter; otherwise escalate
+        # the span geometrically (each span is one more remote compile, so
+        # escalate in few, large steps rather than re-estimating precisely)
+        if signal >= target_signal or span >= max_span:
+            if signal <= 0:
+                raise RuntimeError(
+                    f"non-positive slope at span={span}: t(1)={t1:.4f}s "
+                    f"t({1 + span})={t2:.4f}s — timing is noise, not signal"
+                )
+            sec = signal / span
+            return (sec, out) if return_output else sec
+        est = max(signal / span, 1e-6)
+        span = min(max_span, max(span * 4, int(target_signal / est) + 1))
+
+
+def seconds_per_iter(step, carry, xs_like=None, reps: int = 5) -> float:
+    """Slope-time one step of ``carry -> carry`` (or ``(carry, x) -> carry``).
+
+    Convenience wrapper for the common benchmark shape: the step function is
+    chained via ``lax.scan`` over k dummy iterations with the carry threaded
+    through, then reduced to one scalar per carry leaf for the fetch.
+    """
+
+    def build(k):
+        def run(carry):
+            def body(c, _):
+                c2 = step(c) if xs_like is None else step(c, xs_like)
+                return c2, None
+
+            final, _ = jax.lax.scan(body, carry, None, length=k)
+            # ONE scalar out (each np.asarray in fetch() is a ~73 ms tunnel
+            # round-trip), and a FULL reduction: fetching a single element
+            # lets XLA dead-code-eliminate every other lane of an elementwise
+            # loop body straight through the scan carry (measured: Adam
+            # "steps" of 0.000 ms).  jnp.sum keeps every element live.
+            import jax.numpy as jnp
+
+            return sum(
+                jnp.sum(leaf.astype(jnp.float32))
+                for leaf in jax.tree_util.tree_leaves(final)
+            )
+
+        return run
+
+    return chained_seconds_per_iter(build, (carry,), reps=reps)

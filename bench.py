@@ -31,41 +31,29 @@ FALLBACK_RESERVE = 300       # always kept aside for the CPU-smoke record
 MIN_CHILD_TIMEOUT = 60
 
 
-def make_step(model, opt):
-    import jax
-    import optax
+def measure(dtype, batch, image_size):
+    """Images/sec for one RN50 train step, slope-timed.
 
-    from apex_tpu.models import cross_entropy_loss
-
-    # images/labels are step arguments, not closure constants — closed-over
-    # arrays would be baked into the HLO as a ~150 MB constant at batch 256
-    def step(params, batch_stats, opt_state, images, labels):
-        def loss_fn(p):
-            logits, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats},
-                images,
-                train=True,
-                mutable=["batch_stats"],
-            )
-            return cross_entropy_loss(logits, labels), mutated["batch_stats"]
-
-        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, bs, opt_state, loss
-
-    return jax.jit(step, donate_argnums=(0, 1, 2))
-
-
-def measure(dtype, batch, image_size, warmup=3, iters=10):
+    Wall-clock per-call timing is meaningless through the axon relay
+    (``block_until_ready`` does not wait for device execution and a
+    synchronous fetch costs ~73 ms of tunnel RTT — see
+    apex_tpu/utils/benchmarking.py), so the step is chained k times inside
+    one jitted ``lax.scan`` and the per-step time is the slope between two
+    chain lengths, which cancels every per-call constant.
+    """
     import jax
     import jax.numpy as jnp
+    import optax
 
-    from apex_tpu.models import ResNet50
+    from apex_tpu.models import ResNet50, cross_entropy_loss
     from apex_tpu.optimizers import fused_sgd
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
 
     model = ResNet50(num_classes=1000, dtype=dtype)
     key = jax.random.PRNGKey(0)
+    # images/labels are jit arguments, not closure constants — closed-over
+    # arrays would be baked into the HLO as a ~150 MB constant at batch 256
+    # (and the relay's compile endpoint rejects oversized programs)
     images = jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32)
     labels = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, 1000)
 
@@ -75,22 +63,48 @@ def measure(dtype, batch, image_size, warmup=3, iters=10):
     opt = fused_sgd(lr=0.1, momentum=0.9, weight_decay=1e-4)
     opt_state = opt.init(params)
 
-    step = make_step(model, opt)
-    for _ in range(warmup):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
-    jax.block_until_ready(loss)
+    def build(k):
+        def run(params, batch_stats, opt_state, images, labels):
+            def body(carry, _):
+                params, batch_stats, opt_state = carry
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, images, labels
-        )
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    assert bool(jnp.isfinite(loss)), f"loss diverged: {loss}"
-    return batch * iters / dt
+                def loss_fn(p):
+                    logits, mutated = model.apply(
+                        {"params": p, "batch_stats": batch_stats},
+                        images,
+                        train=True,
+                        mutable=["batch_stats"],
+                    )
+                    return cross_entropy_loss(logits, labels), mutated["batch_stats"]
+
+                (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                updates, opt_state2 = opt.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, bs, opt_state2), loss
+
+            (params, batch_stats, opt_state), losses = jax.lax.scan(
+                body, (params, batch_stats, opt_state), None, length=k
+            )
+            # full param reduction keeps every update lane live (elementwise
+            # chains are otherwise DCE-narrowed to the fetched element)
+            norm = sum(
+                jnp.sum(p.astype(jnp.float32) ** 2)
+                for p in jax.tree_util.tree_leaves(params)
+            )
+            return losses[-1], norm
+
+        return run
+
+    # raises on a non-positive slope rather than emitting garbage throughput
+    sec_per_step, (loss, norm) = chained_seconds_per_iter(
+        build, (params, batch_stats, opt_state, images, labels),
+        reps=3, target_signal=1.0, max_span=64, return_output=True,
+    )
+    # correctness gate on the (already-fetched) timed outputs
+    assert jnp.isfinite(loss) and jnp.isfinite(norm), (
+        f"diverged: loss={loss} param_norm_sq={norm}"
+    )
+    return batch / sec_per_step
 
 
 def run_bench():
@@ -103,12 +117,12 @@ def run_bench():
 
     jax.devices()  # force backend init (raises here on failure, not mid-bench)
     if _on_tpu():  # recognizes both "tpu" and the axon relay platform
-        batch, image_size, iters = 256, 224, 20
+        batch, image_size = 256, 224
     else:  # CPU smoke mode so the bench is runnable anywhere
-        batch, image_size, iters = 8, 32, 2
+        batch, image_size = 8, 32
 
-    o2 = measure(jnp.bfloat16, batch, image_size, iters=iters)  # amp O2: bf16 compute, fp32 params
-    o0 = measure(jnp.float32, batch, image_size, iters=iters)   # O0 baseline
+    o2 = measure(jnp.bfloat16, batch, image_size)  # amp O2: bf16 compute, fp32 params
+    o0 = measure(jnp.float32, batch, image_size)   # O0 baseline
 
     print(
         json.dumps(

@@ -18,6 +18,14 @@ dispatch resolves every variant to XLA, so the adam/l2norm rows still give a
 real flat-vs-tree comparison while the layer_norm/attention rows collapse to
 XLA-vs-XLA (reported as such).  Results land in BENCH.md.
 
+Timing methodology: every number is a chained-iteration SLOPE
+(``apex_tpu.utils.benchmarking``), not a per-call wall clock — the axon
+relay defers execution past ``block_until_ready`` and adds ~73 ms RTT per
+synchronous fetch, so per-call timing measures the tunnel.  K data-dependent
+iterations run inside one jitted ``lax.scan``; t(K2)-t(K1) over K2-K1 cancels
+every per-call constant.  Calibrated at 181 TFLOP/s on a 4096^3 bf16 matmul
+(92% of v5e peak).
+
 Usage:  python benchmarks/bench_optimizers.py [--cpu] [--params N] [--json]
 
 ``--cpu`` is mandatory knowledge for this environment: the axon sitecustomize
@@ -30,9 +38,7 @@ forces the CPU backend.
 import argparse
 import json
 import os
-import statistics
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -40,19 +46,21 @@ import jax
 import jax.numpy as jnp
 
 
-def _timeit(fn, *args, warmup=2, reps=5, inner=10):
-    """Median seconds per call of jitted ``fn`` (block_until_ready fenced)."""
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(inner):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        times.append((time.perf_counter() - t0) / inner)
-    return statistics.median(times)
+from apex_tpu.utils.benchmarking import chained_seconds_per_iter  # noqa: E402
+
+
+def _scalar(tree):
+    """One fp32 scalar data-depending on every ELEMENT of every leaf.
+
+    A full reduction, not ``leaf[0]``: for elementwise loop bodies (Adam!)
+    XLA can trace a single fetched element back through the scan carry and
+    dead-code-eliminate all other lanes — measured as a 0.000 ms "step".
+    ``jnp.sum`` makes every element live at a cost far below one loop body.
+    """
+    return sum(
+        jnp.sum(leaf.astype(jnp.float32))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
 
 
 def make_param_tree(total_params, key):
@@ -82,6 +90,8 @@ def make_param_tree(total_params, key):
 
 
 def bench_adam(tree, grads):
+    import optax
+
     from apex_tpu.optimizers import fused_adam
 
     results = {}
@@ -89,14 +99,19 @@ def bench_adam(tree, grads):
         opt = fused_adam(lr=1e-3, weight_decay=0.01, fuse=mode)
         state = jax.jit(opt.init)(tree)
 
-        @jax.jit
-        def step(g, s, p):
-            upd, s2 = opt.update(g, s, p)
-            import optax
+        def build(k, opt=opt):
+            def run(g, s, p):
+                def body(carry, _):
+                    p, s = carry
+                    upd, s2 = opt.update(g, s, p)
+                    return (optax.apply_updates(p, upd), s2), None
 
-            return optax.apply_updates(p, upd), s2
+                (p, s), _ = jax.lax.scan(body, (p, s), None, length=k)
+                return _scalar(p)
 
-        results[mode] = _timeit(step, grads, state, tree)
+            return run
+
+        results[mode] = chained_seconds_per_iter(build, (grads, state, tree))
     return results
 
 
@@ -105,12 +120,42 @@ def bench_l2norm(tree, grads):
     from apex_tpu.optimizers._fused_kernels import l2norm_flat
 
     flat, _ = flatten_pytree(grads, dtype=jnp.float32)
-    tree_fn = jax.jit(lambda g: multi_tensor_l2norm(jax.tree_util.tree_leaves(g)))
-    flat_fn = jax.jit(l2norm_flat)
+    tree_fn = lambda g: multi_tensor_l2norm(jax.tree_util.tree_leaves(g))
+    flat_fn = l2norm_flat
     # sanity: both engines agree before we time them
-    a, b = tree_fn(grads), flat_fn(flat)
+    a, b = jax.jit(tree_fn)(grads), jax.jit(flat_fn)(flat)
     assert jnp.allclose(a, b, rtol=1e-5), (a, b)
-    return {"tree": _timeit(tree_fn, grads), "flat": _timeit(flat_fn, flat)}
+
+    def build_tree(k):
+        def run(g):
+            # The 1e-30 carry nudge serializes the chained reductions (and
+            # defeats loop-invariant hoisting of per-leaf partial sums). XLA
+            # fuses the add into the reduction's read pass, but the timed
+            # body is still norm-of-a-freshly-produced-tensor, not a bare
+            # reduction — disclosed in BENCH.md; both variants pay it.
+            def body(c, _):
+                g2 = jax.tree_util.tree_map(lambda x: x + c * 1e-30, g)
+                return tree_fn(g2), None
+
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=k)
+            return c
+
+        return run
+
+    def build_flat(k):
+        def run(f):
+            def body(c, _):
+                return flat_fn(f + c * 1e-30), None
+
+            c, _ = jax.lax.scan(body, jnp.float32(0), None, length=k)
+            return c
+
+        return run
+
+    return {
+        "tree": chained_seconds_per_iter(build_tree, (grads,)),
+        "flat": chained_seconds_per_iter(build_flat, (flat,)),
+    }
 
 
 def bench_layer_norm(batch, hidden, key):
@@ -121,8 +166,18 @@ def bench_layer_norm(batch, hidden, key):
     b = jnp.zeros((hidden,))
     out = {}
     for impl in ("xla", "pallas"):
-        fn = jax.jit(lambda x, w, b, impl=impl: layer_norm(x, w, b, impl=impl))
-        out[impl] = _timeit(fn, x, w, b)
+
+        def build(k, impl=impl):
+            def run(x, w, b):
+                def body(c, _):
+                    return layer_norm(c, w, b, impl=impl), None
+
+                c, _ = jax.lax.scan(body, x, None, length=k)
+                return _scalar(c)
+
+            return run
+
+        out[impl] = chained_seconds_per_iter(build, (x, w, b))
     return out
 
 
@@ -134,10 +189,18 @@ def bench_attention(batch, heads, seq, dim, key):
     v = jax.random.normal(jax.random.fold_in(key, 2), (batch, heads, seq, dim), jnp.bfloat16)
     out = {}
     for impl in ("xla", "pallas"):
-        fn = jax.jit(
-            lambda q, k, v, impl=impl: flash_attention(q, k, v, causal=True, impl=impl)
-        )
-        out[impl] = _timeit(fn, q, k, v)
+
+        def build(n, impl=impl):
+            def run(q, k, v):
+                def body(c, _):
+                    return flash_attention(c, k, v, causal=True, impl=impl), None
+
+                c, _ = jax.lax.scan(body, q, None, length=n)
+                return _scalar(c)
+
+            return run
+
+        out[impl] = chained_seconds_per_iter(build, (q, k, v))
     return out
 
 
