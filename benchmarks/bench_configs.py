@@ -26,7 +26,6 @@ import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -37,15 +36,38 @@ if "xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
 import jax
 
 
-def _timed_steps(step, state, batches, warmup=2, iters=10):
-    for i in range(warmup):
-        state = step(state, *batches(i))
-    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
-    t0 = time.perf_counter()
-    for i in range(warmup, warmup + iters):
-        state = step(state, *batches(i))
-    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
-    return iters / (time.perf_counter() - t0), state
+def _timed_steps(step, state, batches):
+    """Steps/sec via chained-scan slope timing (relay-proof; methodology in
+    apex_tpu/utils/benchmarking.py — per-call wall clock through the axon
+    relay measures the tunnel, not the chip).  The batch is fixed at
+    ``batches(0)`` for every chained step, standard for throughput."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
+
+    b = batches(0)
+
+    def build(k):
+        def run(state, *b):
+            def body(c, _):
+                return step(c, *b), None
+
+            c, _ = jax.lax.scan(body, state, None, length=k)
+            # full reduction: keeps every lane of the carried state live
+            return sum(
+                jnp.sum(leaf.astype(jnp.float32))
+                for leaf in jax.tree_util.tree_leaves(c)
+            )
+
+        return run
+
+    sec, out = chained_seconds_per_iter(
+        build, (state, *b), reps=3, target_signal=0.5, max_span=256,
+        return_output=True,
+    )
+    assert np.isfinite(out[0]), f"diverged during timing: state sum={out[0]}"
+    return 1.0 / sec, state
 
 
 def bench_mlp(tpu):
