@@ -1,0 +1,141 @@
+"""One-shot TPU benchmark capture.
+
+The axon relay is intermittently reachable (it answered for ~40 minutes on
+2026-07-30, then hung mid-session; rounds 1-2 never reached it at all), so
+when it IS up, everything must be harvested in one process, ordered so the
+most valuable artifacts land first:
+
+1. compiled Pallas kernel smoke (numerics on hardware, fwd+bwd)
+2. fused-engine micro-benchmarks (flat-vs-tree Adam, Pallas-vs-XLA LN/attn)
+3. headline RN50 amp-O2 imgs/sec (bench.py's measurement, in-process)
+4. BASELINE configs 2-5 (full TPU shapes)
+
+Each section appends one JSON line to ``--out`` (default
+benchmarks/tpu_results.jsonl) the moment it completes, so a mid-run relay
+hang loses only the sections not yet reached.  Run it in the BACKGROUND and
+poll the file — never timeout-kill a process that holds the TPU claim (a
+SIGTERM mid-claim has wedged the relay for an entire session).
+
+Usage: python benchmarks/run_all_tpu.py [--out PATH] [--skip smoke,micro,...]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def emit(out_path, record):
+    record["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    line = json.dumps(record)
+    print(line, flush=True)
+    with open(out_path, "a") as f:
+        f.write(line + "\n")
+
+
+def section(out_path, name, fn):
+    t0 = time.time()
+    try:
+        payload = fn()
+        emit(out_path, {"section": name, "ok": True,
+                        "elapsed_s": round(time.time() - t0, 1), **payload})
+    except Exception:
+        emit(out_path, {
+            "section": name, "ok": False,
+            "elapsed_s": round(time.time() - t0, 1),
+            "error": traceback.format_exc()[-1500:],
+        })
+
+
+def run_smoke():
+    # in-process (a subprocess would need a second TPU claim while this one
+    # holds the relay), stdout captured
+    import contextlib
+    import io
+
+    import tpu_kernel_smoke
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tpu_kernel_smoke.main()
+    lines = [l for l in buf.getvalue().splitlines()
+             if l.startswith(("ok", "FAIL", "ALL", "backend"))]
+    return {"rc": rc, "lines": lines}
+
+
+def run_micro():
+    import jax
+
+    import bench_optimizers as bo
+
+    key = jax.random.PRNGKey(0)
+    tree = bo.make_param_tree(30_000_000, key)
+    grads = jax.tree_util.tree_map(
+        lambda x: jax.random.normal(jax.random.fold_in(key, 99), x.shape, x.dtype) * 1e-3,
+        tree,
+    )
+    rec = {}
+    rec["adam_step_s"] = bo.bench_adam(tree, grads)
+    rec["l2norm_s"] = bo.bench_l2norm(tree, grads)
+    rec["layer_norm_s"] = bo.bench_layer_norm(8192, 4096, jax.random.fold_in(key, 7))
+    rec["attention_s"] = bo.bench_attention(4, 16, 2048, 128, jax.random.fold_in(key, 8))
+    return rec
+
+
+def run_headline():
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import measure
+
+    o2 = measure(jnp.bfloat16, 256, 224)
+    o0 = measure(jnp.float32, 256, 224)
+    return {
+        "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+        "value": round(o2, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(o2 / o0, 3),
+    }
+
+
+def run_configs():
+    import bench_configs as bc
+
+    out = {}
+    for name in ("mlp", "bert", "dp", "gpt"):
+        t0 = time.time()
+        out[name] = bc.CONFIGS[name](tpu=True)
+        out[name]["elapsed_s"] = round(time.time() - t0, 1)
+    return {"configs": out}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(os.path.dirname(__file__), "tpu_results.jsonl"))
+    ap.add_argument("--skip", default="")
+    args = ap.parse_args()
+    skip = set(args.skip.split(",")) if args.skip else set()
+
+    import jax
+
+    dev = jax.devices()[0]
+    emit(args.out, {"section": "init", "ok": True,
+                    "platform": dev.platform, "device_kind": dev.device_kind})
+    if "smoke" not in skip:
+        section(args.out, "smoke", run_smoke)
+    if "micro" not in skip:
+        section(args.out, "micro", run_micro)
+    if "headline" not in skip:
+        section(args.out, "headline", run_headline)
+    if "configs" not in skip:
+        section(args.out, "configs", run_configs)
+    emit(args.out, {"section": "done", "ok": True})
+
+
+if __name__ == "__main__":
+    main()
