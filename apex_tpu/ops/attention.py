@@ -63,7 +63,11 @@ def _attn_ref(q, k, v, scale, causal, mask=None):
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
-    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, D)
+    # dot operands KEEP the input dtype (bf16 stays bf16) with fp32
+    # accumulation via preferred_element_type — upcasting operands to fp32
+    # before the dot forces the MXU's slow fp32 path and was the dominant
+    # cost of this kernel; softmax math stays fp32 throughout
+    q = q_ref[0]  # (BQ, D)
     seq_k = k_ref.shape[1]
     qi = pl.program_id(1)
     num_kv = seq_k // bk
@@ -74,11 +78,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
     # interpret-passes/compile-rejects hazard (r2 verdict weak #3)
     def body(j, carry):
         acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)  # (BK, D)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * bk, bk), :]  # (BK, D)
+        vb = v_ref[0, pl.ds(j * bk, bk), :]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (BQ, BK)
+        ) * scale  # (BQ, BK), fp32
         if causal:
             s = jnp.where(_causal_keep(qi, j, bq, bk), s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -86,7 +90,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
         p = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return acc_new, m_new, l_new
 
@@ -147,8 +152,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dq for one q block: loop over participating kv blocks (the exact
     recompute-from-lse strategy of the standard flash backward)."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0, :]
     delta = delta_ref[0, 0, :]
     seq_k = k_ref.shape[1]
@@ -156,8 +161,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     hi = _causal_hi(qi, bq, bk, num_kv) if causal else num_kv
 
     def body(j, acc):
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        # operands keep the input dtype; fp32 accumulation (see fwd kernel)
+        kb = k_ref[0, pl.ds(j * bk, bk), :]
+        vb = v_ref[0, pl.ds(j * bk, bk), :]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
@@ -169,7 +175,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta[:, None]) * scale
         return acc + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     d = q_ref.shape[2]
@@ -181,16 +188,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, *, scale, causal, bq, bk):
     """dk/dv for one kv block: loop over participating q blocks."""
     kj = pl.program_id(1)
-    kb = k_ref[0].astype(jnp.float32)  # (BK, D)
-    vb = v_ref[0].astype(jnp.float32)
+    kb = k_ref[0]  # (BK, D)
+    vb = v_ref[0]
     seq_q = q_ref.shape[1]
     num_q = seq_q // bq
     lo = jax.lax.div(kj * bk, bq) if causal else 0
 
     def body(i, carry):
+        # operands keep the input dtype; fp32 accumulation (see fwd kernel)
         dk, dv = carry
-        qb = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        qb = q_ref[0, pl.ds(i * bq, bq), :]
+        dob = do_ref[0, pl.ds(i * bq, bq), :]
         lse_b = lse_ref[0, 0, pl.ds(i * bq, bq)]
         delta_b = delta_ref[0, 0, pl.ds(i * bq, bq)]
         s = jax.lax.dot_general(
@@ -200,14 +208,16 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             p = jnp.where(_causal_keep(i, kj, bq, bk), p, 0.0)
         dv = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta_b[:, None]) * scale
         dk = dk + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk, dv
 

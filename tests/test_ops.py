@@ -200,6 +200,42 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
+    def test_bf16_fwd_bwd_close_to_fp32_ref(self, rng, causal):
+        """bf16 path: the kernel keeps dot OPERANDS in bf16 (p and ds are
+        cast back down before their dots — the MXU-rate flash recipe) with
+        fp32 accumulation/softmax.  Gate: within a few bf16 ulps of the
+        all-fp32 reference, fwd and bwd — this is the only test where the
+        kernel's bf16 casts are not no-ops."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        shape = (1, 2, 128, 64)
+        qf = jax.random.normal(k1, shape)
+        kf = jax.random.normal(k2, shape)
+        vf = jax.random.normal(k3, shape)
+        ct = jax.random.normal(k4, shape)
+        qb, kb, vb = (x.astype(jnp.bfloat16) for x in (qf, kf, vf))
+
+        out_b = flash_attention(qb, kb, vb, causal=causal, impl="pallas")
+        ref_f = self._ref(qf, kf, vf, causal)
+        # |out| <= max|v| ~ 4; bf16 eps ~ 8e-3 -> a few ulps of headroom
+        np.testing.assert_allclose(
+            np.asarray(out_b, np.float32), np.asarray(ref_f), atol=0.08
+        )
+
+        def loss(impl, dt):
+            return lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=causal, impl=impl).astype(
+                    jnp.float32
+                ) * ct
+            )
+
+        gb = jax.grad(loss("pallas", jnp.bfloat16), (0, 1, 2))(qb, kb, vb)
+        gf = jax.grad(loss("xla", jnp.float32), (0, 1, 2))(qf, kf, vf)
+        for a, b in zip(gb, gf):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b), atol=0.35
+            )
+
+    @pytest.mark.parametrize("causal", [False, True])
     def test_grads_multiblock(self, rng, causal):
         """seq > block forces the backward kernels' inner block loops (and
         the causal lo/hi bounds) to run over several blocks."""
