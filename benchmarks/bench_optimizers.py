@@ -158,6 +158,62 @@ def bench_l2norm(tree, grads):
     }
 
 
+def bench_adam_vs_torch_eager(tree, grads):
+    """BASELINE.md's second headline: "FusedAdam step time vs eager".
+
+    The reference's FusedAdam exists to beat eager per-tensor torch.optim
+    steps (SURVEY.md L4; amp_C.multi_tensor_adam).  Here the eager baseline
+    is torch.optim.AdamW on CPU over the same tensors — measured directly
+    (torch CPU ops are synchronous; no relay between us and the math) —
+    vs ``fused_adam(fuse="tree")`` jitted, slope-timed.  CPU-only: torch has
+    no TPU backend, so this row is skipped on TPU runs.
+    """
+    import time
+
+    import torch
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    tparams = [
+        torch.nn.Parameter(torch.from_numpy(__import__("numpy").asarray(x)).clone())
+        for x in leaves
+    ]
+    tgrads = [
+        torch.from_numpy(__import__("numpy").asarray(g)).clone()
+        for g in jax.tree_util.tree_leaves(grads)
+    ]
+    for p, g in zip(tparams, tgrads):
+        p.grad = g
+    opt = torch.optim.AdamW(tparams, lr=1e-3, weight_decay=0.01)
+    opt.step()  # state init outside the timed region
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        opt.step()
+    torch_sec = (time.perf_counter() - t0) / n
+
+    import optax
+
+    from apex_tpu.optimizers import fused_adam
+
+    fopt = fused_adam(lr=1e-3, weight_decay=0.01, fuse="tree")
+    state = jax.jit(fopt.init)(tree)
+
+    def build(k):
+        def run(g, s, p):
+            def body(carry, _):
+                p, s = carry
+                upd, s2 = fopt.update(g, s, p)
+                return (optax.apply_updates(p, upd), s2), None
+
+            (p, s), _ = jax.lax.scan(body, (p, s), None, length=k)
+            return _scalar(p)
+
+        return run
+
+    ours_sec = chained_seconds_per_iter(build, (grads, state, tree))
+    return {"torch_eager": torch_sec, "fused_tree": ours_sec}
+
+
 def bench_layer_norm(batch, hidden, key):
     from apex_tpu.ops.layer_norm import layer_norm
 
@@ -243,12 +299,17 @@ def main():
         "layer_norm_s": bench_layer_norm(*ln_shape, jax.random.fold_in(key, 7)),
         "attention_s": bench_attention(*attn_shape, jax.random.fold_in(key, 8)),
     }
+    if not tpu:  # torch has no TPU backend; eager baseline is CPU-only
+        record["adam_vs_eager_s"] = bench_adam_vs_torch_eager(tree, grads)
     if args.json:
         print(json.dumps(record))
         return
 
     print(f"platform={platform}  pallas_compiled={tpu}  params={total:,}")
-    for name in ("adam_step_s", "l2norm_s", "layer_norm_s", "attention_s"):
+    rows = ["adam_step_s", "l2norm_s", "layer_norm_s", "attention_s"]
+    if "adam_vs_eager_s" in record:
+        rows.append("adam_vs_eager_s")
+    for name in rows:
         row = record[name]
         (k1, v1), (k2, v2) = row.items()
         ratio = v1 / v2 if v2 else float("inf")
