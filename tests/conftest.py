@@ -34,6 +34,10 @@ import pytest  # noqa: E402
 #   fast:  python -m pytest tests/ -q -m "not slow" -n 4
 #   full:  python -m pytest tests/ -q
 _SLOW_TESTS = {
+    "test_amp_mlp_example",
+    "test_imagenet_example",
+    "test_gpt_pretrain_example",
+    "test_sparsity_example",
     "test_post_params_stay_replicated_under_sp",
     "test_matches_sequential_composition",
     "test_bert_sp_loss_and_grads_match_non_sp",
