@@ -1,0 +1,211 @@
+"""Cross-framework numeric parity: apex_tpu ops/optimizers vs PyTorch (CPU).
+
+The reference's L0 tier is built on numerical comparison against
+pure-PyTorch implementations (SURVEY.md §4; e.g.
+tests/L0/run_optimizers/test_fused_optimizer.py, run_fused_layer_norm/).
+The rest of this suite compares our fused engines against our own jnp
+references — a closed loop that can't catch a shared formula error.  These
+tests close that loop with the SAME external oracle the reference uses:
+torch's CPU implementations of Adam/AdamW/SGD, layer_norm, softmax
+cross-entropy, group_norm, and scaled_dot_product_attention.
+
+All comparisons run in fp32 on CPU with tolerances sized for
+order-of-operations differences, not behavioral slack.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+import torch
+import torch.nn.functional as F
+
+
+def _tree(key, shapes):
+    return {
+        f"p{i}": jax.random.normal(jax.random.fold_in(key, i), s, jnp.float32)
+        for i, s in enumerate(shapes)
+    }
+
+
+def _to_torch(tree):
+    return [
+        torch.nn.Parameter(torch.from_numpy(np.asarray(x)).clone())
+        for x in jax.tree_util.tree_leaves(tree)
+    ]
+
+
+SHAPES = [(64, 128), (128,), (32, 32, 3), (256,)]
+
+
+class TestOptimizersVsTorch:
+    @pytest.mark.parametrize("steps", [5])
+    def test_fused_adamw_matches_torch_adamw(self, steps):
+        key = jax.random.PRNGKey(0)
+        params = _tree(key, SHAPES)
+        tparams = _to_torch(params)
+        topt = torch.optim.AdamW(
+            tparams, lr=1e-2, betas=(0.9, 0.95), eps=1e-8, weight_decay=0.1
+        )
+        from apex_tpu.optimizers import fused_adam
+
+        opt = fused_adam(lr=1e-2, betas=(0.9, 0.95), eps=1e-8,
+                         weight_decay=0.1, adam_w_mode=True)
+        state = opt.init(params)
+        for s in range(steps):
+            gkey = jax.random.fold_in(key, 100 + s)
+            grads = jax.tree_util.tree_map(
+                lambda x: jax.random.normal(
+                    jax.random.fold_in(gkey, hash(x.shape) % 1000), x.shape
+                ),
+                params,
+            )
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.from_numpy(np.asarray(g)).clone()
+            topt.step()
+            upd, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(
+                np.asarray(ours), theirs.detach().numpy(), atol=2e-6
+            )
+
+    def test_fused_adam_l2_mode_matches_torch_adam(self):
+        key = jax.random.PRNGKey(1)
+        params = _tree(key, SHAPES)
+        tparams = _to_torch(params)
+        # torch.optim.Adam's weight_decay IS L2-into-the-gradient — the
+        # semantics our adam_w_mode=False mirrors (ref multi_tensor_adam.cu
+        # ADAM_MODE_1)
+        topt = torch.optim.Adam(tparams, lr=3e-3, weight_decay=0.05)
+        from apex_tpu.optimizers import fused_adam
+
+        opt = fused_adam(lr=3e-3, weight_decay=0.05, adam_w_mode=False)
+        state = opt.init(params)
+        for s in range(4):
+            grads = jax.tree_util.tree_map(
+                lambda x: jnp.full(x.shape, 0.01 * (s + 1), jnp.float32), params
+            )
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.from_numpy(np.asarray(g)).clone()
+            topt.step()
+            upd, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(
+                np.asarray(ours), theirs.detach().numpy(), atol=2e-6
+            )
+
+    @pytest.mark.parametrize("nesterov", [False, True])
+    def test_fused_sgd_matches_torch_sgd(self, nesterov):
+        key = jax.random.PRNGKey(2)
+        params = _tree(key, SHAPES)
+        tparams = _to_torch(params)
+        topt = torch.optim.SGD(
+            tparams, lr=0.1, momentum=0.9, weight_decay=1e-4,
+            nesterov=nesterov,
+        )
+        from apex_tpu.optimizers import fused_sgd
+
+        opt = fused_sgd(lr=0.1, momentum=0.9, weight_decay=1e-4,
+                        nesterov=nesterov)
+        state = opt.init(params)
+        for s in range(5):
+            gkey = jax.random.fold_in(key, 200 + s)
+            grads = jax.tree_util.tree_map(
+                lambda x: jax.random.normal(
+                    jax.random.fold_in(gkey, x.size % 997), x.shape
+                ) * 0.1,
+                params,
+            )
+            for tp, g in zip(tparams, jax.tree_util.tree_leaves(grads)):
+                tp.grad = torch.from_numpy(np.asarray(g)).clone()
+            topt.step()
+            upd, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        for ours, theirs in zip(jax.tree_util.tree_leaves(params), tparams):
+            np.testing.assert_allclose(
+                np.asarray(ours), theirs.detach().numpy(), atol=1e-6
+            )
+
+
+class TestOpsVsTorch:
+    def test_layer_norm_fwd_bwd(self):
+        key = jax.random.PRNGKey(3)
+        x = jax.random.normal(key, (96, 256), jnp.float32)
+        w = jax.random.normal(jax.random.fold_in(key, 1), (256,)) * 0.2 + 1.0
+        b = jax.random.normal(jax.random.fold_in(key, 2), (256,)) * 0.1
+
+        from apex_tpu.ops import layer_norm
+
+        def loss(x, w, b):
+            return jnp.sum(jnp.tanh(layer_norm(x, w, b, eps=1e-5)))
+
+        ours = layer_norm(x, w, b, eps=1e-5)
+        g = jax.grad(loss, (0, 1, 2))(x, w, b)
+
+        tx = torch.from_numpy(np.asarray(x)).requires_grad_()
+        tw = torch.from_numpy(np.asarray(w)).requires_grad_()
+        tb = torch.from_numpy(np.asarray(b)).requires_grad_()
+        ty = F.layer_norm(tx, (256,), tw, tb, eps=1e-5)
+        torch.sum(torch.tanh(ty)).backward()
+
+        np.testing.assert_allclose(np.asarray(ours), ty.detach().numpy(), atol=1e-5)
+        for a, t in zip(g, (tx.grad, tw.grad, tb.grad)):
+            np.testing.assert_allclose(np.asarray(a), t.numpy(), atol=1e-4)
+
+    def test_group_norm_fwd(self):
+        key = jax.random.PRNGKey(4)
+        x = jax.random.normal(key, (4, 8, 8, 32), jnp.float32)  # NHWC
+        w = jnp.ones((32,))
+        b = jnp.zeros((32,))
+        from apex_tpu.contrib.group_norm import group_norm
+
+        ours = group_norm(x, num_groups=8, weight=w, bias=b, eps=1e-5)
+        tx = torch.from_numpy(np.asarray(jnp.transpose(x, (0, 3, 1, 2))))
+        ty = F.group_norm(tx, 8, torch.ones(32), torch.zeros(32), eps=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(ours, (0, 3, 1, 2))), ty.numpy(), atol=1e-5
+        )
+
+    def test_xentropy_label_smoothing(self):
+        key = jax.random.PRNGKey(5)
+        logits = jax.random.normal(key, (32, 100), jnp.float32)
+        labels = jax.random.randint(jax.random.fold_in(key, 1), (32,), 0, 100)
+        from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+        ours = softmax_cross_entropy_loss(logits, labels, smoothing=0.1)
+        tl = F.cross_entropy(
+            torch.from_numpy(np.asarray(logits)),
+            torch.from_numpy(np.asarray(labels)).long(),
+            label_smoothing=0.1, reduction="none",
+        )
+        np.testing.assert_allclose(np.asarray(ours), tl.numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_attention_vs_sdpa(self, causal):
+        key = jax.random.PRNGKey(6)
+        shape = (2, 4, 128, 64)
+        q = jax.random.normal(key, shape, jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), shape, jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(key, 2), shape, jnp.float32)
+        from apex_tpu.ops import flash_attention
+
+        ours = flash_attention(q, k, v, causal=causal, impl="pallas")
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(np.asarray(q)),
+            torch.from_numpy(np.asarray(k)),
+            torch.from_numpy(np.asarray(v)),
+            is_causal=causal,
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=2e-5)
+
+    def test_softmax_family_vs_torch(self):
+        key = jax.random.PRNGKey(7)
+        x = jax.random.normal(key, (4, 8, 64, 64), jnp.float32)
+        from apex_tpu.ops.softmax import scaled_softmax
+
+        ours = scaled_softmax(x, scale=0.63)
+        ref = torch.softmax(torch.from_numpy(np.asarray(x)) * 0.63, dim=-1)
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6)
