@@ -209,3 +209,72 @@ class TestOpsVsTorch:
         ours = scaled_softmax(x, scale=0.63)
         ref = torch.softmax(torch.from_numpy(np.asarray(x)) * 0.63, dim=-1)
         np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6)
+
+
+class TestRNNCellsVsTorch:
+    """Gate-order/formula drift in RNN cells is invisible to shape tests;
+    torch.nn.LSTMCell/GRUCell are the oracles (ref apex/RNN mirrors torch's
+    cell math)."""
+
+    def test_lstm_cell_trajectory(self):
+        from apex_tpu.rnn.cells import LSTMCell
+
+        key = jax.random.PRNGKey(8)
+        in_dim, hs, batch = 24, 32, 4
+        cell = LSTMCell(hidden_size=hs)
+        carry = LSTMCell.init_carry(batch, hs)
+        x0 = jax.random.normal(key, (batch, in_dim), jnp.float32)
+        params = cell.init(key, carry, x0)
+
+        tcell = torch.nn.LSTMCell(in_dim, hs)
+        wi = np.asarray(params["params"]["wi"])  # (in, 4h)
+        wh = np.asarray(params["params"]["wh"])
+        b = np.asarray(params["params"]["bias"])
+        with torch.no_grad():
+            tcell.weight_ih.copy_(torch.from_numpy(wi.T))
+            tcell.weight_hh.copy_(torch.from_numpy(wh.T))
+            tcell.bias_ih.copy_(torch.from_numpy(b))
+            tcell.bias_hh.zero_()  # ours has ONE bias; torch has two
+
+        th = torch.zeros(batch, hs)
+        tc = torch.zeros(batch, hs)
+        for s in range(4):
+            x = jax.random.normal(jax.random.fold_in(key, 10 + s),
+                                  (batch, in_dim), jnp.float32)
+            carry, y = cell.apply(params, carry, x)
+            with torch.no_grad():
+                th, tc = tcell(torch.from_numpy(np.asarray(x)), (th, tc))
+        np.testing.assert_allclose(np.asarray(carry[0]), th.numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(carry[1]), tc.numpy(), atol=1e-5)
+
+    def test_gru_cell_trajectory(self):
+        from apex_tpu.rnn.cells import GRUCell
+
+        key = jax.random.PRNGKey(9)
+        in_dim, hs, batch = 16, 24, 3
+        cell = GRUCell(hidden_size=hs)
+        carry = GRUCell.init_carry(batch, hs)
+        x0 = jax.random.normal(key, (batch, in_dim), jnp.float32)
+        params = cell.init(key, carry, x0)
+        # non-zero biases so the two-bias split (bi vs bh, which matters in
+        # the r*hn term) is actually exercised
+        params = jax.tree_util.tree_map(
+            lambda x: x + 0.05 if x.ndim == 1 else x, params
+        )
+
+        tcell = torch.nn.GRUCell(in_dim, hs)
+        p = params["params"]
+        with torch.no_grad():
+            tcell.weight_ih.copy_(torch.from_numpy(np.asarray(p["wi"]).T))
+            tcell.weight_hh.copy_(torch.from_numpy(np.asarray(p["wh"]).T))
+            tcell.bias_ih.copy_(torch.from_numpy(np.asarray(p["bi"])))
+            tcell.bias_hh.copy_(torch.from_numpy(np.asarray(p["bh"])))
+
+        th = torch.zeros(batch, hs)
+        for s in range(4):
+            x = jax.random.normal(jax.random.fold_in(key, 20 + s),
+                                  (batch, in_dim), jnp.float32)
+            carry, y = cell.apply(params, carry, x)
+            with torch.no_grad():
+                th = tcell(torch.from_numpy(np.asarray(x)), th)
+        np.testing.assert_allclose(np.asarray(carry[0]), th.numpy(), atol=1e-5)
