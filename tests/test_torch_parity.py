@@ -211,6 +211,47 @@ class TestOpsVsTorch:
         np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6)
 
 
+class TestMLPVsTorch:
+    """The reference's own MLP test compares against an equivalent
+    nn.Sequential (tests/L0/run_mlp/test_mlp.py) — same oracle here,
+    forward AND input/weight gradients."""
+
+    @pytest.mark.parametrize("activation", ["relu", "sigmoid"])
+    def test_mlp_fwd_bwd(self, activation):
+        from apex_tpu.ops import mlp_apply, mlp_init
+
+        sizes = [40, 64, 32, 10]
+        params = mlp_init(jax.random.PRNGKey(10), sizes)
+        x = jax.random.normal(jax.random.PRNGKey(11), (16, 40), jnp.float32)
+
+        layers = []
+        for i in range(len(sizes) - 1):
+            lin = torch.nn.Linear(sizes[i], sizes[i + 1])
+            with torch.no_grad():
+                lin.weight.copy_(torch.from_numpy(np.asarray(params["weights"][i])))
+                lin.bias.copy_(torch.from_numpy(np.asarray(params["biases"][i])))
+            layers.append(lin)
+            if i < len(sizes) - 2:
+                layers.append(torch.nn.ReLU() if activation == "relu"
+                              else torch.nn.Sigmoid())
+        tmlp = torch.nn.Sequential(*layers)
+
+        ours = mlp_apply(params, x, activation=activation)
+        tx = torch.from_numpy(np.asarray(x)).requires_grad_()
+        ty = tmlp(tx)
+        np.testing.assert_allclose(np.asarray(ours), ty.detach().numpy(), atol=2e-5)
+
+        def loss(params, x):
+            return jnp.sum(jnp.tanh(mlp_apply(params, x, activation=activation)))
+
+        gp, gx = jax.grad(loss, (0, 1))(params, x)
+        torch.sum(torch.tanh(ty)).backward()
+        np.testing.assert_allclose(np.asarray(gx), tx.grad.numpy(), atol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(gp["weights"][0]), tmlp[0].weight.grad.numpy(), atol=2e-5
+        )
+
+
 class TestRNNCellsVsTorch:
     """Gate-order/formula drift in RNN cells is invisible to shape tests;
     torch.nn.LSTMCell/GRUCell are the oracles (ref apex/RNN mirrors torch's
