@@ -211,6 +211,79 @@ class TestOpsVsTorch:
         np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-6)
 
 
+class TestConvAndBNVsTorch:
+    def test_conv_bias_relu_fwd_bwd(self):
+        from apex_tpu.contrib.conv_bias_relu import conv_bias_relu
+
+        key = jax.random.PRNGKey(12)
+        x = jax.random.normal(key, (2, 16, 16, 8), jnp.float32)  # NHWC
+        w = jax.random.normal(jax.random.fold_in(key, 1), (3, 3, 8, 12)) * 0.2
+        b = jax.random.normal(jax.random.fold_in(key, 2), (12,)) * 0.1
+
+        ours = conv_bias_relu(x, w, b, padding=1, stride=2)
+
+        tx = torch.from_numpy(
+            np.asarray(jnp.transpose(x, (0, 3, 1, 2)))
+        ).requires_grad_()
+        tw = torch.from_numpy(
+            np.asarray(jnp.transpose(w, (3, 2, 0, 1)))  # HWIO -> OIHW
+        ).requires_grad_()
+        tb = torch.from_numpy(np.asarray(b)).requires_grad_()
+        ty = F.relu(F.conv2d(tx, tw, tb, stride=2, padding=1))
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(ours, (0, 3, 1, 2))), ty.detach().numpy(),
+            atol=2e-5,
+        )
+
+        def loss(x, w, b):
+            return jnp.sum(jnp.sin(conv_bias_relu(x, w, b, padding=1, stride=2)))
+
+        gx, gw, gb = jax.grad(loss, (0, 1, 2))(x, w, b)
+        torch.sum(torch.sin(ty)).backward()
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(gx, (0, 3, 1, 2))), tx.grad.numpy(), atol=2e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(gw, (3, 2, 0, 1))), tw.grad.numpy(), atol=2e-5
+        )
+        np.testing.assert_allclose(np.asarray(gb), tb.grad.numpy(), atol=2e-5)
+
+    def test_syncbn_single_device_matches_torch_bn_train_mode(self):
+        """On one device SyncBatchNorm must equal plain BN; oracle is
+        torch.nn.BatchNorm2d in train mode, including the running-stat
+        update after one batch."""
+        from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
+
+        key = jax.random.PRNGKey(13)
+        x = jax.random.normal(key, (8, 6, 6, 10), jnp.float32)
+        # torch-convention momentum (new = (1-m)*old + m*batch), no mesh axes
+        bn = SyncBatchNorm(momentum=0.1, epsilon=1e-5, axis_names=())
+        variables = bn.init(key, x, use_running_average=False)
+        ours, mutated = bn.apply(
+            variables, x, use_running_average=False, mutable=["batch_stats"]
+        )
+
+        tbn = torch.nn.BatchNorm2d(10, eps=1e-5, momentum=0.1)
+        tbn.train()
+        tx = torch.from_numpy(np.asarray(jnp.transpose(x, (0, 3, 1, 2))))
+        ty = tbn(tx)
+        np.testing.assert_allclose(
+            np.asarray(jnp.transpose(ours, (0, 3, 1, 2))), ty.detach().numpy(),
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mutated["batch_stats"]["mean"]),
+            tbn.running_mean.numpy(), atol=1e-5,
+        )
+        # both feed the UNBIASED (Bessel-corrected) batch var into the
+        # running mix — torch-convention stats tracking is part of the
+        # SyncBatchNorm design, so running var matches directly
+        np.testing.assert_allclose(
+            np.asarray(mutated["batch_stats"]["var"]),
+            tbn.running_var.numpy(), rtol=1e-5,
+        )
+
+
 class TestMLPVsTorch:
     """The reference's own MLP test compares against an equivalent
     nn.Sequential (tests/L0/run_mlp/test_mlp.py) — same oracle here,
