@@ -1,0 +1,138 @@
+"""HuggingFace GPT-2 weight import.
+
+Proves (and provides) functional interchangeability: a GPT-2 checkpoint in
+the transformers format maps onto ``apex_tpu.models.GPTModel`` exactly —
+same logits to fp32 tolerance (tests/test_hf_parity.py).  The reference's
+Megatron-style GPT (testing/standalone_gpt.py) is architecture-identical to
+GPT-2 (pre-LN, tanh-gelu, learned positions, tied embeddings); the only
+differences are packing/layout conventions, handled here:
+
+- HF ``c_attn`` packs [Q_all | K_all | V_all] over full hidden blocks;
+  Megatron's fused ``query_key_value`` packs per head: [q_0 k_0 v_0 | q_1
+  k_1 v_1 | ...] so the TP reshape (s, b, heads_local, 3*head_dim) works.
+- HF Conv1D stores (in, out) kernels — the same orientation as our flax
+  ``kernel``s, so no transposes beyond the qkv regroup.
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def config_from_hf_gpt2(hf_config, **overrides):
+    """TransformerConfig matching a transformers.GPT2Config."""
+    from apex_tpu.transformer import TransformerConfig
+
+    if getattr(hf_config, "activation_function", "gelu_new") not in (
+        "gelu_new", "gelu_pytorch_tanh",
+    ):
+        raise ValueError(
+            f"GPT2 activation {hf_config.activation_function!r} not the "
+            "tanh-gelu this mapping assumes"
+        )
+    kw = dict(
+        num_layers=hf_config.n_layer,
+        hidden_size=hf_config.n_embd,
+        num_attention_heads=hf_config.n_head,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.n_positions,
+        layernorm_epsilon=hf_config.layer_norm_epsilon,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        activation="gelu",  # _activate uses the tanh approximation == gelu_new
+        position_embedding_type="learned",
+        share_embeddings_and_output_weights=True,
+        apply_query_key_layer_scaling=False,
+        # checkpoint-parity default: the HF model computes fp32; override
+        # with compute_dtype=jnp.bfloat16 for TPU-rate inference/training
+        compute_dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def _regroup_qkv(w_qkv: np.ndarray, heads: int) -> np.ndarray:
+    """[Q|K|V] full-hidden blocks -> per-head [q k v] blocks.
+
+    Works for both kernels (h, 3h) and biases (3h,): the leading dims are
+    untouched, only the last axis is regrouped.
+    """
+    *lead, three_h = w_qkv.shape
+    h = three_h // 3
+    hn = h // heads
+    q, k, v = np.split(w_qkv, 3, axis=-1)
+    stack = np.stack(
+        [x.reshape(*lead, heads, hn) for x in (q, k, v)], axis=-2
+    )  # (*lead, heads, 3, hn)
+    return stack.reshape(*lead, 3 * h)
+
+
+def params_from_hf_gpt2(hf_model) -> Dict[str, Any]:
+    """Map a transformers GPT2LMHeadModel/GPT2Model state onto GPTModel's
+    param tree (tp=1 layout; shard with jax.device_put + NamedSharding for
+    tp>1 — the per-head qkv packing already matches the TP split)."""
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    pfx = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    heads = hf_model.config.n_head
+
+    def g(name):
+        return sd[pfx + name]
+
+    params: Dict[str, Any] = {
+        "embedding": {
+            "word_embeddings": {"embedding": jnp.asarray(g("wte.weight"))},
+            "position_embeddings": jnp.asarray(g("wpe.weight")),
+        },
+        "transformer": {
+            "final_layernorm": {
+                "scale": jnp.asarray(g("ln_f.weight")),
+                "bias": jnp.asarray(g("ln_f.bias")),
+            },
+        },
+    }
+    for i in range(hf_model.config.n_layer):
+        L = f"h.{i}."
+        params["transformer"][f"layer_{i}"] = {
+            "input_layernorm": {
+                "scale": jnp.asarray(g(L + "ln_1.weight")),
+                "bias": jnp.asarray(g(L + "ln_1.bias")),
+            },
+            "post_attention_layernorm": {
+                "scale": jnp.asarray(g(L + "ln_2.weight")),
+                "bias": jnp.asarray(g(L + "ln_2.bias")),
+            },
+            "self_attention": {
+                "query_key_value": {
+                    "kernel": jnp.asarray(
+                        _regroup_qkv(g(L + "attn.c_attn.weight"), heads)
+                    ),
+                    "bias": jnp.asarray(
+                        _regroup_qkv(g(L + "attn.c_attn.bias"), heads)
+                    ),
+                },
+                "dense": {
+                    "kernel": jnp.asarray(g(L + "attn.c_proj.weight")),
+                    "bias": jnp.asarray(g(L + "attn.c_proj.bias")),
+                },
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": jnp.asarray(g(L + "mlp.c_fc.weight")),
+                    "bias": jnp.asarray(g(L + "mlp.c_fc.bias")),
+                },
+                "dense_4h_to_h": {
+                    "kernel": jnp.asarray(g(L + "mlp.c_proj.weight")),
+                    "bias": jnp.asarray(g(L + "mlp.c_proj.bias")),
+                },
+            },
+        }
+    return params
+
+
+def gpt2_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
+    """(GPTModel, params) functionally equal to the given HF GPT-2."""
+    from apex_tpu.models import GPTModel
+
+    cfg = config_from_hf_gpt2(hf_model.config, **config_overrides)
+    return GPTModel(config=cfg), {"params": params_from_hf_gpt2(hf_model)}
