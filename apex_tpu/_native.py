@@ -75,6 +75,12 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.gather_rows_u16.argtypes = [
             p(ctypes.c_uint16), p(i64), i64, i64, p(ctypes.c_uint16)
         ]
+        lib.gather_rows_i32_mt.argtypes = [
+            p(ctypes.c_int32), p(i64), i64, i64, p(ctypes.c_int32), i64
+        ]
+        lib.gather_rows_u16_mt.argtypes = [
+            p(ctypes.c_uint16), p(i64), i64, i64, p(ctypes.c_uint16), i64
+        ]
         lib.flatten_f32.argtypes = [
             p(p(ctypes.c_float)), p(i64), i64, p(ctypes.c_float)
         ]
@@ -85,7 +91,7 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.build_lm_sample_offsets.argtypes = [i64, i64, p(i64), i64]
         lib.build_lm_sample_offsets.restype = i64
         lib.apex_tpu_native_abi_version.restype = i64
-        if lib.apex_tpu_native_abi_version() != 1:
+        if lib.apex_tpu_native_abi_version() != 2:
             return None
         _LIB = lib
         return _LIB
@@ -99,11 +105,17 @@ def _i64ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
+# staging batches past ~8 MB get striped over threads (host DRAM bandwidth
+# spans cores); below that the spawn cost exceeds the copy
+_MT_BYTES_THRESHOLD = 8 << 20
+_MT_THREADS = min(8, os.cpu_count() or 1)
+
+
 def gather_rows(data: np.ndarray, offsets: np.ndarray, row_len: int) -> np.ndarray:
     """out[i] = data[offsets[i] : offsets[i]+row_len]; data 1-D int32/uint16.
 
     The data-loader hot path: one native memcpy per sample out of the
-    token memmap."""
+    token memmap (threaded across cores for large batches)."""
     offsets = np.ascontiguousarray(offsets, np.int64)
     n = offsets.shape[0]
     if np.any(offsets < 0) or np.any(offsets + row_len > data.shape[0]):
@@ -115,17 +127,21 @@ def gather_rows(data: np.ndarray, offsets: np.ndarray, row_len: int) -> np.ndarr
         )
     data = np.ascontiguousarray(data)
     out = np.empty((n, row_len), data.dtype)
+    threads = (
+        _MT_THREADS if out.nbytes >= _MT_BYTES_THRESHOLD and _MT_THREADS > 1
+        else 1
+    )
     if data.dtype == np.int32:
-        lib.gather_rows_i32(
+        lib.gather_rows_i32_mt(
             data.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             _i64ptr(offsets), n, row_len,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), threads,
         )
     else:
-        lib.gather_rows_u16(
+        lib.gather_rows_u16_mt(
             data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
             _i64ptr(offsets), n, row_len,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)), threads,
         )
     return out
 
