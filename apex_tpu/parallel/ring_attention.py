@@ -70,10 +70,14 @@ def _chunk_block_size(s_local: int, block_size: int) -> int:
     return bk
 
 
-def _online_chunk_update(state, qf, kc, vc, scale, src, rank, causal, block_size):
+def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size):
     """Stream one visiting K/V chunk through the online softmax in
-    ``block_size`` slices. state = (acc, m, l) accumulated so far."""
-    sq = qf.shape[-2]
+    ``block_size`` slices. state = (acc, m, l) accumulated so far.
+
+    Dot operands KEEP the input dtype (bf16 stays bf16) with fp32
+    accumulation — upcasting before the einsum forces the MXU's slow fp32
+    path (same policy as ops/attention.py); softmax math stays fp32."""
+    sq = q.shape[-2]
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
@@ -81,10 +85,10 @@ def _online_chunk_update(state, qf, kc, vc, scale, src, rank, causal, block_size
     def block_step(carry, j):
         acc, m, l = carry
         lo = j * bk
-        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2).astype(jnp.float32)
-        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2).astype(jnp.float32)
+        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2)
         s = (
-            jnp.einsum("bhqd,bhkd->bhqk", qf, kb, preferred_element_type=jnp.float32)
+            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
         allow = _allow_mask(sq, lo, bk, src, rank, causal)
@@ -96,7 +100,10 @@ def _online_chunk_update(state, qf, kc, vc, scale, src, rank, causal, block_size
         if allow is not None:
             p = jnp.where(allow, p, 0.0)  # exp(-inf - (-inf)) guard
         l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32,
+        )
         return (acc_new, m_new, l_new), None
 
     if num_blocks == 1:
@@ -116,7 +123,6 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
-    qf = q.astype(jnp.float32)
 
     init_state = (
         jnp.zeros((b, h, sq, d), jnp.float32),
@@ -125,7 +131,7 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
     )
     # step 0 on the resident chunk — no rotation needed
     state = _online_chunk_update(
-        init_state, qf, k, v, scale, rank, rank, causal, block_size
+        init_state, q, k, v, scale, rank, rank, causal, block_size
     )
 
     def step(carry, t):
@@ -133,7 +139,7 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
         kc, vc = _rotate((kc, vc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         state = _online_chunk_update(
-            state, qf, kc, vc, scale, src, rank, causal, block_size
+            state, q, kc, vc, scale, src, rank, causal, block_size
         )
         return ((kc, vc), state), None
 
@@ -148,10 +154,12 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
     return o, (q, k, v, o, lse)
 
 
-def _chunk_bwd_update(qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
+def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
                       causal, block_size):
-    """Blockwise gradient contributions of one visiting K/V chunk."""
-    sq = qf.shape[-2]
+    """Blockwise gradient contributions of one visiting K/V chunk.
+    Operand-dtype policy as in _online_chunk_update; dkc/dvc/dq accumulate
+    in fp32."""
+    sq = q.shape[-2]
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
@@ -159,10 +167,10 @@ def _chunk_bwd_update(qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, ran
     def block_step(carry, j):
         dkc, dvc, dq = carry
         lo = j * bk
-        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2).astype(jnp.float32)
-        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2).astype(jnp.float32)
+        kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2)
+        vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2)
         s = (
-            jnp.einsum("bhqd,bhkd->bhqk", qf, kb, preferred_element_type=jnp.float32)
+            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
         allow = _allow_mask(sq, lo, bk, src, rank, causal)
@@ -171,11 +179,21 @@ def _chunk_bwd_update(qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, ran
         p = jnp.exp(s - lse[..., None])
         if allow is not None:
             p = jnp.where(allow, p, 0.0)
-        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
+        dv_b = jnp.einsum(
+            "bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+            preferred_element_type=jnp.float32,
+        )
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", do, vb, preferred_element_type=jnp.float32
+        )
         ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
-        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        ds_lo = ds.astype(kb.dtype)
+        dq = dq + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds_lo, kb, preferred_element_type=jnp.float32
+        )
+        dk_b = jnp.einsum(
+            "bhqk,bhqd->bhkd", ds_lo, q, preferred_element_type=jnp.float32
+        )
         dkc = jax.lax.dynamic_update_slice_in_dim(
             dkc, jax.lax.dynamic_slice_in_dim(dkc, lo, bk, 2) + dk_b, lo, 2
         )
@@ -197,16 +215,16 @@ def _ring_bwd(axis_name, causal, scale, block_size, res, do):
     q, k, v, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
-    qf = q.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b, h, sq)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (b, h, sq)
 
     zeros_k = jnp.zeros(k.shape, jnp.float32)
     zeros_v = jnp.zeros(v.shape, jnp.float32)
     dq0 = jnp.zeros(q.shape, jnp.float32)
     # step 0 on the resident chunk
     dk0, dv0, dq = _chunk_bwd_update(
-        qf, dof, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rank, rank,
+        q, do, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rank, rank,
         causal, block_size,
     )
 
@@ -216,7 +234,7 @@ def _ring_bwd(axis_name, causal, scale, block_size, res, do):
         kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         dkc, dvc, dq = _chunk_bwd_update(
-            qf, dof, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
+            q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
             causal, block_size,
         )
         return ((kc, vc, dkc, dvc), dq), None

@@ -58,6 +58,37 @@ class TestRingAttention:
             run(q, k, v), full_reference(q, k, v, causal), rtol=2e-4, atol=2e-5
         )
 
+    def test_bf16_forward_close_to_fp32_reference(self, rng):
+        """bf16 path: einsum operands stay bf16 (MXU-rate policy, as in
+        ops/attention.py) with fp32 online-softmax state — the only test
+        where those casts are not no-ops."""
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv = jax.random.split(rng, 3)
+        qf = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        kf = jax.random.normal(kk, (B, H, SEQ, D), jnp.float32)
+        vf = jax.random.normal(kv, (B, H, SEQ, D), jnp.float32)
+
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(seq_spec(),) * 3,
+            out_specs=seq_spec(),
+            check_vma=False,
+        )
+        def run(q, k, v):
+            return ring_attention(q, k, v, axis_name="cp", causal=True)
+
+        out_b = run(*(x.astype(jnp.bfloat16) for x in (qf, kf, vf)))
+        ref = full_reference(qf, kf, vf, True)
+        assert out_b.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out_b, np.float32), np.asarray(ref), atol=0.08
+        )
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_grad_parity(self, rng, causal):
         cp = 4
