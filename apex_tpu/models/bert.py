@@ -101,9 +101,20 @@ class BertModel(nn.Module):
         deterministic: bool = True,
     ):
         cfg = self.config
+        # padding keeps the Pallas flash fast path: a (b, s) key-padding row
+        # reaches the kernel directly (ops/attention.py key_padding_mask —
+        # the reference fmha's cu_seqlens role). Semantics match the dense
+        # extended mask: queries at padded positions attend uniformly but
+        # their losses are masked out (Megatron masks lm_loss by loss_mask).
+        # Attention dropout forces the unfused CoreAttention path, which
+        # wants the dense (b,1,s,s) extended mask.
         ext_mask = None
+        key_padding_mask = None
         if attention_mask is not None:
-            ext_mask = bert_extended_attention_mask(attention_mask)
+            if cfg.attention_dropout > 0.0 and not deterministic:
+                ext_mask = bert_extended_attention_mask(attention_mask)
+            else:
+                key_padding_mask = attention_mask.astype(bool) == False  # noqa: E712
         if self.pre_process:
             if tokentype_ids is None and self.num_tokentypes > 0:
                 tokentype_ids = jnp.zeros_like(tokens)  # segment-0 default
@@ -113,7 +124,8 @@ class BertModel(nn.Module):
         else:
             h = tokens
         h = self.transformer(
-            h, attention_mask=ext_mask, deterministic=deterministic
+            h, attention_mask=ext_mask, key_padding_mask=key_padding_mask,
+            deterministic=deterministic,
         )
         if not self.post_process:
             return h

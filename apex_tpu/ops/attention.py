@@ -62,11 +62,14 @@ def _attn_ref(q, k, v, scale, causal, mask=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
+                      has_kpm):
     # dot operands KEEP the input dtype (bf16 stays bf16) with fp32
     # accumulation via preferred_element_type — upcasting operands to fp32
     # before the dot forces the MXU's slow fp32 path and was the dominant
     # cost of this kernel; softmax math stays fp32 throughout
+    kpm_ref = refs[0] if has_kpm else None  # (1, SK) int32, 1 = padded key
+    o_ref, lse_ref = refs[-2:]
     q = q_ref[0]  # (BQ, D)
     seq_k = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -85,6 +88,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
         ) * scale  # (BQ, BK), fp32
         if causal:
             s = jnp.where(_causal_keep(qi, j, bq, bk), s, _NEG_INF)
+        if has_kpm:
+            s = jnp.where(kpm_ref[:, pl.ds(j * bk, bk)] == 0, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
@@ -102,18 +107,38 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq,
         jnp.zeros((bq, 1), jnp.float32),
     )
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    # fully-masked rows (every key padded): m = -inf, l = 0 -> emit zeros
+    # and a large-but-FINITE lse so the backward's exp(s - lse) stays 0
+    # instead of exp(-inf + inf) = nan
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+    lse_ref[0, 0, :] = jnp.maximum(m + jnp.log(l), _NEG_INF)[:, 0]
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
+def _kpm_spec(heads, sk):
+    """Key-padding-mask block: the (b, sk) int32 mask row for this (b*h)
+    grid step — heads is static, so b = bh // heads is an index-map affine."""
+    return pl.BlockSpec((1, sk), lambda b_h, i, heads=heads: (b_h // heads, 0))
+
+
+def _flash_fwd(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     grid = (bh, sq // bq)
+    has_kpm = kpm is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+    ]
+    inputs = [q3, k3, v3]
+    if has_kpm:
+        in_specs.append(_kpm_spec(heads, sk))
+        inputs.append(kpm)
     o, lse = pl.pallas_call(
         functools.partial(
-            _flash_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+            _flash_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            has_kpm=has_kpm,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
@@ -122,35 +147,33 @@ def _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk):
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=(
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),
         ),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(*inputs)
     return o, lse.reshape(bh, sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q3, k3, v3, scale, causal, interpret, bq, bk):
-    o, _ = _flash_fwd_res(q3, k3, v3, scale, causal, interpret, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
+    o, _ = _flash_fwd_res(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk)
     return o
 
 
-def _flash_fwd_res(q3, k3, v3, scale, causal, interpret, bq, bk):
-    o, lse = _flash_fwd(q3, k3, v3, scale, causal, interpret, bq, bk)
-    return o, (q3, k3, v3, o, lse)
+def _flash_fwd_res(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
+    o, lse = _flash_fwd(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk)
+    return o, (q3, k3, v3, kpm, o, lse)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, *, scale, causal, bq, bk):
+                         *refs, scale, causal, bq, bk, has_kpm):
     """dq for one q block: loop over participating kv blocks (the exact
     recompute-from-lse strategy of the standard flash backward)."""
+    kpm_ref = refs[0] if has_kpm else None
+    dq_ref = refs[-1]
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
@@ -170,6 +193,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         if causal:
             p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
+        if has_kpm:
+            p = jnp.where(kpm_ref[:, pl.ds(j * bk, bk)] == 0, p, 0.0)
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -185,8 +210,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, *, scale, causal, bq, bk):
+                          *refs, scale, causal, bq, bk, has_kpm):
     """dk/dv for one kv block: loop over participating q blocks."""
+    kpm_ref = refs[0] if has_kpm else None
+    dk_ref, dv_ref = refs[-2:]
     kj = pl.program_id(1)
     kb = k_ref[0]  # (BK, D)
     vb = v_ref[0]
@@ -207,6 +234,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse_b[:, None])
         if causal:
             p = jnp.where(_causal_keep(i, kj, bq, bk), p, 0.0)
+        if has_kpm:
+            # this kv block's slice of the padding row: keys of THIS block
+            p = jnp.where(kpm_ref[:, pl.ds(kj * bk, bk)] == 0, p, 0.0)
         dv = dv + jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -228,13 +258,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(scale, causal, interpret, bq, bk, res, do):
+def _flash_bwd(heads, scale, causal, interpret, bq, bk, res, do):
     """Pallas flash backward: recompute p from the saved logsumexp per
     block pair — O(seq x block) memory like the forward, never the full
     (sq, sk) score matrix (previously an XLA einsum chain)."""
-    q3, k3, v3, o, lse = res
+    q3, k3, v3, kpm, o, lse = res
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    has_kpm = kpm is not None
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (BH, SQ)
     lse3 = lse.reshape(bh, 1, sq)
@@ -243,47 +274,58 @@ def _flash_bwd(scale, causal, interpret, bq, bk, res, do):
     full_q = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0))
     full_k = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
     row_q = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # q block
+        full_k, full_k,                                    # k, v resident
+        pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # do block
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # lse block
+        pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # delta block
+    ]
+    inputs = [q3, k3, v3, do, lse3, delta3]
+    if has_kpm:
+        in_specs.append(_kpm_spec(heads, sk))
+        inputs.append(kpm)
     dq = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+            _flash_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            has_kpm=has_kpm,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         grid=(bh, sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # q block
-            full_k, full_k,                                    # k, v resident
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # do block
-            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # lse block
-            pl.BlockSpec((1, 1, bq), lambda b, i: (b, 0, i)),  # delta block
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         interpret=interpret,
-    )(q3, k3, v3, do, lse3, delta3)
+    )(*inputs)
 
+    in_specs_kv = [
+        full_q,                                            # q resident
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # k block
+        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # v block
+        full_q,                                            # do resident
+        row_q,                                             # lse full row
+        row_q,                                             # delta full row
+    ]
+    if has_kpm:
+        in_specs_kv.append(_kpm_spec(heads, sk))
     dk, dv = pl.pallas_call(
         functools.partial(
-            _flash_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+            has_kpm=has_kpm,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
         ),
         grid=(bh, sk // bk),
-        in_specs=[
-            full_q,                                            # q resident
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # k block
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # v block
-            full_q,                                            # do resident
-            row_q,                                             # lse full row
-            row_q,                                             # delta full row
-        ],
+        in_specs=in_specs_kv,
         out_specs=(
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
         ),
         interpret=interpret,
-    )(q3, k3, v3, do, lse3, delta3)
-    return dq, dk, dv
+    )(*inputs)
+    # kpm is an int mask: no cotangent (None == symbolic zero)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_fwd_res, _flash_bwd)
@@ -296,15 +338,19 @@ def flash_attention(
     causal: bool = False,
     scale: float = None,
     mask=None,
+    key_padding_mask=None,
     impl: str = "auto",
     block_q: int = 128,
     block_k: int = 128,
 ):
     """Multi-head attention; q,k,v: (batch, heads, seq, head_dim).
 
-    ``mask`` (True = masked out, broadcastable to (b, h, sq, sk)) forces the
-    XLA path; the Pallas kernel covers the unmasked / causal fast paths that
-    the reference's fmha/fast_multihead_attn accelerate.
+    ``key_padding_mask`` ((b, sk) bool, True = padded-out key) stays on the
+    Pallas fast path — the reference fmha's variable-seqlen capability
+    (contrib/fmha: cu_seqlens) expressed as a mask. An arbitrary ``mask``
+    (True = masked out, broadcastable to (b, h, sq, sk)) forces the XLA
+    path; the Pallas kernel covers the unmasked / causal / key-padded fast
+    paths that the reference's fmha/fast_multihead_attn accelerate.
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -321,9 +367,17 @@ def flash_attention(
         and (not causal or sq == sk)
     )
     if not pallas_ok:
+        if key_padding_mask is not None:
+            kp = key_padding_mask[:, None, None, :]  # (b, 1, 1, sk)
+            mask = kp if mask is None else jnp.logical_or(mask, kp)
         return _attn_ref(q, k, v, scale, causal, mask)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)
     v3 = v.reshape(b * h, sk, d)
-    o = _flash(q3, k3, v3, scale, causal, interpret, bq, bk)
+    kpm = (
+        None
+        if key_padding_mask is None
+        else key_padding_mask.astype(jnp.int32)  # (b, sk), 1 = padded
+    )
+    o = _flash(q3, k3, v3, kpm, h, scale, causal, interpret, bq, bk)
     return o.reshape(b, h, sq, d)

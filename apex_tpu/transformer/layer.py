@@ -226,6 +226,7 @@ class ParallelAttention(nn.Module):
         attention_mask=None,
         encoder_output=None,
         rotary_pos_emb=None,
+        key_padding_mask=None,
         deterministic: bool = True,
     ):
         cfg = self.config
@@ -303,11 +304,21 @@ class ParallelAttention(nn.Module):
         use_flash = attention_mask is None and (
             cfg.attention_dropout == 0.0 or deterministic
         )
+        if key_padding_mask is not None and not use_flash:
+            # fold the (b, sk) padding row into the dense mask for the
+            # unfused CoreAttention path (True = masked out)
+            kp = key_padding_mask[:, None, None, :]
+            attention_mask = (
+                kp if attention_mask is None
+                else jnp.logical_or(attention_mask, kp)
+            )
+            key_padding_mask = None
         if cp > 1:
-            if not use_flash:
+            if not use_flash or key_padding_mask is not None:
                 raise NotImplementedError(
                     "context parallelism supports causal/unmasked attention "
-                    "without dropout (like the reference's fused paths)"
+                    "without dropout or padding masks (like the reference's "
+                    "fused paths)"
                 )
             from apex_tpu.parallel.ring_attention import (
                 ring_attention,
@@ -331,7 +342,8 @@ class ParallelAttention(nn.Module):
                 )
         elif use_flash:
             ctx = flash_attention(
-                qb, kb, vb, causal=causal, impl=cfg.attention_impl
+                qb, kb, vb, causal=causal, key_padding_mask=key_padding_mask,
+                impl=cfg.attention_impl,
             )
         else:
             ctx = CoreAttention(
@@ -368,6 +380,7 @@ class ParallelTransformerLayer(nn.Module):
         encoder_output=None,
         enc_dec_attn_mask=None,
         rotary_pos_emb=None,
+        key_padding_mask=None,
         deterministic: bool = True,
     ):
         cfg = self.config
@@ -378,9 +391,9 @@ class ParallelTransformerLayer(nn.Module):
         if cfg.recompute_granularity == "selective":
             # recompute only the attention block in backward (ref: Megatron
             # --recompute-granularity selective; core-attention checkpoint).
-            # arg 0 is the module scope; ``deterministic`` (arg 5) is static.
+            # arg 0 is the module scope; ``deterministic`` (arg 6) is static.
             attn_cls = nn.remat(
-                ParallelAttention, static_argnums=(5,), prevent_cse=False
+                ParallelAttention, static_argnums=(6,), prevent_cse=False
             )
         attn_out = attn_cls(
             config=cfg,
@@ -392,6 +405,7 @@ class ParallelTransformerLayer(nn.Module):
             attention_mask,
             None,
             rotary_pos_emb,
+            key_padding_mask,
             deterministic,
         )
         residual = (
@@ -474,16 +488,17 @@ class ParallelTransformer(nn.Module):
         hidden_states,
         attention_mask=None,
         rotary_pos_emb=None,
+        key_padding_mask=None,
         deterministic: bool = True,
     ):
         cfg = self.config
         n = self.num_layers if self.num_layers is not None else cfg.num_layers
         layer_cls = ParallelTransformerLayer
         if cfg.recompute_granularity == "full":
-            # arg 0 is the module scope; ``deterministic`` (arg 6) is static
+            # arg 0 is the module scope; ``deterministic`` (arg 7) is static
             layer_cls = nn.remat(
                 ParallelTransformerLayer,
-                static_argnums=(6,),
+                static_argnums=(7,),
                 prevent_cse=False,
             )
         for i in range(n):
@@ -495,6 +510,7 @@ class ParallelTransformer(nn.Module):
                 None,
                 None,
                 rotary_pos_emb,
+                key_padding_mask,
                 deterministic,
             )
         if self.post_layer_norm:

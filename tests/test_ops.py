@@ -200,6 +200,48 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
+    def test_key_padding_mask_matches_xla(self, rng, causal):
+        """Pallas fast path with (b, sk) key padding — the reference fmha's
+        variable-seqlen capability. One batch row is fully padded to pin the
+        exp(-inf - lse) guard."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        shape = (3, 2, 128, 64)
+        q = jax.random.normal(k1, shape)
+        k = jax.random.normal(k2, shape)
+        v = jax.random.normal(k3, shape)
+        ct = jax.random.normal(k4, shape)
+        # row 0: valid prefix 70; row 1: no padding; row 2: ALL padded
+        kpm = np.zeros((3, 128), bool)
+        kpm[0, 70:] = True
+        kpm[2, :] = True
+        kpm = jnp.asarray(kpm)
+
+        out_p = flash_attention(q, k, v, causal=causal,
+                                key_padding_mask=kpm, impl="pallas")
+        out_x = flash_attention(q, k, v, causal=causal,
+                                key_padding_mask=kpm, impl="xla")
+        # fully-padded rows degrade to UNIFORM attention in both paths (the
+        # finite -1e30 mask value makes softmax([-1e30,...]) uniform) —
+        # finite everywhere, never nan, and identical across impls
+        assert bool(jnp.all(jnp.isfinite(out_p)))
+        np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=5e-5)
+
+        def loss(impl):
+            def f(q, k, v):
+                o = flash_attention(q, k, v, causal=causal,
+                                    key_padding_mask=kpm, impl=impl)
+                # row 2 is all padding: a real loss would mask it; do so
+                return jnp.sum(o[:2] * ct[:2])
+
+            return f
+
+        gp = jax.grad(loss("pallas"), (0, 1, 2))(q, k, v)
+        gx = jax.grad(loss("xla"), (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gx):
+            assert bool(jnp.all(jnp.isfinite(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
     def test_bf16_fwd_bwd_close_to_fp32_ref(self, rng, causal):
         """bf16 path: the kernel keeps dot OPERANDS in bf16 (p and ds are
         cast back down before their dots — the MXU-rate flash recipe) with

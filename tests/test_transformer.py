@@ -237,6 +237,25 @@ class TestBert:
         losses, _ = model.apply(params, tokens, mask, tokentype, lm_labels=labels)
         assert losses.shape == (2, 16)
 
+    def test_kpm_fast_path_matches_dense_mask_path(self, rng):
+        """The (b, s) key-padding row through the flash kernel must equal
+        the same mask expressed densely through CoreAttention (key-side
+        broadcast), for every position."""
+        from apex_tpu.transformer.layer import ParallelTransformer
+
+        cfg = tiny_cfg()
+        model = ParallelTransformer(config=cfg, attn_mask_type=AttnMaskType.padding)
+        h = jax.random.normal(rng, (16, 2, 32), jnp.float32)  # (s, b, h)
+        kpm = jnp.zeros((2, 16), bool).at[0, 11:].set(True)
+        params = model.init(rng, h)
+
+        out_kpm = model.apply(params, h, key_padding_mask=kpm)
+        dense = kpm[:, None, None, :]  # key-side-only dense equivalent
+        out_dense = model.apply(params, h, attention_mask=dense)
+        np.testing.assert_allclose(
+            np.asarray(out_kpm), np.asarray(out_dense), atol=2e-5
+        )
+
     def test_padding_mask_blocks_attention(self, rng):
         """Masked-out positions must not influence kept positions' outputs."""
         cfg = tiny_cfg()
