@@ -35,10 +35,12 @@ from apex_tpu.ops.softmax import fused_scale_mask_softmax
 def _attend(q, k, v, mask_additive_bias, key_padding_mask, dropout, scaling,
             deterministic, dropout_rng_module, causal=False):
     """q,k,v: (b*h grouped as b, h, s, d) -> (b, h, sq, d)."""
-    if mask_additive_bias is None and key_padding_mask is None and (
-        dropout == 0.0 or deterministic
-    ):
-        return flash_attention(q, k, v, causal=causal, scale=scaling)
+    if mask_additive_bias is None and (dropout == 0.0 or deterministic):
+        # key padding stays on the flash fast path (ops/attention.py kpm)
+        return flash_attention(
+            q, k, v, causal=causal, scale=scaling,
+            key_padding_mask=key_padding_mask,
+        )
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scaling
