@@ -69,6 +69,15 @@ def _load() -> Optional[ctypes.CDLL]:
         i64 = ctypes.c_int64
         u64 = ctypes.c_uint64
         p = ctypes.POINTER
+        # version gate FIRST: a stale .so from an older ABI may lack the
+        # newer symbols, and a ctypes attribute lookup on a missing symbol
+        # raises — the numpy fallback must win instead
+        try:
+            lib.apex_tpu_native_abi_version.restype = i64
+            if lib.apex_tpu_native_abi_version() != 2:
+                return None
+        except AttributeError:
+            return None
         lib.gather_rows_i32.argtypes = [
             p(ctypes.c_int32), p(i64), i64, i64, p(ctypes.c_int32)
         ]
@@ -90,9 +99,6 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.permutation_i64.argtypes = [i64, u64, p(i64)]
         lib.build_lm_sample_offsets.argtypes = [i64, i64, p(i64), i64]
         lib.build_lm_sample_offsets.restype = i64
-        lib.apex_tpu_native_abi_version.restype = i64
-        if lib.apex_tpu_native_abi_version() != 2:
-            return None
         _LIB = lib
         return _LIB
 

@@ -107,12 +107,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
         jnp.zeros((bq, 1), jnp.float32),
     )
     acc, m, l = jax.lax.fori_loop(0, hi, body, init)
-    # fully-masked rows (every key padded): m = -inf, l = 0 -> emit zeros
-    # and a large-but-FINITE lse so the backward's exp(s - lse) stays 0
-    # instead of exp(-inf + inf) = nan
+    # fully-masked rows (every key padded): the finite -1e30 mask means the
+    # loop accumulated a spurious uniform softmax (p = exp(0) = 1 per key).
+    # Emit ZEROS and a +1e30 lse sentinel instead: output-zero rows make the
+    # backward's p = exp(s - lse) underflow to exactly 0, so the custom VJP
+    # is self-consistent (o = 0 constant => dq = dk = dv = 0 for that row)
+    # and no padded v values leak into the output. The XLA kpm path zeroes
+    # dead rows identically (flash_attention wrapper).
+    dead = m <= _NEG_INF * 0.5
     l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = jnp.maximum(m + jnp.log(l), _NEG_INF)[:, 0]
+    o_ref[0] = jnp.where(dead, 0.0, acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = jnp.where(dead, -_NEG_INF, m + jnp.log(l))[:, 0]
 
 
 def _kpm_spec(heads, sk):
@@ -370,6 +375,11 @@ def flash_attention(
         if key_padding_mask is not None:
             kp = key_padding_mask[:, None, None, :]  # (b, 1, 1, sk)
             mask = kp if mask is None else jnp.logical_or(mask, kp)
+            out = _attn_ref(q, k, v, scale, causal, mask)
+            # fully-padded rows are zero (not uniform-softmax leakage) in
+            # the Pallas kernel; match exactly here
+            dead = jnp.all(key_padding_mask, axis=-1)[:, None, None, None]
+            return jnp.where(dead, jnp.zeros((), out.dtype), out)
         return _attn_ref(q, k, v, scale, causal, mask)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h, sk, d)

@@ -30,7 +30,30 @@ from typing import Callable, Sequence
 import jax
 import numpy as np
 
-__all__ = ["fetch", "chained_seconds_per_iter", "seconds_per_iter"]
+__all__ = [
+    "fetch",
+    "full_reduce",
+    "chained_seconds_per_iter",
+    "seconds_per_iter",
+]
+
+
+def full_reduce(tree):
+    """ONE fp32 scalar depending on every ELEMENT of every leaf.
+
+    This reduction is load-bearing for measurement validity, not a
+    convenience: fetching a single element lets XLA trace it back through a
+    scan carry and dead-code-eliminate every other lane of an elementwise
+    loop body (measured: 0.000 ms Adam "steps"), and one scalar output
+    means one host fetch (each is a ~73 ms tunnel round-trip). Use this in
+    every slope-timed ``build`` — do not re-implement it inline.
+    """
+    import jax.numpy as jnp
+
+    return sum(
+        jnp.sum(leaf.astype(jnp.float32))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
 
 
 def fetch(out):
@@ -118,17 +141,7 @@ def seconds_per_iter(step, carry, xs_like=None, reps: int = 5) -> float:
                 return c2, None
 
             final, _ = jax.lax.scan(body, carry, None, length=k)
-            # ONE scalar out (each np.asarray in fetch() is a ~73 ms tunnel
-            # round-trip), and a FULL reduction: fetching a single element
-            # lets XLA dead-code-eliminate every other lane of an elementwise
-            # loop body straight through the scan carry (measured: Adam
-            # "steps" of 0.000 ms).  jnp.sum keeps every element live.
-            import jax.numpy as jnp
-
-            return sum(
-                jnp.sum(leaf.astype(jnp.float32))
-                for leaf in jax.tree_util.tree_leaves(final)
-            )
+            return full_reduce(final)
 
         return run
 

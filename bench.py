@@ -47,7 +47,7 @@ def measure(dtype, batch, image_size):
 
     from apex_tpu.models import ResNet50, cross_entropy_loss
     from apex_tpu.optimizers import fused_sgd
-    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter, full_reduce
 
     model = ResNet50(num_classes=1000, dtype=dtype)
     key = jax.random.PRNGKey(0)
@@ -87,11 +87,7 @@ def measure(dtype, batch, image_size):
             )
             # full param reduction keeps every update lane live (elementwise
             # chains are otherwise DCE-narrowed to the fetched element)
-            norm = sum(
-                jnp.sum(p.astype(jnp.float32) ** 2)
-                for p in jax.tree_util.tree_leaves(params)
-            )
-            return losses[-1], norm
+            return losses[-1], full_reduce(params)
 
         return run
 

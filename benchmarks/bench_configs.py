@@ -41,10 +41,9 @@ def _timed_steps(step, state, batches):
     apex_tpu/utils/benchmarking.py — per-call wall clock through the axon
     relay measures the tunnel, not the chip).  The batch is fixed at
     ``batches(0)`` for every chained step, standard for throughput."""
-    import jax.numpy as jnp
     import numpy as np
 
-    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter, full_reduce
 
     b = batches(0)
 
@@ -54,11 +53,7 @@ def _timed_steps(step, state, batches):
                 return step(c, *b), None
 
             c, _ = jax.lax.scan(body, state, None, length=k)
-            # full reduction: keeps every lane of the carried state live
-            return sum(
-                jnp.sum(leaf.astype(jnp.float32))
-                for leaf in jax.tree_util.tree_leaves(c)
-            )
+            return full_reduce(c)
 
         return run
 
@@ -67,7 +62,7 @@ def _timed_steps(step, state, batches):
         return_output=True,
     )
     assert np.isfinite(out[0]), f"diverged during timing: state sum={out[0]}"
-    return 1.0 / sec, state
+    return 1.0 / sec
 
 
 def bench_mlp(tpu):
@@ -103,7 +98,7 @@ def bench_mlp(tpu):
         params, state, _ = amp_opt.step(grads, state, params)
         return params, state
 
-    sps, _ = _timed_steps(step, (params, state), lambda i: (x, y))
+    sps = _timed_steps(step, (params, state), lambda i: (x, y))
     return {"config": "mlp_fusedadam_clip", "metric": "steps_per_sec",
             "value": round(sps, 2), "unit": "steps/sec"}
 
@@ -163,7 +158,7 @@ def bench_dp_syncbn(tpu):
 
     carry = (variables["params"], variables["batch_stats"],
              opt.init(variables["params"]))
-    sps, _ = _timed_steps(step, carry, lambda i: (images, labels))
+    sps = _timed_steps(step, carry, lambda i: (images, labels))
     return {"config": "rn50_dp_syncbn", "metric": "imgs_per_sec_global",
             "value": round(sps * batch, 2), "unit": "imgs/sec",
             "devices": n_dev}
@@ -213,7 +208,7 @@ def bench_bert(tpu):
         updates, opt_state = opt.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state)
 
-    sps, _ = _timed_steps(step, (params, opt.init(params)),
+    sps = _timed_steps(step, (params, opt.init(params)),
                           lambda i: (tokens, labels))
     return {"config": "bert_fusedlamb", "metric": "sequences_per_sec",
             "value": round(sps * batch, 2), "unit": "seq/sec"}
@@ -287,7 +282,7 @@ def bench_gpt_tp(tpu, force_tp=None):
         updates, opt_state = opt.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state)
 
-    sps, _ = _timed_steps(step, (params, opt.init(params)),
+    sps = _timed_steps(step, (params, opt.init(params)),
                           lambda i: (tokens, labels))
     parallel_state.destroy_model_parallel()
     return {"config": "gpt_tensor_parallel", "metric": "tokens_per_sec",

@@ -220,18 +220,20 @@ class TestFlashAttention:
                                 key_padding_mask=kpm, impl="pallas")
         out_x = flash_attention(q, k, v, causal=causal,
                                 key_padding_mask=kpm, impl="xla")
-        # fully-padded rows degrade to UNIFORM attention in both paths (the
-        # finite -1e30 mask value makes softmax([-1e30,...]) uniform) —
-        # finite everywhere, never nan, and identical across impls
+        # fully-padded rows are ZERO in both impls (no uniform-softmax
+        # leakage of padded v values), finite everywhere, never nan
         assert bool(jnp.all(jnp.isfinite(out_p)))
         np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=5e-5)
+        np.testing.assert_allclose(np.asarray(out_p[2]), 0.0, atol=0.0)
 
+        # grads INCLUDE the dead row's output in the loss on purpose: the
+        # o=0 convention must be differentiable-consistent (all-zero grads
+        # for that row) in BOTH impls, not just when the loss masks it
         def loss(impl):
             def f(q, k, v):
                 o = flash_attention(q, k, v, causal=causal,
                                     key_padding_mask=kpm, impl=impl)
-                # row 2 is all padding: a real loss would mask it; do so
-                return jnp.sum(o[:2] * ct[:2])
+                return jnp.sum(o * ct)
 
             return f
 
@@ -240,6 +242,9 @@ class TestFlashAttention:
         for a, b in zip(gp, gx):
             assert bool(jnp.all(jnp.isfinite(a)))
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+        # the dead batch row's q/k/v receive exactly zero gradient
+        for a in gp:
+            np.testing.assert_allclose(np.asarray(a[2]), 0.0, atol=0.0)
 
     @pytest.mark.parametrize("causal", [False, True])
     def test_bf16_fwd_bwd_close_to_fp32_ref(self, rng, causal):
