@@ -51,7 +51,12 @@ def causal_mask(sq: int, sk: int):
 
 
 def _attn_ref(q, k, v, scale, causal, mask=None):
-    """Plain XLA attention; q,k,v: (B, H, S, D)."""
+    """Plain XLA attention; q: (B, H, S, D); k/v: (B, H_kv, S, D) with
+    H % H_kv == 0 (GQA: each kv head serves H/H_kv query heads)."""
+    h, h_kv = q.shape[1], k.shape[1]
+    if h_kv != h:
+        k = jnp.repeat(k, h // h_kv, axis=1)
+        v = jnp.repeat(v, h // h_kv, axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * scale
     if causal:
@@ -126,15 +131,24 @@ def _kpm_spec(heads, sk):
     return pl.BlockSpec((1, sk), lambda b_h, i, heads=heads: (b_h // heads, 0))
 
 
-def _flash_fwd(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
+def _kv_spec(group, sk, d):
+    """K/V block for GQA: q-head row bh maps to kv row bh // group (group =
+    h // h_kv, static). group == 1 recovers plain MHA indexing."""
+    return pl.BlockSpec(
+        (1, sk, d), lambda b_h, i, group=group: (b_h // group, 0, 0)
+    )
+
+
+def _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
+    k3, v3 = kv3
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     grid = (bh, sq // bq)
     has_kpm = kpm is not None
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        _kv_spec(group, sk, d),
+        _kv_spec(group, sk, d),
     ]
     inputs = [q3, k3, v3]
     if has_kpm:
@@ -162,15 +176,15 @@ def _flash_fwd(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
     return o, lse.reshape(bh, sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
-def _flash(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
-    o, _ = _flash_fwd_res(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
+    o, _ = _flash_fwd_res(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk)
     return o
 
 
-def _flash_fwd_res(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk):
-    o, lse = _flash_fwd(q3, k3, v3, kpm, heads, scale, causal, interpret, bq, bk)
-    return o, (q3, k3, v3, kpm, o, lse)
+def _flash_fwd_res(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
+    o, lse = _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk)
+    return o, (q3, kv3, kpm, o, lse)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -263,11 +277,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(heads, scale, causal, interpret, bq, bk, res, do):
+def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, res, do):
     """Pallas flash backward: recompute p from the saved logsumexp per
     block pair — O(seq x block) memory like the forward, never the full
-    (sq, sk) score matrix (previously an XLA einsum chain)."""
-    q3, k3, v3, kpm, o, lse = res
+    (sq, sk) score matrix (previously an XLA einsum chain).
+
+    GQA (group > 1): both kernels run per Q head with grouped K/V indexing;
+    dk/dv come out as per-q-head partials and are group-summed afterwards."""
+    q3, (k3, v3), kpm, o, lse = res
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     has_kpm = kpm is not None
@@ -277,7 +294,7 @@ def _flash_bwd(heads, scale, causal, interpret, bq, bk, res, do):
     delta3 = delta.reshape(bh, 1, sq)
 
     full_q = pl.BlockSpec((1, sq, d), lambda b, i: (b, 0, 0))
-    full_k = pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0))
+    full_k = _kv_spec(group, sk, d)
     row_q = pl.BlockSpec((1, 1, sq), lambda b, i: (b, 0, 0))
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),  # q block
@@ -304,15 +321,19 @@ def _flash_bwd(heads, scale, causal, interpret, bq, bk, res, do):
 
     in_specs_kv = [
         full_q,                                            # q resident
-        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # k block
-        pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),  # v block
+        pl.BlockSpec((1, bk, d),                           # k block (grouped)
+                     lambda b, j, g=group: (b // g, j, 0)),
+        pl.BlockSpec((1, bk, d),
+                     lambda b, j, g=group: (b // g, j, 0)),
         full_q,                                            # do resident
         row_q,                                             # lse full row
         row_q,                                             # delta full row
     ]
     if has_kpm:
         in_specs_kv.append(_kpm_spec(heads, sk))
-    dk, dv = pl.pallas_call(
+    # per-Q-HEAD partials: grid still runs over all bh q-head rows, so two
+    # q heads sharing a kv head never race on one output block
+    dk_p, dv_p = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
             has_kpm=has_kpm,
@@ -329,8 +350,15 @@ def _flash_bwd(heads, scale, causal, interpret, bq, bk, res, do):
         ),
         interpret=interpret,
     )(*inputs)
+    if group > 1:
+        # q-head row r = b*heads + kv*group + j  ->  sum over j
+        bhkv = bh // group
+        dk = dk_p.reshape(bhkv, group, sk, d).sum(axis=1).astype(k3.dtype)
+        dv = dv_p.reshape(bhkv, group, sk, d).sum(axis=1).astype(v3.dtype)
+    else:
+        dk, dv = dk_p, dv_p
     # kpm is an int mask: no cotangent (None == symbolic zero)
-    return dq, dk, dv, None
+    return dq, (dk, dv), None
 
 
 _flash.defvjp(_flash_fwd_res, _flash_bwd)
@@ -356,9 +384,17 @@ def flash_attention(
     (True = masked out, broadcastable to (b, h, sq, sk)) forces the XLA
     path; the Pallas kernel covers the unmasked / causal / key-padded fast
     paths that the reference's fmha/fast_multihead_attn accelerate.
+
+    GQA: k/v may carry ``h_kv`` heads with ``h % h_kv == 0`` — query head
+    ``g * (h // h_kv) + j`` attends through kv head ``g`` (consecutive
+    grouping, the llama convention). The kernels index K/V by
+    ``q_head // group`` so no materialized head broadcast is needed.
     """
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    h_kv, sk = k.shape[1], k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"q heads ({h}) not a multiple of kv heads ({h_kv})")
+    group = h // h_kv
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     use_pallas, interpret = resolve_impl(impl)
@@ -382,12 +418,12 @@ def flash_attention(
             return jnp.where(dead, jnp.zeros((), out.dtype), out)
         return _attn_ref(q, k, v, scale, causal, mask)
     q3 = q.reshape(b * h, sq, d)
-    k3 = k.reshape(b * h, sk, d)
-    v3 = v.reshape(b * h, sk, d)
+    k3 = k.reshape(b * h_kv, sk, d)
+    v3 = v.reshape(b * h_kv, sk, d)
     kpm = (
         None
         if key_padding_mask is None
         else key_padding_mask.astype(jnp.int32)  # (b, sk), 1 = padded
     )
-    o = _flash(q3, k3, v3, kpm, h, scale, causal, interpret, bq, bk)
+    o = _flash(q3, (k3, v3), kpm, h, group, scale, causal, interpret, bq, bk)
     return o.reshape(b, h, sq, d)

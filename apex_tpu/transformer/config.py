@@ -32,6 +32,10 @@ class TransformerConfig:
     max_position_embeddings: int = 0
     ffn_hidden_size: Optional[int] = None  # defaults to 4*hidden_size
     kv_channels: Optional[int] = None  # defaults to hidden_size // heads
+    # GQA (extension; absent in the reference): number of KV heads. None =
+    # MHA. Must divide num_attention_heads; with tp>1 must also divide by
+    # tp (KV heads are tensor-sharded like Q heads).
+    num_query_groups: Optional[int] = None
 
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1

@@ -235,7 +235,41 @@ class ParallelAttention(nn.Module):
         np_local = cfg.num_attention_heads // tp
         hn = cfg.kv_channels
 
-        if self.attn_type == AttnType.self_attn:
+        groups = cfg.num_query_groups or cfg.num_attention_heads
+        if groups != cfg.num_attention_heads and self.attn_type != AttnType.self_attn:
+            raise NotImplementedError("GQA is a self-attention feature")
+        if cfg.num_attention_heads % groups != 0 or groups % tp != 0:
+            raise ValueError(
+                f"num_query_groups ({groups}) must divide "
+                f"num_attention_heads ({cfg.num_attention_heads}) and be "
+                f"divisible by tp ({tp})"
+            )
+        g_local = groups // tp
+
+        if self.attn_type == AttnType.self_attn and groups != cfg.num_attention_heads:
+            # GQA: separate Q and fused-KV projections (llama convention,
+            # consecutive grouping — matches ops.flash_attention's
+            # q_head // group kv indexing)
+            q = ColumnParallelLinear(
+                output_size=cfg.num_attention_heads * hn,
+                gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                name="query",
+            )(hidden_states)
+            kv = ColumnParallelLinear(
+                output_size=2 * groups * hn,
+                gather_output=False,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                axis_name=cfg.tensor_axis,
+                params_dtype=cfg.params_dtype,
+                name="key_value",
+            )(hidden_states)
+            q = q.reshape(q.shape[0], b, np_local, hn)
+            kv = kv.reshape(kv.shape[0], b, g_local, 2 * hn)
+            k, v = jnp.split(kv, 2, axis=-1)  # (s, b, g_local, hn)
+        elif self.attn_type == AttnType.self_attn:
             qkv = ColumnParallelLinear(
                 output_size=3 * cfg.num_attention_heads * hn,
                 gather_output=False,
@@ -346,6 +380,10 @@ class ParallelAttention(nn.Module):
                 impl=cfg.attention_impl,
             )
         else:
+            if kb.shape[1] != qb.shape[1]:  # GQA through the unfused path
+                rep = qb.shape[1] // kb.shape[1]
+                kb = jnp.repeat(kb, rep, axis=1)
+                vb = jnp.repeat(vb, rep, axis=1)
             ctx = CoreAttention(
                 config=cfg, attn_mask_type=self.attn_mask_type, name="core_attention"
             )(qb, kb, vb, attention_mask, deterministic=deterministic)

@@ -200,6 +200,45 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("h_kv", [1, 2])
+    def test_gqa_matches_broadcast_reference(self, rng, causal, h_kv):
+        """Grouped-query attention: kv with h_kv heads through the Pallas
+        kernels must equal full attention over explicitly repeated kv heads
+        (consecutive llama grouping), fwd and all grads — including the
+        group-sum of the per-q-head dk/dv partials."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        h, sq, d = 4, 128, 64
+        q = jax.random.normal(k1, (2, h, sq, d))
+        k = jax.random.normal(k2, (2, h_kv, sq, d))
+        v = jax.random.normal(k3, (2, h_kv, sq, d))
+        ct = jax.random.normal(k4, (2, h, sq, d))
+        group = h // h_kv
+        k_rep = jnp.repeat(k, group, axis=1)
+        v_rep = jnp.repeat(v, group, axis=1)
+
+        out = flash_attention(q, k, v, causal=causal, impl="pallas")
+        ref = flash_attention(q, k_rep, v_rep, causal=causal, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        def loss(impl, grouped):
+            def f(q, k, v):
+                o = flash_attention(q, k, v, causal=causal, impl=impl)
+                return jnp.sum(o * ct)
+
+            return f
+
+        gq, gk, gv = jax.grad(loss("pallas", True), (0, 1, 2))(q, k, v)
+        rq, rk_rep, rv_rep = jax.grad(loss("xla", False), (0, 1, 2))(
+            q, k_rep, v_rep
+        )
+        # repeated-kv reference grads sum over each group
+        rk = rk_rep.reshape(2, h_kv, group, sq, d).sum(axis=2)
+        rv = rv_rep.reshape(2, h_kv, group, sq, d).sum(axis=2)
+        np.testing.assert_allclose(np.asarray(gq), np.asarray(rq), atol=5e-5)
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(rk), atol=5e-5)
+        np.testing.assert_allclose(np.asarray(gv), np.asarray(rv), atol=5e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
     def test_key_padding_mask_matches_xla(self, rng, causal):
         """Pallas fast path with (b, sk) key padding — the reference fmha's
         variable-seqlen capability. One batch row is fully padded to pin the

@@ -206,6 +206,15 @@ class TestGPTTensorParallel:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] - 0.2, losses
 
+    def test_tp8_gqa_loss_decreases(self, rng):
+        # REAL GQA under tensor parallelism (groups < heads): 16 q heads
+        # share 8 kv heads; over tp=8 each rank holds 2 q heads + 1 kv head
+        losses = self._train_losses(
+            tiny_cfg(num_attention_heads=16, num_query_groups=8), rng
+        )
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] - 0.2, losses
+
     def test_tp8_sequence_parallel_loss_decreases(self, rng):
         losses = self._train_losses(tiny_cfg(sequence_parallel=True), rng)
         assert np.isfinite(losses).all()
