@@ -136,3 +136,116 @@ def gpt2_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
 
     cfg = config_from_hf_gpt2(hf_model.config, **config_overrides)
     return GPTModel(config=cfg), {"params": params_from_hf_gpt2(hf_model)}
+
+
+# ---------------------------------------------------------------------------
+# Llama family
+# ---------------------------------------------------------------------------
+
+
+def config_from_hf_llama(hf_config, **overrides):
+    """TransformerConfig matching a transformers.LlamaConfig.
+
+    Llama == GPTModel with rmsnorm + rotate-half RoPE (same convention as
+    ops/rope.py, so weights map with NO head permutation) + SwiGLU +
+    bias-free linears + GQA + untied output head.
+    """
+    from apex_tpu.transformer import TransformerConfig
+
+    kw = dict(
+        num_layers=hf_config.num_hidden_layers,
+        hidden_size=hf_config.hidden_size,
+        num_attention_heads=hf_config.num_attention_heads,
+        num_query_groups=hf_config.num_key_value_heads,
+        ffn_hidden_size=hf_config.intermediate_size,
+        vocab_size=hf_config.vocab_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        layernorm_epsilon=hf_config.rms_norm_eps,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        normalization="rmsnorm",
+        activation="swiglu",
+        add_bias_linear=False,
+        position_embedding_type="rope",
+        rotary_base=getattr(hf_config, "rope_theta", 10000.0),
+        share_embeddings_and_output_weights=bool(
+            getattr(hf_config, "tie_word_embeddings", False)
+        ),
+        apply_query_key_layer_scaling=False,
+        compute_dtype=jnp.float32,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def params_from_hf_llama(hf_model) -> Dict[str, Any]:
+    """Map a transformers LlamaForCausalLM onto GPTModel's param tree.
+
+    Packing transforms (torch Linear stores (out, in); ours store (in, out)):
+    - k_proj/v_proj -> one fused ``key_value`` kernel packed per kv group as
+      [k_g | v_g] (the (s,b,g,2*hn) split in ParallelAttention);
+    - gate_proj/up_proj -> one ``dense_h_to_4h`` kernel packed [gate | up]
+      (_activate's swiglu split);
+    - rotate-half RoPE matches ops/rope.py directly — no qk permutation.
+    """
+    sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = hf_model.config
+    heads, g = cfg.num_attention_heads, cfg.num_key_value_heads
+    hn = cfg.hidden_size // heads
+
+    def g_(name):
+        return sd["model." + name]
+
+    def lin(w):  # (out, in) -> (in, out)
+        return jnp.asarray(np.ascontiguousarray(w.T))
+
+    params: Dict[str, Any] = {
+        "embedding": {
+            "word_embeddings": {"embedding": jnp.asarray(g_("embed_tokens.weight"))},
+        },
+        "transformer": {
+            "final_layernorm": {"scale": jnp.asarray(g_("norm.weight"))},
+        },
+    }
+    if not getattr(cfg, "tie_word_embeddings", False):
+        params["output_layer"] = {"kernel": lin(sd["lm_head.weight"])}
+    for i in range(cfg.num_hidden_layers):
+        L = f"layers.{i}."
+        wk = g_(L + "self_attn.k_proj.weight").T  # (h, g*hn)
+        wv = g_(L + "self_attn.v_proj.weight").T
+        kv = np.stack(
+            [wk.reshape(-1, g, hn), wv.reshape(-1, g, hn)], axis=2
+        ).reshape(-1, 2 * g * hn)  # per-group [k_g | v_g]
+        params["transformer"][f"layer_{i}"] = {
+            "input_layernorm": {
+                "scale": jnp.asarray(g_(L + "input_layernorm.weight")),
+            },
+            "post_attention_layernorm": {
+                "scale": jnp.asarray(g_(L + "post_attention_layernorm.weight")),
+            },
+            "self_attention": {
+                "query": {"kernel": lin(g_(L + "self_attn.q_proj.weight"))},
+                "key_value": {"kernel": jnp.asarray(np.ascontiguousarray(kv))},
+                "dense": {"kernel": lin(g_(L + "self_attn.o_proj.weight"))},
+            },
+            "mlp": {
+                "dense_h_to_4h": {
+                    "kernel": jnp.concatenate(
+                        [lin(g_(L + "mlp.gate_proj.weight")),
+                         lin(g_(L + "mlp.up_proj.weight"))], axis=1
+                    )
+                },
+                "dense_4h_to_h": {
+                    "kernel": lin(g_(L + "mlp.down_proj.weight")),
+                },
+            },
+        }
+    return params
+
+
+def llama_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
+    """(GPTModel, params) functionally equal to the given HF Llama."""
+    from apex_tpu.models import GPTModel
+
+    cfg = config_from_hf_llama(hf_model.config, **config_overrides)
+    return GPTModel(config=cfg), {"params": params_from_hf_llama(hf_model)}

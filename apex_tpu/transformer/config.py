@@ -41,6 +41,9 @@ class TransformerConfig:
     attention_dropout: float = 0.1
     layernorm_epsilon: float = 1e-5
     normalization: str = "layernorm"  # "layernorm" | "rmsnorm"
+    # Megatron --disable-bias-linear: bias-free attention/MLP projections
+    # (llama-family models). LayerNorm/RMSNorm params are unaffected.
+    add_bias_linear: bool = True
     activation: str = "gelu"  # "gelu" | "geglu" | "relu" | "swiglu"
     apply_residual_connection_post_layernorm: bool = False
     fp32_residual_connection: bool = False
@@ -52,6 +55,7 @@ class TransformerConfig:
 
     position_embedding_type: str = "learned"  # "learned" | "rope" | "none"
     rotary_percent: float = 1.0
+    rotary_base: float = 10000.0  # RoPE theta (llama-3 uses 500000)
 
     # parallelism
     sequence_parallel: bool = False

@@ -105,6 +105,7 @@ class ParallelMLP(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             axis_name=cfg.tensor_axis,
             params_dtype=cfg.params_dtype,
+            use_bias=cfg.add_bias_linear,
             name="dense_h_to_4h",
         )(hidden_states)
         h = _activate(h, cfg.activation)
@@ -114,6 +115,7 @@ class ParallelMLP(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             axis_name=cfg.tensor_axis,
             params_dtype=cfg.params_dtype,
+            use_bias=cfg.add_bias_linear,
             name="dense_4h_to_h",
         )(h)
 
@@ -256,6 +258,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
+                use_bias=cfg.add_bias_linear,
                 name="query",
             )(hidden_states)
             kv = ColumnParallelLinear(
@@ -264,6 +267,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
+                use_bias=cfg.add_bias_linear,
                 name="key_value",
             )(hidden_states)
             q = q.reshape(q.shape[0], b, np_local, hn)
@@ -276,6 +280,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
+                use_bias=cfg.add_bias_linear,
                 name="query_key_value",
             )(hidden_states)
             sq = qkv.shape[0]
@@ -288,6 +293,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
+                use_bias=cfg.add_bias_linear,
                 name="query",
             )(hidden_states)
             kv = ColumnParallelLinear(
@@ -298,6 +304,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 axis_name=cfg.tensor_axis,
                 params_dtype=cfg.params_dtype,
+                use_bias=cfg.add_bias_linear,
                 name="key_value",
             )(encoder_output)
             q = q.reshape(q.shape[0], b, np_local, hn)
@@ -396,6 +403,7 @@ class ParallelAttention(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             axis_name=cfg.tensor_axis,
             params_dtype=cfg.params_dtype,
+            use_bias=cfg.add_bias_linear,
             name="dense",
         )(ctx)
         return out
@@ -559,5 +567,5 @@ class ParallelTransformer(nn.Module):
 def rotary_embedding_for(config: TransformerConfig, seq_len: int, dtype=jnp.float32):
     """Precompute (q_freqs, k_freqs) for ParallelAttention's rotary path."""
     rot_dim = int(config.kv_channels * config.rotary_percent)
-    f = rope_frequencies(rot_dim, seq_len, dtype=dtype)
+    f = rope_frequencies(rot_dim, seq_len, base=config.rotary_base, dtype=dtype)
     return f, f

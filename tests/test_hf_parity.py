@@ -70,6 +70,43 @@ def test_loss_matches(hf_model):
     np.testing.assert_allclose(ours, ref_loss, rtol=1e-4)
 
 
+@pytest.fixture(scope="module")
+def hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=48,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,  # real GQA
+        max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def test_llama_logits_match(hf_llama):
+    """Llama family: rmsnorm + rotate-half RoPE + SwiGLU + GQA + no-bias
+    linears + untied head, mapped onto GPTModel — logits equal to fp32
+    rounding against the HF implementation."""
+    from apex_tpu.models.hf_import import llama_from_hf
+
+    model, variables = llama_from_hf(hf_llama)
+    rng = np.random.RandomState(2)
+    tokens = rng.randint(0, 128, size=(2, 32))
+
+    with torch.no_grad():
+        ref = hf_llama(torch.from_numpy(tokens)).logits.numpy()
+
+    logits = model.apply(variables, jnp.asarray(tokens))  # (b, s, v)
+    np.testing.assert_allclose(np.asarray(logits, np.float32), ref, atol=3e-5)
+
+
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
 
