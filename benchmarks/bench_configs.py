@@ -12,6 +12,8 @@ is the headline `bench.py`. This file makes the other four measurable:
               sequences/sec.
 5. ``gpt``   — GPT via the parallel transformer layer, tensor-parallel
               mesh (tp=8 on a pod slice; tp=2 CPU smoke), tokens/sec.
++. ``llama`` — extension: llama-family (RMSNorm/RoPE/SwiGLU/GQA/no-bias)
+              training step, tokens/sec.
 
 Each config prints one JSON line {config, metric, value, unit, platform}.
 Sizes scale down automatically off-TPU so the harness is runnable (and
@@ -290,11 +292,68 @@ def bench_gpt_tp(tpu, force_tp=None):
             "tp": tp}
 
 
+def bench_llama(tpu):
+    """Extension config (beyond BASELINE 1-5): llama-family training step —
+    RMSNorm + rotate-half RoPE + SwiGLU + GQA + bias-free linears, the
+    modern-architecture path the GQA/flash kernels exist for."""
+    import jax.numpy as jnp
+    import optax
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.transformer import TransformerConfig
+
+    common = dict(
+        hidden_dropout=0.0, attention_dropout=0.0,
+        normalization="rmsnorm", activation="swiglu",
+        add_bias_linear=False, position_embedding_type="rope",
+        share_embeddings_and_output_weights=False,
+    )
+    if tpu:
+        cfg = TransformerConfig(
+            num_layers=16, hidden_size=1024, num_attention_heads=16,
+            num_query_groups=4, ffn_hidden_size=2816, vocab_size=32000,
+            max_position_embeddings=1024, compute_dtype=jnp.bfloat16,
+            **common,
+        )  # ~llama-ish 250M
+        batch, seq = 8, 1024
+    else:
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_query_groups=2, ffn_hidden_size=160, vocab_size=512,
+            max_position_embeddings=64, compute_dtype=jnp.float32,
+            **common,
+        )
+        batch, seq = 2, 32
+    model = GPTModel(config=cfg)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=1)
+    params = jax.jit(model.init)(key, tokens, labels=labels)
+    opt = fused_adam(lr=1e-4)
+
+    def step(carry, tokens, labels):
+        params, opt_state = carry
+
+        def loss_fn(p):
+            return jnp.mean(model.apply(p, tokens, labels=labels))
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state)
+
+    sps = _timed_steps(step, (params, opt.init(params)),
+                       lambda i: (tokens, labels))
+    return {"config": "llama_gqa", "metric": "tokens_per_sec",
+            "value": round(sps * batch * seq, 2), "unit": "tokens/sec"}
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "dp": bench_dp_syncbn,
     "bert": bench_bert,
     "gpt": bench_gpt_tp,
+    "llama": bench_llama,
 }
 
 

@@ -107,7 +107,7 @@ def run_configs():
     import bench_configs as bc
 
     out = {}
-    for name in ("mlp", "bert", "dp", "gpt"):
+    for name in ("mlp", "bert", "dp", "gpt", "llama"):
         t0 = time.time()
         out[name] = bc.CONFIGS[name](tpu=True)
         out[name]["elapsed_s"] = round(time.time() - t0, 1)
