@@ -36,11 +36,20 @@ def _causal_hi(qi, bq: int, bk: int, num_kv):
     return jnp.minimum(jax.lax.div((qi + 1) * bq + bk - 1, bk), num_kv)
 
 
-def _causal_keep(qi, kj, bq: int, bk: int):
-    """(bq, bk) keep-mask (True = attend) for block pair (qi, kj)."""
+def _causal_keep(qi, kj, bq: int, bk: int, window=None):
+    """(bq, bk) keep-mask (True = attend) for block pair (qi, kj); with a
+    sliding ``window`` W, each row attends to cols in (row - W, row]."""
     row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return col <= row
+    keep = col <= row
+    if window is not None:
+        keep = jnp.logical_and(keep, col > row - window)
+    return keep
+
+
+def _window_lo(qi, bq: int, bk: int, window):
+    """First kv block (inclusive) a windowed-causal q block touches."""
+    return jnp.maximum(0, jax.lax.div(qi * bq - window + 1, bk))
 
 
 def causal_mask(sq: int, sk: int):
@@ -50,7 +59,7 @@ def causal_mask(sq: int, sk: int):
     return jnp.arange(sk)[None, :] > jnp.arange(sq)[:, None] + (sk - sq)
 
 
-def _attn_ref(q, k, v, scale, causal, mask=None):
+def _attn_ref(q, k, v, scale, causal, mask=None, window=None):
     """Plain XLA attention; q: (B, H, S, D); k/v: (B, H_kv, S, D) with
     H % H_kv == 0 (GQA: each kv head serves H/H_kv query heads)."""
     h, h_kv = q.shape[1], k.shape[1]
@@ -61,6 +70,13 @@ def _attn_ref(q, k, v, scale, causal, mask=None):
     s = s * scale
     if causal:
         s = jnp.where(causal_mask(s.shape[-2], s.shape[-1]), _NEG_INF, s)
+    if window is not None:
+        sq_, sk_ = s.shape[-2], s.shape[-1]
+        out_of_window = (
+            jnp.arange(sk_)[None, :]
+            <= jnp.arange(sq_)[:, None] + (sk_ - sq_) - window
+        )
+        s = jnp.where(out_of_window, _NEG_INF, s)
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
@@ -68,7 +84,7 @@ def _attn_ref(q, k, v, scale, causal, mask=None):
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
-                      has_kpm):
+                      has_kpm, window=None):
     # dot operands KEEP the input dtype (bf16 stays bf16) with fp32
     # accumulation via preferred_element_type — upcasting operands to fp32
     # before the dot forces the MXU's slow fp32 path and was the dominant
@@ -80,6 +96,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
     qi = pl.program_id(1)
     num_kv = seq_k // bk
     hi = _causal_hi(qi, bq, bk, num_kv) if causal else num_kv
+    lo = _window_lo(qi, bq, bk, window) if window is not None else 0
 
     # the m/l running stats are carried (bq, 1) 2-D, not (bq,): Mosaic
     # tiles the last two dims and 1-D loop carries are the classic
@@ -92,7 +109,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
             q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (BQ, BK), fp32
         if causal:
-            s = jnp.where(_causal_keep(qi, j, bq, bk), s, _NEG_INF)
+            s = jnp.where(_causal_keep(qi, j, bq, bk, window), s, _NEG_INF)
         if has_kpm:
             s = jnp.where(kpm_ref[:, pl.ds(j * bk, bk)] == 0, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -111,7 +128,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
         jnp.full((bq, 1), _NEG_INF, jnp.float32),
         jnp.zeros((bq, 1), jnp.float32),
     )
-    acc, m, l = jax.lax.fori_loop(0, hi, body, init)
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, init)
     # fully-masked rows (every key padded): the finite -1e30 mask means the
     # loop accumulated a spurious uniform softmax (p = exp(0) = 1 per key).
     # Emit ZEROS and a +1e30 lse sentinel instead: output-zero rows make the
@@ -139,7 +156,7 @@ def _kv_spec(group, sk, d):
     )
 
 
-def _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
+def _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk, window):
     k3, v3 = kv3
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -157,7 +174,7 @@ def _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
     o, lse = pl.pallas_call(
         functools.partial(
             _flash_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-            has_kpm=has_kpm,
+            has_kpm=has_kpm, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
@@ -176,19 +193,23 @@ def _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
     return o, lse.reshape(bh, sq)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
-    o, _ = _flash_fwd_res(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+def _flash(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk, window):
+    o, _ = _flash_fwd_res(
+        q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk, window
+    )
     return o
 
 
-def _flash_fwd_res(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk):
-    o, lse = _flash_fwd(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk)
+def _flash_fwd_res(q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk, window):
+    o, lse = _flash_fwd(
+        q3, kv3, kpm, heads, group, scale, causal, interpret, bq, bk, window
+    )
     return o, (q3, kv3, kpm, o, lse)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         *refs, scale, causal, bq, bk, has_kpm):
+                         *refs, scale, causal, bq, bk, has_kpm, window=None):
     """dq for one q block: loop over participating kv blocks (the exact
     recompute-from-lse strategy of the standard flash backward)."""
     kpm_ref = refs[0] if has_kpm else None
@@ -201,6 +222,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     seq_k = k_ref.shape[1]
     num_kv = seq_k // bk
     hi = _causal_hi(qi, bq, bk, num_kv) if causal else num_kv
+    lo = _window_lo(qi, bq, bk, window) if window is not None else 0
 
     def body(j, acc):
         # operands keep the input dtype; fp32 accumulation (see fwd kernel)
@@ -211,7 +233,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         p = jnp.exp(s - lse[:, None])
         if causal:
-            p = jnp.where(_causal_keep(qi, j, bq, bk), p, 0.0)
+            p = jnp.where(_causal_keep(qi, j, bq, bk, window), p, 0.0)
         if has_kpm:
             p = jnp.where(kpm_ref[:, pl.ds(j * bk, bk)] == 0, p, 0.0)
         dp = jax.lax.dot_general(
@@ -224,12 +246,12 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
 
     d = q_ref.shape[2]
-    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(lo, hi, body, jnp.zeros((bq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          *refs, scale, causal, bq, bk, has_kpm):
+                          *refs, scale, causal, bq, bk, has_kpm, window=None):
     """dk/dv for one kv block: loop over participating q blocks."""
     kpm_ref = refs[0] if has_kpm else None
     dk_ref, dv_ref = refs[-2:]
@@ -239,6 +261,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     seq_q = q_ref.shape[1]
     num_q = seq_q // bq
     lo = jax.lax.div(kj * bk, bq) if causal else 0
+    # windowed: rows beyond col_max + window - 1 see none of this kv block
+    hi_q = (
+        jnp.minimum(num_q, jax.lax.div(kj * bk + bk + window - 2, bq) + 1)
+        if window is not None
+        else num_q
+    )
 
     def body(i, carry):
         # operands keep the input dtype; fp32 accumulation (see fwd kernel)
@@ -252,7 +280,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         p = jnp.exp(s - lse_b[:, None])
         if causal:
-            p = jnp.where(_causal_keep(i, kj, bq, bk), p, 0.0)
+            p = jnp.where(_causal_keep(i, kj, bq, bk, window), p, 0.0)
         if has_kpm:
             # this kv block's slice of the padding row: keys of THIS block
             p = jnp.where(kpm_ref[:, pl.ds(kj * bk, bk)] == 0, p, 0.0)
@@ -272,12 +300,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     d = q_ref.shape[2]
     init = (jnp.zeros((bk, d), jnp.float32), jnp.zeros((bk, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(lo, num_q, body, init)
+    dk, dv = jax.lax.fori_loop(lo, hi_q, body, init)
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, res, do):
+def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, window, res, do):
     """Pallas flash backward: recompute p from the saved logsumexp per
     block pair — O(seq x block) memory like the forward, never the full
     (sq, sk) score matrix (previously an XLA einsum chain).
@@ -310,7 +338,7 @@ def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, res, do):
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-            has_kpm=has_kpm,
+            has_kpm=has_kpm, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         grid=(bh, sq // bq),
@@ -336,7 +364,7 @@ def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, res, do):
     dk_p, dv_p = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-            has_kpm=has_kpm,
+            has_kpm=has_kpm, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
@@ -372,6 +400,7 @@ def flash_attention(
     scale: float = None,
     mask=None,
     key_padding_mask=None,
+    window: int = None,
     impl: str = "auto",
     block_q: int = 128,
     block_k: int = 128,
@@ -385,6 +414,11 @@ def flash_attention(
     path; the Pallas kernel covers the unmasked / causal / key-padded fast
     paths that the reference's fmha/fast_multihead_attn accelerate.
 
+    ``window`` (sliding-window attention, mistral-style; requires
+    ``causal=True``): each query attends only to the last ``window`` keys.
+    The kernels skip kv/q blocks fully outside the band, so compute scales
+    O(seq * window) instead of O(seq^2).
+
     GQA: k/v may carry ``h_kv`` heads with ``h % h_kv == 0`` — query head
     ``g * (h // h_kv) + j`` attends through kv head ``g`` (consecutive
     grouping, the llama convention). The kernels index K/V by
@@ -395,6 +429,11 @@ def flash_attention(
     if h % h_kv != 0:
         raise ValueError(f"q heads ({h}) not a multiple of kv heads ({h_kv})")
     group = h // h_kv
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (mistral semantics)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     use_pallas, interpret = resolve_impl(impl)
@@ -411,12 +450,12 @@ def flash_attention(
         if key_padding_mask is not None:
             kp = key_padding_mask[:, None, None, :]  # (b, 1, 1, sk)
             mask = kp if mask is None else jnp.logical_or(mask, kp)
-            out = _attn_ref(q, k, v, scale, causal, mask)
+            out = _attn_ref(q, k, v, scale, causal, mask, window)
             # fully-padded rows are zero (not uniform-softmax leakage) in
             # the Pallas kernel; match exactly here
             dead = jnp.all(key_padding_mask, axis=-1)[:, None, None, None]
             return jnp.where(dead, jnp.zeros((), out.dtype), out)
-        return _attn_ref(q, k, v, scale, causal, mask)
+        return _attn_ref(q, k, v, scale, causal, mask, window)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h_kv, sk, d)
     v3 = v.reshape(b * h_kv, sk, d)
@@ -425,5 +464,7 @@ def flash_attention(
         if key_padding_mask is None
         else key_padding_mask.astype(jnp.int32)  # (b, sk), 1 = padded
     )
-    o = _flash(q3, (k3, v3), kpm, h, group, scale, causal, interpret, bq, bk)
+    o = _flash(
+        q3, (k3, v3), kpm, h, group, scale, causal, interpret, bq, bk, window
+    )
     return o.reshape(b, h, sq, d)

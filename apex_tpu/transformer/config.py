@@ -36,6 +36,9 @@ class TransformerConfig:
     # MHA. Must divide num_attention_heads; with tp>1 must also divide by
     # tp (KV heads are tensor-sharded like Q heads).
     num_query_groups: Optional[int] = None
+    # sliding-window attention (extension; mistral-style). None = full
+    # causal. Applied only to causal self-attention.
+    attention_window: Optional[int] = None
 
     hidden_dropout: float = 0.1
     attention_dropout: float = 0.1

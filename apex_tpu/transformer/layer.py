@@ -355,11 +355,12 @@ class ParallelAttention(nn.Module):
             )
             key_padding_mask = None
         if cp > 1:
-            if not use_flash or key_padding_mask is not None:
+            if (not use_flash or key_padding_mask is not None
+                    or cfg.attention_window is not None):
                 raise NotImplementedError(
                     "context parallelism supports causal/unmasked attention "
-                    "without dropout or padding masks (like the reference's "
-                    "fused paths)"
+                    "without dropout, padding masks, or sliding windows "
+                    "(like the reference's fused paths)"
                 )
             from apex_tpu.parallel.ring_attention import (
                 ring_attention,
@@ -384,6 +385,7 @@ class ParallelAttention(nn.Module):
         elif use_flash:
             ctx = flash_attention(
                 qb, kb, vb, causal=causal, key_padding_mask=key_padding_mask,
+                window=cfg.attention_window if causal else None,
                 impl=cfg.attention_impl,
             )
         else:
@@ -391,6 +393,18 @@ class ParallelAttention(nn.Module):
                 rep = qb.shape[1] // kb.shape[1]
                 kb = jnp.repeat(kb, rep, axis=1)
                 vb = jnp.repeat(vb, rep, axis=1)
+            if cfg.attention_window is not None and causal:
+                # fold the band's lower edge into the dense mask; the causal
+                # upper edge stays with CoreAttention's own mask handling
+                sq_, sk_ = qb.shape[2], kb.shape[2]
+                below = (
+                    jnp.arange(sk_)[None, :]
+                    <= jnp.arange(sq_)[:, None] + (sk_ - sq_) - cfg.attention_window
+                )[None, None]
+                attention_mask = (
+                    below if attention_mask is None
+                    else jnp.logical_or(attention_mask, below)
+                )
             ctx = CoreAttention(
                 config=cfg, attn_mask_type=self.attn_mask_type, name="core_attention"
             )(qb, kb, vb, attention_mask, deterministic=deterministic)

@@ -199,6 +199,44 @@ class TestFlashAttention:
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
 
+    @pytest.mark.parametrize("window", [1, 64, 200, 1000])
+    def test_sliding_window_matches_dense_mask(self, rng, window):
+        """Windowed-causal (mistral) vs an explicit band mask through the
+        dense reference — windows below, straddling, and beyond the 128
+        block size, plus the degenerate window=1 (self-only) and a window
+        larger than the sequence (== plain causal)."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        shape = (2, 2, 256, 64)
+        q = jax.random.normal(k1, shape)
+        k = jax.random.normal(k2, shape)
+        v = jax.random.normal(k3, shape)
+        ct = jax.random.normal(k4, shape)
+
+        sq = shape[2]
+        rows = jnp.arange(sq)[:, None]
+        cols = jnp.arange(sq)[None, :]
+        band = jnp.logical_or(cols > rows, cols <= rows - window)
+
+        out = flash_attention(q, k, v, causal=True, window=window, impl="pallas")
+        ref = flash_attention(q, k, v, mask=band[None, None], impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        gp = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, window=window,
+                                impl="pallas") * ct
+            ),
+            (0, 1, 2),
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, mask=band[None, None], impl="xla") * ct
+            ),
+            (0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("h_kv", [1, 2])
     def test_gqa_matches_broadcast_reference(self, rng, causal, h_kv):
