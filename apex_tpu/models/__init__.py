@@ -2,7 +2,11 @@
 examples/imagenet) re-built TPU-native on the apex_tpu transformer stack."""
 
 from apex_tpu.models.gpt import GPTModel, gpt_loss_fn
-from apex_tpu.models.hf_import import gpt2_from_hf, llama_from_hf
+from apex_tpu.models.hf_import import (
+    gpt2_from_hf,
+    llama_from_hf,
+    mistral_from_hf,
+)
 from apex_tpu.models.bert import BertModel
 from apex_tpu.models.resnet import (
     ResNet,
@@ -18,6 +22,7 @@ __all__ = [
     "GPTModel",
     "gpt2_from_hf",
     "llama_from_hf",
+    "mistral_from_hf",
     "BertModel",
     "gpt_loss_fn",
     "ResNet",

@@ -244,8 +244,17 @@ def params_from_hf_llama(hf_model) -> Dict[str, Any]:
 
 
 def llama_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
-    """(GPTModel, params) functionally equal to the given HF Llama."""
+    """(GPTModel, params) functionally equal to the given HF Llama — or
+    Mistral: same weight schema, plus sliding-window attention when the HF
+    config carries a ``sliding_window``."""
     from apex_tpu.models import GPTModel
 
+    window = getattr(hf_model.config, "sliding_window", None)
+    if window is not None:
+        config_overrides.setdefault("attention_window", window)
     cfg = config_from_hf_llama(hf_model.config, **config_overrides)
     return GPTModel(config=cfg), {"params": params_from_hf_llama(hf_model)}
+
+
+# same schema (mistral = llama weights + sliding window)
+mistral_from_hf = llama_from_hf

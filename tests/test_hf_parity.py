@@ -107,6 +107,40 @@ def test_llama_logits_match(hf_llama):
     np.testing.assert_allclose(np.asarray(logits, np.float32), ref, atol=3e-5)
 
 
+def test_mistral_logits_match_with_sliding_window():
+    """Mistral = llama schema + sliding window; seq (48) > window (16) so
+    the band is genuinely active in both implementations."""
+    cfg = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=48,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        sliding_window=16,
+        tie_word_embeddings=False,
+        attention_dropout=0.0,
+    )
+    torch.manual_seed(3)
+    hf = transformers.MistralForCausalLM(cfg)
+    hf.eval()
+
+    from apex_tpu.models.hf_import import mistral_from_hf
+
+    model, variables = mistral_from_hf(hf)
+    assert model.config.attention_window == 16
+    rng = np.random.RandomState(4)
+    tokens = rng.randint(0, 128, size=(2, 48))
+
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens)).logits.numpy()
+
+    logits = model.apply(variables, jnp.asarray(tokens))
+    np.testing.assert_allclose(np.asarray(logits, np.float32), ref, atol=3e-5)
+
+
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
 
