@@ -53,6 +53,7 @@ _SLOW_TESTS = {
     "test_train_updates_batch_stats_and_loss_decreases",
     "test_ep_matches_local",
     "test_pp_tp_sp_training_converges",
+    "test_llama_style_pp_tp_sp_training_converges",
     "test_syncbn_dp_matches_single_device_global_batch",
     "test_matches_unsharded",
     "test_gpt_ring_cp_matches_single_device",

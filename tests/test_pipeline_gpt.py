@@ -172,6 +172,71 @@ class TestPipelinedGPT:
         losses = np.asarray(train(tokens, labels))
         assert losses[-1] < losses[0] * 0.9, losses
 
+    def test_llama_style_pp_tp_sp_training_converges(self, rng):
+        """The modern-architecture stack (RMSNorm + rotate-half RoPE +
+        SwiGLU + GQA + sliding window + bias-free linears + untied head)
+        through the same pp=2 x tp=2 (+SP) compiled pipeline."""
+        pp = tp = 2
+        mesh = parallel_state.initialize_model_parallel(
+            tensor_model_parallel_size=tp,
+            pipeline_model_parallel_size=pp,
+            devices=jax.devices()[: pp * tp],
+        )
+        cfg = tiny_cfg(
+            sequence_parallel=True,
+            normalization="rmsnorm",
+            activation="swiglu",
+            add_bias_linear=False,
+            position_embedding_type="rope",
+            num_query_groups=2,
+            attention_window=4,
+            share_embeddings_and_output_weights=False,
+        )
+        parts = build_gpt_pipeline(cfg, pp)
+
+        num_micro = 2
+        tokens = jax.random.randint(rng, (num_micro, MB, SEQ), 0, VOCAB)
+        labels = jnp.roll(tokens, -1, axis=2)
+
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P()),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def train(tokens, labels):
+            key = jax.random.PRNGKey(0)
+            pre = parts.embed.init(key, tokens[0])["params"]
+            h = parts.pre_fn(pre, tokens[0])
+            r = jax.lax.axis_index("pp")
+            stage = parts.chunk.init(
+                jax.random.fold_in(jax.random.fold_in(key, 7), r), h
+            )["params"]
+            params = {
+                "pre": pre,
+                "stages": stage,
+                "post": parts.init_post(jax.random.fold_in(key, 9)),
+            }
+
+            def step(params, _):
+                loss, _, grads = forward_backward_with_pre_post(
+                    parts.pre_fn, parts.stage_fn, parts.post_loss_fn,
+                    params, tokens, labels, axis_name="pp",
+                )
+                params = jax.tree_util.tree_map(
+                    lambda p, g: p - 0.5 * g.astype(p.dtype), params, grads
+                )
+                return params, jax.lax.psum(loss, "tp")
+
+            _, losses = jax.lax.scan(step, params, None, length=8)
+            return losses
+
+        losses = np.asarray(train(tokens, labels))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.9, losses
+
     def test_post_params_stay_replicated_under_sp(self, rng):
         """The SP copy_to routing must produce IDENTICAL post grads on all
         tp ranks (review regression: tp-partial head grads)."""
