@@ -157,6 +157,8 @@ def config_from_hf_llama(hf_config, **overrides):
         hidden_size=hf_config.hidden_size,
         num_attention_heads=hf_config.num_attention_heads,
         num_query_groups=hf_config.num_key_value_heads,
+        # explicit head_dim (Mistral-Nemo style) may differ from hidden/heads
+        kv_channels=getattr(hf_config, "head_dim", None),
         ffn_hidden_size=hf_config.intermediate_size,
         vocab_size=hf_config.vocab_size,
         max_position_embeddings=hf_config.max_position_embeddings,
@@ -191,7 +193,13 @@ def params_from_hf_llama(hf_model) -> Dict[str, Any]:
     sd = {k: v.detach().cpu().numpy() for k, v in hf_model.state_dict().items()}
     cfg = hf_model.config
     heads, g = cfg.num_attention_heads, cfg.num_key_value_heads
-    hn = cfg.hidden_size // heads
+    hn = getattr(cfg, "head_dim", None) or cfg.hidden_size // heads
+    kw = sd["model.layers.0.self_attn.k_proj.weight"]
+    if kw.shape[0] != g * hn:
+        raise ValueError(
+            f"k_proj out dim {kw.shape[0]} != kv_heads*head_dim {g}*{hn} — "
+            "unexpected head layout for the llama/mistral mapping"
+        )
 
     def g_(name):
         return sd["model." + name]

@@ -52,6 +52,16 @@ def _window_lo(qi, bq: int, bk: int, window):
     return jnp.maximum(0, jax.lax.div(qi * bq - window + 1, bk))
 
 
+def window_mask(sq: int, sk: int, window: int):
+    """(sq, sk) bool mask, True = BEYOND the sliding window's lower edge
+    (col <= row - window, bottom-right aligned like causal_mask). The single
+    source of the band formula for the fused kernels' XLA fallback and the
+    unfused CoreAttention path."""
+    return (
+        jnp.arange(sk)[None, :] <= jnp.arange(sq)[:, None] + (sk - sq) - window
+    )
+
+
 def causal_mask(sq: int, sk: int):
     """(sq, sk) bool mask, True = masked out. Bottom-right aligned for
     rectangular scores (sk > sq ⇒ the query block sits at the end of the
@@ -71,12 +81,7 @@ def _attn_ref(q, k, v, scale, causal, mask=None, window=None):
     if causal:
         s = jnp.where(causal_mask(s.shape[-2], s.shape[-1]), _NEG_INF, s)
     if window is not None:
-        sq_, sk_ = s.shape[-2], s.shape[-1]
-        out_of_window = (
-            jnp.arange(sk_)[None, :]
-            <= jnp.arange(sq_)[:, None] + (sk_ - sq_) - window
-        )
-        s = jnp.where(out_of_window, _NEG_INF, s)
+        s = jnp.where(window_mask(s.shape[-2], s.shape[-1], window), _NEG_INF, s)
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)

@@ -356,11 +356,13 @@ class ParallelAttention(nn.Module):
             key_padding_mask = None
         if cp > 1:
             if (not use_flash or key_padding_mask is not None
-                    or cfg.attention_window is not None):
+                    or cfg.attention_window is not None
+                    or kb.shape[1] != qb.shape[1]):
                 raise NotImplementedError(
-                    "context parallelism supports causal/unmasked attention "
-                    "without dropout, padding masks, or sliding windows "
-                    "(like the reference's fused paths)"
+                    "context parallelism supports causal/unmasked MHA "
+                    "attention without dropout, padding masks, sliding "
+                    "windows, or grouped KV heads (like the reference's "
+                    "fused paths)"
                 )
             from apex_tpu.parallel.ring_attention import (
                 ring_attention,
@@ -396,10 +398,10 @@ class ParallelAttention(nn.Module):
             if cfg.attention_window is not None and causal:
                 # fold the band's lower edge into the dense mask; the causal
                 # upper edge stays with CoreAttention's own mask handling
-                sq_, sk_ = qb.shape[2], kb.shape[2]
-                below = (
-                    jnp.arange(sk_)[None, :]
-                    <= jnp.arange(sq_)[:, None] + (sk_ - sq_) - cfg.attention_window
+                from apex_tpu.ops.attention import window_mask
+
+                below = window_mask(
+                    qb.shape[2], kb.shape[2], cfg.attention_window
                 )[None, None]
                 attention_mask = (
                     below if attention_mask is None
