@@ -52,15 +52,28 @@ def _rotate(tree, axis_name: str):
     )
 
 
-def _allow_mask(sq: int, kv_lo, bk: int, src, rank, causal: bool):
-    """Keep-mask (sq, bk) for queries vs the kv block starting at global-
-    chunk-local offset ``kv_lo`` of the chunk from rank ``src`` (traced)."""
-    if not causal:
+def _allow_mask(sq: int, kv_lo, bk: int, src, rank, causal: bool,
+                window=None):
+    """Keep-mask (sq, bk) for queries vs the kv block starting at chunk-
+    local offset ``kv_lo`` of the chunk from rank ``src`` (traced).
+
+    With a sliding ``window`` the band is evaluated in GLOBAL positions
+    (query row rank*sq + i vs key col src*sq + kv_lo + j; equal shard
+    sizes are a ring invariant), composing with the causal cross-rank
+    triangle."""
+    if not causal and window is None:
         return None
     rows = jnp.arange(sq)[:, None]
     cols = kv_lo + jnp.arange(bk)[None, :]
-    tri = cols <= rows
-    return jnp.where(src < rank, True, jnp.where(src == rank, tri, False))
+    if window is None:
+        tri = cols <= rows
+        return jnp.where(src < rank, True, jnp.where(src == rank, tri, False))
+    grow = rank * sq + rows
+    gcol = src * sq + cols
+    keep = gcol > grow - window
+    if causal:
+        keep = jnp.logical_and(keep, gcol <= grow)
+    return keep
 
 
 def _chunk_block_size(s_local: int, block_size: int) -> int:
@@ -70,7 +83,7 @@ def _chunk_block_size(s_local: int, block_size: int) -> int:
     return bk
 
 
-def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size):
+def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size, window=None):
     """Stream one visiting K/V chunk through the online softmax in
     ``block_size`` slices. state = (acc, m, l) accumulated so far.
 
@@ -91,7 +104,7 @@ def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size)
             jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _allow_mask(sq, lo, bk, src, rank, causal)
+        allow = _allow_mask(sq, lo, bk, src, rank, causal, window)
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -113,13 +126,13 @@ def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size)
     return state
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring(q, k, v, axis_name, causal, scale, block_size):
-    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, scale, block_size, window):
+    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window)
     return o
 
 
-def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
+def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window):
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
@@ -131,7 +144,7 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
     )
     # step 0 on the resident chunk — no rotation needed
     state = _online_chunk_update(
-        init_state, q, k, v, scale, rank, rank, causal, block_size
+        init_state, q, k, v, scale, rank, rank, causal, block_size, window
     )
 
     def step(carry, t):
@@ -139,7 +152,7 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
         kc, vc = _rotate((kc, vc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         state = _online_chunk_update(
-            state, q, kc, vc, scale, src, rank, causal, block_size
+            state, q, kc, vc, scale, src, rank, causal, block_size, window
         )
         return ((kc, vc), state), None
 
@@ -155,7 +168,7 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size):
 
 
 def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
-                      causal, block_size):
+                      causal, block_size, window=None):
     """Blockwise gradient contributions of one visiting K/V chunk.
     Operand-dtype policy as in _online_chunk_update; dkc/dvc/dq accumulate
     in fp32."""
@@ -173,7 +186,7 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
             jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _allow_mask(sq, lo, bk, src, rank, causal)
+        allow = _allow_mask(sq, lo, bk, src, rank, causal, window)
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])
@@ -211,7 +224,7 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
     return dkc, dvc, dq
 
 
-def _ring_bwd(axis_name, causal, scale, block_size, res, do):
+def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
     q, k, v, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
@@ -225,7 +238,7 @@ def _ring_bwd(axis_name, causal, scale, block_size, res, do):
     # step 0 on the resident chunk
     dk0, dv0, dq = _chunk_bwd_update(
         q, do, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rank, rank,
-        causal, block_size,
+        causal, block_size, window,
     )
 
     def step(carry, t):
@@ -235,7 +248,7 @@ def _ring_bwd(axis_name, causal, scale, block_size, res, do):
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         dkc, dvc, dq = _chunk_bwd_update(
             q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
-            causal, block_size,
+            causal, block_size, window,
         )
         return ((kc, vc, dkc, dvc), dq), None
 
@@ -261,6 +274,7 @@ def ring_attention(
     causal: bool = False,
     scale: float = None,
     block_size: int = 512,
+    window: int = None,
 ):
     """Exact sequence-sharded attention over the ``axis_name`` ring.
 
@@ -269,10 +283,16 @@ def ring_attention(
     ``shard_map``. ``block_size`` bounds the K/V slice processed at once
     (local memory O(seq_local x block_size)). Returns the local output
     chunk; grads flow through a second ring pass (see module docstring).
+
+    ``window`` (sliding-window, causal only) bands attention in GLOBAL
+    positions across the ring's chunks — long-context mistral-style
+    attention sharded over cp.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True (mistral semantics)")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _ring(q, k, v, axis_name, causal, scale, block_size)
+    return _ring(q, k, v, axis_name, causal, scale, block_size, window)
 
 
 def ulysses_attention(
@@ -282,6 +302,7 @@ def ulysses_attention(
     axis_name: str = "cp",
     causal: bool = False,
     scale: float = None,
+    window: int = None,
     attn_fn=None,
 ):
     """DeepSpeed-Ulysses-style attention: all-to-all from sequence-sharded
@@ -311,5 +332,8 @@ def ulysses_attention(
         return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    oh = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    # heads are sharded but each rank sees the FULL sequence, so the local
+    # attention supports windows natively
+    kw = {} if window is None else {"window": window}
+    oh = attn_fn(qh, kh, vh, causal=causal, scale=scale, **kw)
     return to_seq(oh)

@@ -356,22 +356,22 @@ class ParallelAttention(nn.Module):
             key_padding_mask = None
         if cp > 1:
             if (not use_flash or key_padding_mask is not None
-                    or cfg.attention_window is not None
                     or kb.shape[1] != qb.shape[1]):
                 raise NotImplementedError(
                     "context parallelism supports causal/unmasked MHA "
-                    "attention without dropout, padding masks, sliding "
-                    "windows, or grouped KV heads (like the reference's "
-                    "fused paths)"
+                    "attention without dropout, padding masks, or grouped "
+                    "KV heads (like the reference's fused paths)"
                 )
             from apex_tpu.parallel.ring_attention import (
                 ring_attention,
                 ulysses_attention,
             )
 
+            win = cfg.attention_window if causal else None
             if cfg.context_parallel_mode == "ring":
                 ctx = ring_attention(
-                    qb, kb, vb, axis_name=cfg.context_axis, causal=causal
+                    qb, kb, vb, axis_name=cfg.context_axis, causal=causal,
+                    window=win,
                 )
             else:
                 ctx = ulysses_attention(
@@ -380,6 +380,7 @@ class ParallelAttention(nn.Module):
                     vb,
                     axis_name=cfg.context_axis,
                     causal=causal,
+                    window=win,
                     attn_fn=functools.partial(
                         flash_attention, impl=cfg.attention_impl
                     ),

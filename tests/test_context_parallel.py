@@ -58,6 +58,80 @@ class TestRingAttention:
             run(q, k, v), full_reference(q, k, v, causal), rtol=2e-4, atol=2e-5
         )
 
+    @pytest.mark.parametrize("window", [3, 12, 100])
+    def test_sliding_window_matches_single_device(self, rng, window):
+        """Global-position banding across ring chunks: windows inside one
+        chunk, spanning chunks, and wider than the sequence (== causal)."""
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv, kc = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, H, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, H, SEQ, D), jnp.float32)
+        ct = jax.random.normal(kc, (B, H, SEQ, D), jnp.float32)
+
+        def ring_run(window):
+            @jax.jit
+            @functools.partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(seq_spec(),) * 3,
+                out_specs=seq_spec(),
+                check_vma=False,
+            )
+            def run(q, k, v):
+                return ring_attention(
+                    q, k, v, axis_name="cp", causal=True, window=window
+                )
+
+            return run
+
+        ref = flash_attention(q, k, v, causal=True, window=window, impl="xla")
+        np.testing.assert_allclose(
+            ring_run(window)(q, k, v), ref, rtol=2e-4, atol=2e-5
+        )
+        # grads through the banded ring
+        gp = jax.grad(
+            lambda q, k, v: jnp.sum(ring_run(window)(q, k, v) * ct), (0, 1, 2)
+        )(q, k, v)
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, window=window,
+                                impl="xla") * ct
+            ),
+            (0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+    def test_ulysses_sliding_window_matches_single_device(self, rng):
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, H, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, H, SEQ, D), jnp.float32)
+
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(seq_spec(),) * 3,
+            out_specs=seq_spec(),
+            check_vma=False,
+        )
+        def run(q, k, v):
+            return ulysses_attention(
+                q, k, v, axis_name="cp", causal=True, window=8
+            )
+
+        ref = flash_attention(q, k, v, causal=True, window=8, impl="xla")
+        np.testing.assert_allclose(run(q, k, v), ref, rtol=2e-4, atol=2e-5)
+
     def test_bf16_forward_close_to_fp32_reference(self, rng):
         """bf16 path: einsum operands stay bf16 (MXU-rate policy, as in
         ops/attention.py) with fp32 online-softmax state — the only test
