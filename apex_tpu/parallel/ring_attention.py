@@ -76,6 +76,23 @@ def _allow_mask(sq: int, kv_lo, bk: int, src, rank, causal: bool,
     return keep
 
 
+def _chunk_contributes(src, rank, sq: int, causal: bool, window):
+    """Whether rank ``src``'s chunk intersects the local queries' band —
+    the visiting chunk is SKIPPED entirely (lax.cond) otherwise, making a
+    windowed ring cost O(window + sq) keys per rank instead of O(seq).
+    The ring still rotates every chunk (topology), only compute is saved."""
+    if window is None and not causal:
+        return jnp.bool_(True)
+    s0 = src * sq
+    r0 = rank * sq
+    ok = jnp.bool_(True)
+    if causal:
+        ok = jnp.logical_and(ok, s0 <= r0 + sq - 1)
+    if window is not None:
+        ok = jnp.logical_and(ok, s0 + sq - 1 >= r0 - window + 1)
+    return ok
+
+
 def _chunk_block_size(s_local: int, block_size: int) -> int:
     bk = min(block_size, s_local)
     while s_local % bk != 0:  # s_local is a power-of-two-ish shard; cheap
@@ -151,8 +168,13 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window):
         (kc, vc), state = carry
         kc, vc = _rotate((kc, vc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
-        state = _online_chunk_update(
-            state, q, kc, vc, scale, src, rank, causal, block_size, window
+        state = jax.lax.cond(
+            _chunk_contributes(src, rank, sq, causal, window),
+            lambda st: _online_chunk_update(
+                st, q, kc, vc, scale, src, rank, causal, block_size, window
+            ),
+            lambda st: st,
+            state,
         )
         return ((kc, vc), state), None
 
@@ -228,6 +250,7 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
     q, k, v, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
+    sq = q.shape[-2]
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (b, h, sq)
@@ -246,9 +269,14 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
         # dK/dV ride the ring with their chunks
         kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
-        dkc, dvc, dq = _chunk_bwd_update(
-            q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
-            causal, block_size, window,
+        dkc, dvc, dq = jax.lax.cond(
+            _chunk_contributes(src, rank, sq, causal, window),
+            lambda ops: _chunk_bwd_update(
+                q, do, delta, lse, kc, vc, ops[0], ops[1], ops[2], scale,
+                src, rank, causal, block_size, window,
+            ),
+            lambda ops: ops,
+            (dkc, dvc, dq),
         )
         return ((kc, vc, dkc, dvc), dq), None
 
