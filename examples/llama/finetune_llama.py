@@ -1,0 +1,134 @@
+"""Fine-tune a transformers-format Llama/Mistral checkpoint on TPU.
+
+The full modern stack in ~100 lines: `llama_from_hf` weight import (RMSNorm
++ rotate-half RoPE + SwiGLU + GQA + optional sliding window), bf16 compute
+via amp O2 semantics (fp32 masters are the imported params; compute_dtype
+does the cast), ZeRO-2 `DistributedFusedAdam` sharding optimizer state over
+the dp mesh axis, gradient clipping through the fused l2norm.
+
+With --demo (default when no checkpoint path is given) a tiny
+randomly-initialized HF model stands in, so the script runs anywhere —
+including this zero-egress environment — and doubles as the integration
+test for the import -> shard -> train pipeline.
+"""
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def parse_args():
+    p = argparse.ArgumentParser()
+    p.add_argument("--checkpoint", default=None,
+                   help="HF pretrained name/path; omit for the random demo model")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=4, help="per-device batch")
+    p.add_argument("--seq-len", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--clip", type=float, default=1.0)
+    p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=False,
+                   help="bf16 compute (TPU-rate; keep off for CPU demos)")
+    return p.parse_args()
+
+
+def load_model(args):
+    import transformers
+
+    if args.checkpoint:
+        hf = transformers.AutoModelForCausalLM.from_pretrained(args.checkpoint)
+    else:
+        cfg = transformers.LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=160,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=args.seq_len,
+            tie_word_embeddings=False,
+        )
+        hf = transformers.LlamaForCausalLM(cfg)
+
+    from apex_tpu.models import llama_from_hf
+
+    overrides = {}
+    if args.bf16:
+        overrides["compute_dtype"] = jnp.bfloat16
+    return llama_from_hf(hf, **overrides)
+
+
+def main():
+    args = parse_args()
+    model, variables = load_model(args)
+    cfg = model.config
+
+    from apex_tpu.parallel import parallel_state
+
+    n_dev = len(jax.devices())
+    # the full named mesh (dp,pp,cp,tp) with dp = all devices: the model's
+    # TP/SP/CP accessors want parallel_state initialized even at size 1
+    mesh = parallel_state.initialize_model_parallel(devices=jax.devices())
+    print(f"devices={n_dev} vocab={cfg.vocab_size} layers={cfg.num_layers}")
+
+    from apex_tpu.optimizers import clip_grad_norm, distributed_fused_adam
+
+    # ZeRO-2: optimizer state sharded 1/n_dev over the dp axis
+    opt = distributed_fused_adam(lr=args.lr, axis_name="dp", average_grads=False)
+
+    key = jax.random.PRNGKey(0)
+    global_batch = args.batch * n_dev
+    # one fixed batch, revisited every step: the demo objective is
+    # memorization, so the loss visibly falls from the uniform floor
+    # (ln vocab). Swap in a real dataloader for actual fine-tuning.
+    tokens = jax.random.randint(
+        key, (global_batch, args.seq_len), 0, cfg.vocab_size
+    )
+    labels = jnp.roll(tokens, -1, axis=1)
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        # params replicated in/out (ZeRO all-gathers updates every step);
+        # the batch dim of the (steps, global_batch, seq) data shards on dp;
+        # ZeRO optimizer state lives INSIDE, sharded per rank
+        in_specs=(P(), P("dp"), P("dp")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def train(params, tokens, labels):
+        opt_state = opt.init(params)
+
+        def step(carry, _):
+            params, opt_state = carry
+
+            def loss_fn(p):
+                return jnp.mean(model.apply(p, tokens, labels=labels))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = jax.lax.pmean(grads, "dp")
+            grads, _ = clip_grad_norm(grads, args.clip)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jax.lax.pmean(loss, "dp")
+
+        (params, _), losses = jax.lax.scan(
+            step, (params, opt_state), None, length=args.steps
+        )
+        return params, losses
+
+    t0 = time.perf_counter()
+    params, losses = train(variables, tokens, labels)
+    losses = np.asarray(losses)
+    dt = time.perf_counter() - t0
+    for i in range(0, args.steps, max(1, args.steps // 5)):
+        print(f"step {i:4d} loss {losses[i]:9.4f}")
+    print(f"final loss {losses[-1]:.4f}; {args.steps} steps in {dt:.2f}s "
+          f"on {jax.devices()[0].platform}")
+    assert np.isfinite(losses).all()
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,7 @@ _SLOW_TESTS = {
     "test_imagenet_example",
     "test_gpt_pretrain_example",
     "test_sparsity_example",
+    "test_llama_finetune_example",
     "test_post_params_stay_replicated_under_sp",
     "test_matches_sequential_composition",
     "test_bert_sp_loss_and_grads_match_non_sp",

@@ -63,6 +63,14 @@ def test_gpt_pretrain_example():
     assert "step " in out
 
 
+def test_llama_finetune_example():
+    out = _run("examples/llama/finetune_llama.py", ["--steps", "20"])
+    assert "final loss" in out
+    # memorization demo: loss must fall well below the uniform floor
+    final = float(out.split("final loss")[1].split(";")[0])
+    assert final < 5.0, out
+
+
 def test_sparsity_example():
     out = _run("examples/sparsity/prune_mlp.py", ["--steps", "6"])
     assert "2:4 zeros preserved through training" in out
