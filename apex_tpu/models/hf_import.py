@@ -266,3 +266,56 @@ def llama_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
 
 # same schema (mistral = llama weights + sliding window)
 mistral_from_hf = llama_from_hf
+
+
+def params_to_hf_llama(params, hf_model) -> None:
+    """Load a GPTModel llama-style param tree back INTO ``hf_model``
+    (in place) — the inverse of ``params_from_hf_llama``, so models trained
+    here round-trip to the transformers ecosystem.
+
+    ``params`` is the {'params': ...} variables dict or its inner tree.
+    """
+    import torch
+
+    p = params.get("params", params)
+    cfg = hf_model.config
+    heads, g = cfg.num_attention_heads, cfg.num_key_value_heads
+    hn = getattr(cfg, "head_dim", None) or cfg.hidden_size // heads
+    ffn = cfg.intermediate_size
+
+    def t(x):  # (in, out) kernel -> torch Linear (out, in)
+        return torch.from_numpy(np.ascontiguousarray(np.asarray(x).T))
+
+    sd = {}
+    sd["model.embed_tokens.weight"] = torch.from_numpy(
+        np.asarray(p["embedding"]["word_embeddings"]["embedding"])
+    )
+    sd["model.norm.weight"] = torch.from_numpy(
+        np.asarray(p["transformer"]["final_layernorm"]["scale"])
+    )
+    if "output_layer" in p:
+        sd["lm_head.weight"] = t(p["output_layer"]["kernel"])
+    for i in range(cfg.num_hidden_layers):
+        lp = p["transformer"][f"layer_{i}"]
+        L = f"model.layers.{i}."
+        sd[L + "input_layernorm.weight"] = torch.from_numpy(
+            np.asarray(lp["input_layernorm"]["scale"])
+        )
+        sd[L + "post_attention_layernorm.weight"] = torch.from_numpy(
+            np.asarray(lp["post_attention_layernorm"]["scale"])
+        )
+        sd[L + "self_attn.q_proj.weight"] = t(lp["self_attention"]["query"]["kernel"])
+        kv = np.asarray(lp["self_attention"]["key_value"]["kernel"])
+        kv = kv.reshape(-1, g, 2, hn)  # undo per-group [k_g | v_g]
+        sd[L + "self_attn.k_proj.weight"] = t(kv[:, :, 0, :].reshape(-1, g * hn))
+        sd[L + "self_attn.v_proj.weight"] = t(kv[:, :, 1, :].reshape(-1, g * hn))
+        sd[L + "self_attn.o_proj.weight"] = t(lp["self_attention"]["dense"]["kernel"])
+        h4 = np.asarray(lp["mlp"]["dense_h_to_4h"]["kernel"])  # (h, 2*ffn)
+        sd[L + "mlp.gate_proj.weight"] = t(h4[:, :ffn])
+        sd[L + "mlp.up_proj.weight"] = t(h4[:, ffn:])
+        sd[L + "mlp.down_proj.weight"] = t(lp["mlp"]["dense_4h_to_h"]["kernel"])
+    missing, unexpected = hf_model.load_state_dict(sd, strict=False)
+    # rotary inv_freq buffers etc. may be "missing" (they are derived);
+    # anything unexpected means the mapping drifted
+    if unexpected:
+        raise ValueError(f"unexpected keys in export: {unexpected}")

@@ -141,6 +141,32 @@ def test_mistral_logits_match_with_sliding_window():
     np.testing.assert_allclose(np.asarray(logits, np.float32), ref, atol=3e-5)
 
 
+def test_llama_export_roundtrip(hf_llama):
+    """Train-here -> export-to-HF: params perturbed on our side, loaded
+    back into a fresh HF model, logits must track OUR model exactly."""
+    from apex_tpu.models.hf_import import llama_from_hf, params_to_hf_llama
+
+    model, variables = llama_from_hf(hf_llama)
+    # perturb deterministically so the export isn't trivially the import
+    variables = jax.tree_util.tree_map(
+        lambda x: x + 0.01 * jnp.sin(jnp.arange(x.size, dtype=jnp.float32)
+                                     ).reshape(x.shape),
+        variables,
+    )
+    import copy
+
+    hf2 = copy.deepcopy(hf_llama)
+    params_to_hf_llama(variables, hf2)
+    hf2.eval()
+
+    rng = np.random.RandomState(5)
+    tokens = rng.randint(0, 128, size=(2, 24))
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)), np.float32)
+    with torch.no_grad():
+        theirs = hf2(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-5)
+
+
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
 
