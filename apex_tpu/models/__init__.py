@@ -6,6 +6,7 @@ from apex_tpu.models.hf_import import (
     gpt2_from_hf,
     llama_from_hf,
     mistral_from_hf,
+    params_to_hf_gpt2,
     params_to_hf_llama,
 )
 from apex_tpu.models.bert import BertModel
@@ -24,6 +25,7 @@ __all__ = [
     "gpt2_from_hf",
     "llama_from_hf",
     "mistral_from_hf",
+    "params_to_hf_gpt2",
     "params_to_hf_llama",
     "BertModel",
     "gpt_loss_fn",

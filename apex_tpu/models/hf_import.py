@@ -138,6 +138,59 @@ def gpt2_from_hf(hf_model, **config_overrides) -> Tuple[Any, Dict[str, Any]]:
     return GPTModel(config=cfg), {"params": params_from_hf_gpt2(hf_model)}
 
 
+def _ungroup_qkv(w_packed: np.ndarray, heads: int) -> np.ndarray:
+    """Inverse of _regroup_qkv: per-head [q k v] blocks -> [Q|K|V]."""
+    *lead, three_h = w_packed.shape
+    h = three_h // 3
+    hn = h // heads
+    stack = w_packed.reshape(*lead, heads, 3, hn)
+    parts = [stack[..., :, j, :].reshape(*lead, h) for j in range(3)]
+    return np.concatenate(parts, axis=-1)
+
+
+def params_to_hf_gpt2(params, hf_model) -> None:
+    """Load a GPTModel param tree back INTO an HF GPT-2 (in place) — the
+    inverse of ``params_from_hf_gpt2``; round-trip tested."""
+    import torch
+
+    p = params.get("params", params)
+    heads = hf_model.config.n_head
+
+    def arr(x):
+        return torch.from_numpy(np.ascontiguousarray(np.asarray(x)))
+
+    sd = {}
+    wte = np.asarray(p["embedding"]["word_embeddings"]["embedding"])
+    sd["transformer.wte.weight"] = arr(wte)
+    sd["transformer.wpe.weight"] = arr(p["embedding"]["position_embeddings"])
+    sd["lm_head.weight"] = arr(wte)  # tied
+    sd["transformer.ln_f.weight"] = arr(p["transformer"]["final_layernorm"]["scale"])
+    sd["transformer.ln_f.bias"] = arr(p["transformer"]["final_layernorm"]["bias"])
+    for i in range(hf_model.config.n_layer):
+        lp = p["transformer"][f"layer_{i}"]
+        L = f"transformer.h.{i}."
+        sd[L + "ln_1.weight"] = arr(lp["input_layernorm"]["scale"])
+        sd[L + "ln_1.bias"] = arr(lp["input_layernorm"]["bias"])
+        sd[L + "ln_2.weight"] = arr(lp["post_attention_layernorm"]["scale"])
+        sd[L + "ln_2.bias"] = arr(lp["post_attention_layernorm"]["bias"])
+        sa = lp["self_attention"]
+        sd[L + "attn.c_attn.weight"] = arr(
+            _ungroup_qkv(np.asarray(sa["query_key_value"]["kernel"]), heads)
+        )
+        sd[L + "attn.c_attn.bias"] = arr(
+            _ungroup_qkv(np.asarray(sa["query_key_value"]["bias"]), heads)
+        )
+        sd[L + "attn.c_proj.weight"] = arr(sa["dense"]["kernel"])
+        sd[L + "attn.c_proj.bias"] = arr(sa["dense"]["bias"])
+        sd[L + "mlp.c_fc.weight"] = arr(lp["mlp"]["dense_h_to_4h"]["kernel"])
+        sd[L + "mlp.c_fc.bias"] = arr(lp["mlp"]["dense_h_to_4h"]["bias"])
+        sd[L + "mlp.c_proj.weight"] = arr(lp["mlp"]["dense_4h_to_h"]["kernel"])
+        sd[L + "mlp.c_proj.bias"] = arr(lp["mlp"]["dense_4h_to_h"]["bias"])
+    missing, unexpected = hf_model.load_state_dict(sd, strict=False)
+    if unexpected:
+        raise ValueError(f"unexpected keys in export: {unexpected}")
+
+
 # ---------------------------------------------------------------------------
 # Llama family
 # ---------------------------------------------------------------------------

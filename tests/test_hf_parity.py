@@ -167,6 +167,29 @@ def test_llama_export_roundtrip(hf_llama):
     np.testing.assert_allclose(ours, theirs, atol=3e-5)
 
 
+def test_gpt2_export_roundtrip(hf_model):
+    from apex_tpu.models.hf_import import gpt2_from_hf, params_to_hf_gpt2
+
+    model, variables = gpt2_from_hf(hf_model)
+    variables = jax.tree_util.tree_map(
+        lambda x: x + 0.01 * jnp.cos(jnp.arange(x.size, dtype=jnp.float32)
+                                     ).reshape(x.shape),
+        variables,
+    )
+    import copy
+
+    hf2 = copy.deepcopy(hf_model)
+    params_to_hf_gpt2(variables, hf2)
+    hf2.eval()
+
+    rng = np.random.RandomState(6)
+    tokens = rng.randint(0, 128, size=(2, 24))
+    ours = np.asarray(model.apply(variables, jnp.asarray(tokens)), np.float32)
+    with torch.no_grad():
+        theirs = hf2(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=3e-5)
+
+
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
 
