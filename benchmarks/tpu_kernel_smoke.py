@@ -78,6 +78,32 @@ def main():
         g_x = jax.jit(jax.grad(lambda q, k, v, c=causal: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=c, impl="xla"))), argnums=(0, 1, 2)))
         ok &= check(f"flash_attention bwd causal={causal}", g_p(q, k_, v), g_x(q, k_, v), 5e-2)
 
+    # ---- GQA / sliding window / key-padding fast paths (compiled) ----
+    q4 = jax.random.normal(jax.random.fold_in(key, 10), (2, 4, 256, 64), jnp.float32)
+    k4 = jax.random.normal(jax.random.fold_in(key, 11), (2, 2, 256, 64), jnp.float32)
+    v4 = jax.random.normal(jax.random.fold_in(key, 12), (2, 2, 256, 64), jnp.float32)
+    gq_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="pallas"))
+    gq_x = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="xla"))
+    ok &= check("flash_attention GQA fwd", gq_p(q4, k4, v4), gq_x(q4, k4, v4), 2e-2)
+    gg_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+        flash_attention(q, k, v, causal=True, impl="pallas"))), argnums=(0, 1, 2)))
+    gg_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+        flash_attention(q, k, v, causal=True, impl="xla"))), argnums=(0, 1, 2)))
+    ok &= check("flash_attention GQA bwd", gg_p(q4, k4, v4), gg_x(q4, k4, v4), 5e-2)
+
+    w_p = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, window=100, impl="pallas"))
+    w_x = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, window=100, impl="xla"))
+    ok &= check("flash_attention window fwd", w_p(q, k_, v), w_x(q, k_, v), 2e-2)
+
+    kpm = jnp.zeros((2, 256), bool).at[0, 180:].set(True)
+    kp_p = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, key_padding_mask=kpm, impl="pallas"))
+    kp_x = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, key_padding_mask=kpm, impl="xla"))
+    ok &= check("flash_attention kpm fwd", kp_p(q, k_, v), kp_x(q, k_, v), 2e-2)
+
     # ---- flat optimizer engine ----
     from apex_tpu.optimizers._fused_kernels import adam_flat, l2norm_flat
     from apex_tpu.ops.multi_tensor import CHUNK_SIZE
