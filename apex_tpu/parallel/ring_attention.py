@@ -29,9 +29,11 @@ Two strategies, both exact:
 
 Causal handling in the ring: the chunk from rank j attends against local
 queries of rank i with (j < i) → full block, (j == i) → causal block,
-(j > i) → fully masked (contributes nothing). Ranks with higher indices do
-more work — the standard ring-attention causal imbalance; zigzag
-load-balanced chunk ordering is a planned optimization.
+(j > i) → skipped entirely (``_chunk_contributes`` + ``lax.cond``; sliding
+windows additionally skip chunks behind the band). Ranks with higher
+indices still do more work per rotation — the standard ring-attention
+causal imbalance; zigzag load-balanced chunk ordering is a planned
+optimization.
 """
 
 import functools
