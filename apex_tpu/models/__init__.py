@@ -2,6 +2,7 @@
 examples/imagenet) re-built TPU-native on the apex_tpu transformer stack."""
 
 from apex_tpu.models.gpt import GPTModel, gpt_loss_fn
+from apex_tpu.models.generate import generate
 from apex_tpu.models.hf_import import (
     gpt2_from_hf,
     llama_from_hf,
@@ -22,6 +23,7 @@ from apex_tpu.models.resnet import (
 
 __all__ = [
     "GPTModel",
+    "generate",
     "gpt2_from_hf",
     "llama_from_hf",
     "mistral_from_hf",

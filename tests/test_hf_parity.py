@@ -190,6 +190,28 @@ def test_gpt2_export_roundtrip(hf_model):
     np.testing.assert_allclose(ours, theirs, atol=3e-5)
 
 
+def test_greedy_generation_matches_hf(hf_llama):
+    """Greedy continuations on the same imported weights must match HF's
+    generate(do_sample=False) token-for-token."""
+    from apex_tpu.models.generate import generate
+    from apex_tpu.models.hf_import import llama_from_hf
+
+    model, variables = llama_from_hf(hf_llama)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 128, size=(2, 8))
+
+    with torch.no_grad():
+        ref = hf_llama.generate(
+            torch.from_numpy(prompt), max_new_tokens=12, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+
+    out = np.asarray(
+        generate(model, variables, jnp.asarray(prompt), max_new_tokens=12)
+    )
+    np.testing.assert_array_equal(out, ref)
+
+
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
 
