@@ -36,8 +36,8 @@ FALLBACK_RESERVE = 360       # kept aside for the CPU-smoke record (measured ~31
 MIN_CHILD_TIMEOUT = 60
 
 
-def measure(dtype, batch, image_size):
-    """Images/sec for one RN50 train step, slope-timed.
+def measure(dtype, batch, image_size, smoke_model="resnet50"):
+    """Images/sec for one train step, slope-timed.
 
     Wall-clock per-call timing is meaningless through the axon relay
     (``block_until_ready`` does not wait for device execution and a
@@ -50,11 +50,16 @@ def measure(dtype, batch, image_size):
     import jax.numpy as jnp
     import optax
 
-    from apex_tpu.models import ResNet50, cross_entropy_loss
+    from apex_tpu.models import ResNet18, ResNet50, cross_entropy_loss
     from apex_tpu.optimizers import fused_sgd
     from apex_tpu.utils.benchmarking import chained_seconds_per_iter, full_reduce
 
-    model = ResNet50(num_classes=1000, dtype=dtype)
+    # the CPU smoke proves the pipeline, not RN50 throughput; RN18 halves
+    # the dominant cost (four scan compiles on one core) so the fallback
+    # fits its reserve with real margin even under load (a 700s-budget
+    # drill measured the RN50 smoke overrunning a 384s window)
+    model_cls = ResNet50 if smoke_model == "resnet50" else ResNet18
+    model = model_cls(num_classes=1000, dtype=dtype)
     key = jax.random.PRNGKey(0)
     # images/labels are jit arguments, not closure constants — closed-over
     # arrays would be baked into the HLO as a ~150 MB constant at batch 256
@@ -123,23 +128,22 @@ def run_bench():
 
     jax.devices()  # force backend init (raises here on failure, not mid-bench)
     if _on_tpu():  # recognizes both "tpu" and the axon relay platform
-        batch, image_size = 256, 224
+        batch, image_size, smoke_model = 256, 224, "resnet50"
     else:  # CPU smoke mode so the bench is runnable anywhere
-        batch, image_size = 8, 32
+        batch, image_size, smoke_model = 8, 32, "resnet18"
 
-    o2 = measure(jnp.bfloat16, batch, image_size)  # amp O2: bf16 compute, fp32 params
-    o0 = measure(jnp.float32, batch, image_size)   # O0 baseline
+    o2 = measure(jnp.bfloat16, batch, image_size, smoke_model)  # amp O2
+    o0 = measure(jnp.float32, batch, image_size, smoke_model)   # O0 baseline
 
-    print(
-        json.dumps(
-            {
-                "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
-                "value": round(o2, 2),
-                "unit": "imgs/sec/chip",
-                "vs_baseline": round(o2 / o0, 3),
-            }
-        )
-    )
+    rec = {
+        "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+        "value": round(o2, 2),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(o2 / o0, 3),
+    }
+    if smoke_model != "resnet50":
+        rec["smoke_model"] = smoke_model  # CPU fallback proves the pipeline
+    print(json.dumps(rec))
     return 0
 
 
@@ -210,20 +214,17 @@ def main():
         if probe is not None:
             break
 
-    # 2) TPU measurement attempts — only if the probe saw an accelerator, and
-    #    each sized so the fallback reserve survives no matter what.
+    # 2) ONE TPU measurement attempt with the full non-reserve budget.
+    #    The remote-compile cost dominates (4+ RN50-scan compiles); a 60/40
+    #    two-attempt split starves BOTH attempts below that cost, while
+    #    transient-init flakiness is already covered by the probe retry loop.
     if probe and probe.get("probe_platform") not in (None, "cpu"):
-        for i in range(2):
-            budget = remaining() - FALLBACK_RESERVE
-            if budget < MIN_CHILD_TIMEOUT:
-                break
-            t = budget if i == 1 else budget * 0.6
-            rec = child(["--run"], timeout=max(MIN_CHILD_TIMEOUT, t),
-                        tag=f"tpu attempt {i + 1}/2")
+        budget = remaining() - FALLBACK_RESERVE
+        if budget >= MIN_CHILD_TIMEOUT:
+            rec = child(["--run"], timeout=budget, tag="tpu attempt")
             if rec is not None and "metric" in rec:
                 print(json.dumps(rec))
                 return 0
-            time.sleep(min(10, max(0, remaining() - FALLBACK_RESERVE)))
     elif probe:
         diagnostics.append(f"probe saw platform={probe.get('probe_platform')!r}; "
                            "skipping TPU attempts")
