@@ -323,6 +323,30 @@ class TestFlashAttention:
         for a in gp:
             np.testing.assert_allclose(np.asarray(a[2]), 0.0, atol=0.0)
 
+    def test_bf16_gqa_window_compose(self, rng):
+        """All three fast-path features at once — bf16 operands, grouped kv,
+        sliding window — against the fp32 repeated-kv dense-band reference."""
+        k1, k2, k3 = jax.random.split(rng, 3)
+        q = jax.random.normal(k1, (2, 4, 128, 64), jnp.float32)
+        k = jax.random.normal(k2, (2, 2, 128, 64), jnp.float32)
+        v = jax.random.normal(k3, (2, 2, 128, 64), jnp.float32)
+
+        out_b = flash_attention(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16), causal=True, window=40, impl="pallas",
+        )
+        rows = jnp.arange(128)[:, None]
+        cols = jnp.arange(128)[None, :]
+        band = jnp.logical_or(cols > rows, cols <= rows - 40)
+        ref = flash_attention(
+            q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1),
+            mask=band[None, None], impl="xla",
+        )
+        assert out_b.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(out_b, np.float32), np.asarray(ref), atol=0.08
+        )
+
     @pytest.mark.parametrize("causal", [False, True])
     def test_bf16_fwd_bwd_close_to_fp32_ref(self, rng, causal):
         """bf16 path: the kernel keeps dot OPERANDS in bf16 (p and ds are
