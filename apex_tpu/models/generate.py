@@ -34,6 +34,14 @@ def generate(
     """
     b, s = prompt_tokens.shape
     total = s + max_new_tokens
+    max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
+    if max_pos is not None and total > max_pos:
+        # out-of-range positions would be silently CLAMPED by the gather
+        # (jnp.take clips), yielding garbage continuations — fail loudly
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's max_position_embeddings ({max_pos})"
+        )
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if rng is None:
@@ -56,9 +64,7 @@ def generate(
         else:
             nxt = jnp.argmax(next_logits, axis=-1)
         nxt = nxt.astype(buf.dtype)
-        buf = jax.vmap(
-            lambda row, tok, c: jax.lax.dynamic_update_slice(row, tok[None], (c,))
-        )(buf, nxt, jnp.full((b,), cur))
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, cur))
         return (buf, cur + 1, key), None
 
     (buf, _, _), _ = jax.lax.scan(

@@ -135,15 +135,15 @@ def run_bench():
     o2 = measure(jnp.bfloat16, batch, image_size, smoke_model)  # amp O2
     o0 = measure(jnp.float32, batch, image_size, smoke_model)   # O0 baseline
 
-    rec = {
+    # smoke_model is ALWAYS emitted: the metric key alone must never be
+    # read as comparable across platforms (the CPU fallback smokes RN18)
+    print(json.dumps({
         "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
         "value": round(o2, 2),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(o2 / o0, 3),
-    }
-    if smoke_model != "resnet50":
-        rec["smoke_model"] = smoke_model  # CPU fallback proves the pipeline
-    print(json.dumps(rec))
+        "smoke_model": smoke_model,
+    }))
     return 0
 
 
