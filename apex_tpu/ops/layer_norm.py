@@ -95,8 +95,17 @@ def _ln_bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dg_ref, db_ref, *, eps):
     m1 = jnp.mean(dyw, axis=1, keepdims=True)
     m2 = jnp.mean(dyw * xhat, axis=1, keepdims=True)
     dx_ref[:] = ((dyw - m1 - xhat * m2) * rstd).astype(dx_ref.dtype)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(dy, axis=0, keepdims=True)
+    # dgamma/dbeta accumulate across the (sequential) TPU grid into one
+    # (1, hidden) block: a per-step (grid, hidden) partials array would need
+    # a 1-sublane output block, which Mosaic rejects for grid > 1 (measured
+    # on v5e: "last two dimensions ... divisible by 8 and 128")
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    dg_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _rms_fwd_kernel(x_ref, w_ref, y_ref, *, eps):
@@ -114,7 +123,12 @@ def _rms_bwd_kernel(x_ref, w_ref, dy_ref, dx_ref, dg_ref, *, eps):
     dyw = dy * w
     m2 = jnp.mean(dyw * xhat, axis=1, keepdims=True)
     dx_ref[:] = ((dyw - xhat * m2) * rstd).astype(dx_ref.dtype)
-    dg_ref[:] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    # accumulated across the sequential grid (see _ln_bwd_kernel)
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[:] = jnp.zeros_like(dg_ref)
+
+    dg_ref[:] += jnp.sum(dy * xhat, axis=0, keepdims=True)
 
 
 def _pad_rows(x2d, block_rows):
@@ -162,8 +176,8 @@ def _ln_pallas_bwd(eps, interpret, res, dy):
         functools.partial(_ln_bwd_kernel, eps=eps),
         out_shape=(
             jax.ShapeDtypeStruct((padded, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ),
         grid=(grid,),
         in_specs=[
@@ -173,13 +187,13 @@ def _ln_pallas_bwd(eps, interpret, res, dy):
         ],
         out_specs=(
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ),
         interpret=interpret,
     )(xp, w.reshape(1, -1), dyp)
-    dg = jnp.sum(dgp, axis=0).astype(w.dtype)
-    db = jnp.sum(dbp, axis=0).astype(b.dtype)
+    dg = dgp.reshape(-1).astype(w.dtype)
+    db = dbp.reshape(-1).astype(b.dtype)
     return dx[:rows], dg, db
 
 
@@ -222,7 +236,7 @@ def _rms_pallas_bwd(eps, interpret, res, dy):
         functools.partial(_rms_bwd_kernel, eps=eps),
         out_shape=(
             jax.ShapeDtypeStruct((padded, hidden), x2d.dtype),
-            jax.ShapeDtypeStruct((grid, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((1, hidden), jnp.float32),
         ),
         grid=(grid,),
         in_specs=[
@@ -232,11 +246,11 @@ def _rms_pallas_bwd(eps, interpret, res, dy):
         ],
         out_specs=(
             pl.BlockSpec((br, hidden), lambda i: (i, 0)),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
         ),
         interpret=interpret,
     )(xp, w.reshape(1, -1), dyp)
-    dg = jnp.sum(dgp, axis=0).astype(w.dtype)
+    dg = dgp.reshape(-1).astype(w.dtype)
     return dx[:rows], dg
 
 

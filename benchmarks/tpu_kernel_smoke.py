@@ -43,20 +43,31 @@ def main():
     # ---- layer norm / rms norm fwd+bwd ----
     from apex_tpu.ops import layer_norm, rms_norm
 
-    x = jax.random.normal(key, (512, 1024), jnp.float32)
-    w = jax.random.normal(jax.random.fold_in(key, 1), (1024,)) * 0.1 + 1.0
-    b = jax.random.normal(jax.random.fold_in(key, 2), (1024,)) * 0.1
-
-    for name, fn in [
-        ("layer_norm", lambda impl: lambda x, w, b: layer_norm(x, w, b, impl=impl)),
-        ("rms_norm", lambda impl: lambda x, w, b: rms_norm(x, w, impl=impl)),
+    # Shapes cover both measured v5e failure modes: (512, 1024) runs the bwd
+    # dgamma/dbeta accumulation at grid>1 (block_rows=256 -> 2 grid steps;
+    # a per-step partials layout was rejected by Mosaic's 8-sublane rule),
+    # and (1024, 4096) is the shape whose fp32 temporaries blew the 16MB
+    # scoped-vmem limit before _pick_block_rows budgeted 1MB/operand.
+    for rows, hidden, dtype, ftol, btol in [
+        (512, 1024, jnp.float32, 2e-5, 2e-4),
+        (1024, 4096, jnp.float32, 2e-5, 2e-3),
+        (512, 1024, jnp.bfloat16, 2e-2, 2e-2),
     ]:
-        f_p = jax.jit(lambda x, w, b, f=fn("pallas"): f(x, w, b))
-        f_x = jax.jit(lambda x, w, b, f=fn("xla"): f(x, w, b))
-        ok &= check(f"{name} fwd", f_p(x, w, b), f_x(x, w, b), 2e-5)
-        g_p = jax.jit(jax.grad(lambda x, w, b, f=fn("pallas"): jnp.sum(jnp.sin(f(x, w, b))), argnums=(0, 1, 2)))
-        g_x = jax.jit(jax.grad(lambda x, w, b, f=fn("xla"): jnp.sum(jnp.sin(f(x, w, b))), argnums=(0, 1, 2)))
-        ok &= check(f"{name} bwd", g_p(x, w, b), g_x(x, w, b), 2e-4)
+        x = jax.random.normal(key, (rows, hidden), jnp.float32).astype(dtype)
+        w = (jax.random.normal(jax.random.fold_in(key, 1), (hidden,)) * 0.1 + 1.0).astype(dtype)
+        b = (jax.random.normal(jax.random.fold_in(key, 2), (hidden,)) * 0.1).astype(dtype)
+        tag = f"{rows}x{hidden} {jnp.dtype(dtype).name}"
+
+        for name, fn in [
+            ("layer_norm", lambda impl: lambda x, w, b: layer_norm(x, w, b, impl=impl)),
+            ("rms_norm", lambda impl: lambda x, w, b: rms_norm(x, w, impl=impl)),
+        ]:
+            f_p = jax.jit(lambda x, w, b, f=fn("pallas"): f(x, w, b))
+            f_x = jax.jit(lambda x, w, b, f=fn("xla"): f(x, w, b))
+            ok &= check(f"{name} fwd {tag}", f_p(x, w, b), f_x(x, w, b), ftol)
+            g_p = jax.jit(jax.grad(lambda x, w, b, f=fn("pallas"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
+            g_x = jax.jit(jax.grad(lambda x, w, b, f=fn("xla"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
+            ok &= check(f"{name} bwd {tag}", g_p(x, w, b), g_x(x, w, b), btol)
 
     # ---- flash attention fwd+bwd (causal + non-causal) ----
     from apex_tpu.ops import flash_attention
@@ -108,7 +119,11 @@ def main():
     from apex_tpu.optimizers._fused_kernels import adam_flat, l2norm_flat
     from apex_tpu.ops.multi_tensor import CHUNK_SIZE
 
-    n = CHUNK_SIZE  # buffers must be CHUNK_SIZE-padded
+    # 3 chunks: the production case is a MULTI-chunk buffer (grid > 1), which
+    # exercises the sequential-grid accumulation in l2norm_flat and the
+    # per-chunk block walk in adam_flat — grid=1 alone would leave the same
+    # hazard class that bit the LN bwd partials (see above) uncovered
+    n = 3 * CHUNK_SIZE
     buf = jax.random.normal(jax.random.fold_in(key, 8), (n,), jnp.float32)
     g = jax.random.normal(jax.random.fold_in(key, 9), (n,), jnp.float32)
     m = jnp.zeros_like(buf)

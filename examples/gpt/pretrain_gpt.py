@@ -113,7 +113,11 @@ def main():
     opt = fused_adam(lr=args.lr, weight_decay=0.01)
     scaler = GradScaler(loss_scale="dynamic")
 
-    @jax.jit
+    # donated carried state: params/opt/scaler buffers are reused in place
+    # across the Python step loop instead of double-buffering the full
+    # parameter set in HBM (the torch reference mutates in place for free;
+    # under jit, donation is the explicit equivalent)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
     @functools.partial(
         shard_map,
         mesh=mesh,
@@ -134,11 +138,17 @@ def main():
         grads = all_reduce_gradients(grads, axis_name="dp")
         grads, found_inf = scaler.unscale(scaler_state, grads)
         new_scaler_state = scaler.update(scaler_state, found_inf)
-        updates, new_opt_state = opt.update(grads, opt_state, params)
-        new_params = jax.lax.cond(
-            found_inf,
-            lambda: params,
-            lambda: optax.apply_updates(params, updates),
+
+        # the skip must gate the OPTIMIZER STATE too: opt.update on inf
+        # grads would fold inf into the Adam moments permanently (m =
+        # 0.9*m + 0.1*inf), nan-ing every later step even after the scaler
+        # backs off — same both-or-neither rule as AmpOptimizer.step
+        def apply():
+            updates, new_opt = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+
+        new_params, new_opt_state = jax.lax.cond(
+            found_inf, lambda: (params, opt_state), apply
         )
         # the loss is tp-replicated even under SP: model.apply gathers the
         # sequence before the head and vocab_parallel_cross_entropy psums

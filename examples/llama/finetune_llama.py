@@ -88,7 +88,9 @@ def main():
     )
     labels = jnp.roll(tokens, -1, axis=1)
 
-    @jax.jit
+    # params are donated: the imported HF weights are consumed by the run
+    # and their HBM is reused for the trained result
+    @functools.partial(jax.jit, donate_argnums=(0,))
     @functools.partial(
         shard_map, mesh=mesh,
         # params replicated in/out (ZeRO all-gathers updates every step);
