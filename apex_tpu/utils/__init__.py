@@ -10,6 +10,7 @@ from apex_tpu.utils.checkpoint import (
     load_checkpoint,
     save_checkpoint,
 )
+from apex_tpu.utils.autoresume import AutoResume
 
 __all__ = [
     "tree_cast",
@@ -22,4 +23,5 @@ __all__ = [
     "latest_step",
     "load_checkpoint",
     "save_checkpoint",
+    "AutoResume",
 ]

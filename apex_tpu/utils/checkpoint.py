@@ -22,6 +22,16 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _serialize(tree: Any) -> Any:
+    """Custom pytree nodes (flax structs, optax/amp state dataclasses,
+    NamedTuples) -> plain nested containers. Orbax stores plain containers
+    on disk, so restoring INTO a custom-node target otherwise fails with a
+    treedef mismatch (observed with amp's LossScalerState)."""
+    from orbax.checkpoint.utils import serialize_tree
+
+    return serialize_tree(tree, keep_empty_nodes=True)
+
+
 def save_checkpoint(directory: str, step: int, tree: Any, overwrite: bool = True) -> str:
     """Write ``tree`` to ``directory/step_<N>``; returns the path.
 
@@ -30,7 +40,7 @@ def save_checkpoint(directory: str, step: int, tree: Any, overwrite: bool = True
     optimizer state_dict composition).
     """
     path = os.path.join(os.path.abspath(directory), f"step_{step}")
-    _checkpointer().save(path, tree, force=overwrite)
+    _checkpointer().save(path, _serialize(tree), force=overwrite)
     return path
 
 
@@ -53,10 +63,17 @@ def load_checkpoint(directory: str, step: Optional[int] = None, target: Any = No
     path = os.path.join(directory, f"step_{step}")
     if target is not None:
         import orbax.checkpoint as ocp
+        from orbax.checkpoint.utils import deserialize_tree
 
-        return _checkpointer().restore(
-            path, restore_args=ocp.checkpoint_utils.construct_restore_args(target)
+        plain = _checkpointer().restore(
+            path,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(
+                _serialize(target)
+            ),
         )
+        # rebuild the caller's structure (dataclasses etc.) from the plain
+        # on-disk containers
+        return deserialize_tree(plain, target, keep_empty_nodes=True)
     return _checkpointer().restore(path)
 
 

@@ -69,7 +69,7 @@ def main():
     from apex_tpu.parallel import parallel_state
     from apex_tpu.parallel.ddp import all_reduce_gradients
     from apex_tpu.transformer import TransformerConfig
-    from apex_tpu.utils import Timers, save_checkpoint
+    from apex_tpu.utils import AutoResume, Timers
 
     import optax
 
@@ -81,13 +81,6 @@ def main():
 
     prefix = args.corpus or synthetic_corpus(args.vocab)
     lm = LMDataset(IndexedTokenDataset(prefix), seq_len=args.seq_len)
-    sampler = MegatronPretrainingSampler(
-        total_samples=len(lm),
-        consumed_samples=0,
-        local_minibatch_size=args.global_batch,  # host-level batch; dp
-        data_parallel_rank=0,                    # sharding happens on device
-        data_parallel_size=1,
-    )
     num_micro = args.global_batch // (args.micro_batch * dp)
     assert num_micro >= 1, "global batch too small for micro batch x dp"
     assert args.global_batch % (args.micro_batch * dp) == 0, (
@@ -165,12 +158,48 @@ def main():
         return model.init(jax.random.PRNGKey(args.seed), tokens)
 
     params = init_params(sample_tokens)
-    opt_state = jax.jit(opt.init)(params)
-    scaler_state = scaler.init()
+    # optimizer/scaler state is pinned to the SAME mesh-replicated sharding
+    # as the params: plain jit would leave its scalar leaves committed to
+    # device 0, which works transiently (jit auto-moves) but breaks the
+    # moment the state round-trips through a checkpoint — restored arrays
+    # are committed, and mixed device sets are a hard error
+    replicated = jax.sharding.NamedSharding(mesh, P())
+    opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
+    scaler_state = jax.device_put(scaler.init(), replicated)
+
+    # --save enables BOTH periodic checkpoints and preemption-safe exit:
+    # SIGTERM (preemptible TPU VMs send it before eviction) checkpoints the
+    # current step and breaks the loop; a rerun with the same --save dir
+    # resumes.
+    ar = AutoResume(args.save, interval=args.save_interval) if args.save else None
+    step0 = 0
+    if ar is not None:
+        try:
+            step0, (params, opt_state, scaler_state) = ar.restore(
+                (params, opt_state, scaler_state)
+            )
+        except ValueError as e:
+            # a --save dir written by an older payload layout: train fresh
+            # rather than crash (old checkpoints stay on disk untouched)
+            print(f"checkpoint in {args.save} has an incompatible layout "
+                  f"({e}); starting fresh")
+        if step0:
+            print(f"resumed from step {step0}")
+
+    # the sampler's own resume mechanism picks the data stream up exactly
+    # where the saved run left off
+    sampler = MegatronPretrainingSampler(
+        total_samples=len(lm),
+        consumed_samples=step0 * args.global_batch,
+        local_minibatch_size=args.global_batch,  # host-level batch; dp
+        data_parallel_rank=0,                    # sharding happens on device
+        data_parallel_size=1,
+    )
 
     timers = Timers()
     it = iter(sampler)
-    for step_i in range(args.steps):
+    steps_run = 0
+    for step_i in range(step0, args.steps):
         idx = next(it)
         x, y = lm.batch(idx)
         x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
@@ -180,19 +209,18 @@ def main():
             params, opt_state, scaler_state, jnp.asarray(x), jnp.asarray(y)
         )
         timers("step").stop(barrier_on=loss)
+        steps_run += 1
         if step_i % 5 == 0 or step_i == args.steps - 1:
             print(
                 f"step {step_i:5d} loss {float(loss):8.4f} "
                 f"scale {float(scaler_state.scale):9.1f}"
             )
-        if args.save and (step_i + 1) % args.save_interval == 0:
-            path = save_checkpoint(
-                args.save, step_i + 1,
-                {"params": params, "opt_state": opt_state,
-                 "scale": scaler_state.scale},
-            )
-            print(f"saved {path}")
-    timers.log(["step"], normalizer=args.steps)
+        if ar is not None and ar.step(
+            step_i + 1, (params, opt_state, scaler_state)
+        ):
+            print(f"termination checkpoint at step {step_i + 1}; exiting")
+            break
+    timers.log(["step"], normalizer=max(1, steps_run))
 
 
 if __name__ == "__main__":

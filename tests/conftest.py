@@ -37,6 +37,7 @@ _SLOW_TESTS = {
     "test_amp_mlp_example",
     "test_imagenet_example",
     "test_gpt_pretrain_example",
+    "test_gpt_pretrain_resume",
     "test_sparsity_example",
     "test_llama_finetune_example",
     "test_post_params_stay_replicated_under_sp",

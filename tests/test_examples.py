@@ -63,6 +63,19 @@ def test_gpt_pretrain_example():
     assert "step " in out
 
 
+def test_gpt_pretrain_resume(tmp_path):
+    """Checkpoint-then-resume through the example's AutoResume wiring: the
+    second invocation must pick up at the saved step, not step 0 (the
+    preemption-signal path itself is unit-tested in test_utils.py)."""
+    base = ["--layers", "2", "--hidden", "64", "--heads", "4",
+            "--seq-len", "32", "--micro-batch", "1", "--global-batch", "16",
+            "--save", str(tmp_path), "--save-interval", "2"]
+    _run("examples/gpt/pretrain_gpt.py", ["--steps", "3"] + base)
+    out = _run("examples/gpt/pretrain_gpt.py", ["--steps", "5"] + base)
+    assert "resumed from step 2" in out
+    assert "step     4" in out
+
+
 def test_llama_finetune_example():
     out = _run("examples/llama/finetune_llama.py", ["--steps", "20"])
     assert "final loss" in out
