@@ -13,9 +13,18 @@ that recompute probabilities from the saved logsumexp per block pair —
 the standard flash recompute strategy, O(seq x block) memory in both
 directions.
 
+Single-chip long context: K/V residency caps the kernel at
+``_KV_RESIDENT_BYTES`` (below 16k bf16 / 8k fp32 keys at head_dim 128).
+Beyond
+it — or when the XLA fallback's full (sq, sk) score tensor would blow
+``_SCORE_BYTES`` — dispatch switches to ``_attn_blockwise``: an XLA-level
+(cq, ck)-tiled online softmax with a custom lse-recompute VJP, the same
+math as the kernel one tile size up, supporting GQA, key-padding masks,
+sliding windows, and rectangular causal. ``impl="blockwise"`` forces it.
+
 Long-context across chips is handled one level up by
-``apex_tpu.parallel.ring_attention``, which calls the blockwise pieces here
-per ring step.
+``apex_tpu.parallel.ring_attention``, which rotates K/V chunks over the
+cp ring with this same online-softmax structure per visiting chunk.
 """
 
 import functools
@@ -30,16 +39,18 @@ from apex_tpu.ops._dispatch import resolve_impl
 _NEG_INF = -1e30
 
 
-def _causal_hi(qi, bq: int, bk: int, num_kv):
+def _causal_hi(qi, bq: int, bk: int, num_kv, offs: int = 0):
     """Last kv block (exclusive) participating for q block ``qi`` under the
-    causal mask — shared by the fwd and both bwd kernels."""
-    return jnp.minimum(jax.lax.div((qi + 1) * bq + bk - 1, bk), num_kv)
+    causal mask — shared by the fwd/bwd kernels (offs=0) and the blockwise
+    path (offs = sk - sq, bottom-right alignment)."""
+    return jnp.minimum(jax.lax.div((qi + 1) * bq + offs - 1, bk) + 1, num_kv)
 
 
-def _causal_keep(qi, kj, bq: int, bk: int, window=None):
+def _causal_keep(qi, kj, bq: int, bk: int, window=None, offs: int = 0):
     """(bq, bk) keep-mask (True = attend) for block pair (qi, kj); with a
-    sliding ``window`` W, each row attends to cols in (row - W, row]."""
-    row = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    sliding ``window`` W, each row attends to cols in (row - W, row]. Query
+    row r sits at global key position r + offs."""
+    row = qi * bq + offs + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     col = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     keep = col <= row
     if window is not None:
@@ -47,9 +58,24 @@ def _causal_keep(qi, kj, bq: int, bk: int, window=None):
     return keep
 
 
-def _window_lo(qi, bq: int, bk: int, window):
+def _window_lo(qi, bq: int, bk: int, window, offs: int = 0):
     """First kv block (inclusive) a windowed-causal q block touches."""
-    return jnp.maximum(0, jax.lax.div(qi * bq - window + 1, bk))
+    return jnp.maximum(0, jax.lax.div(qi * bq + offs - window + 1, bk))
+
+
+def _q_band(kj, bq: int, bk: int, num_q, causal: bool, window, offs: int = 0):
+    """[lo, hi) q-block range whose band intersects kv block ``kj`` — the
+    transpose of (_window_lo, _causal_hi); shared by the dkv kernel
+    (offs=0) and the blockwise dk/dv pass."""
+    lo = (
+        jnp.maximum(0, jax.lax.div(kj * bk - offs, bq)) if causal else 0
+    )
+    hi = (
+        jnp.minimum(num_q, jax.lax.div(kj * bk + bk + window - 2 - offs, bq) + 1)
+        if window is not None
+        else num_q
+    )
+    return lo, hi
 
 
 def window_mask(sq: int, sk: int, window: int):
@@ -265,13 +291,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     vb = v_ref[0]
     seq_q = q_ref.shape[1]
     num_q = seq_q // bq
-    lo = jax.lax.div(kj * bk, bq) if causal else 0
-    # windowed: rows beyond col_max + window - 1 see none of this kv block
-    hi_q = (
-        jnp.minimum(num_q, jax.lax.div(kj * bk + bk + window - 2, bq) + 1)
-        if window is not None
-        else num_q
-    )
+    lo, hi_q = _q_band(kj, bq, bk, num_q, causal, window)
 
     def body(i, carry):
         # operands keep the input dtype; fp32 accumulation (see fwd kernel)
@@ -397,6 +417,230 @@ def _flash_bwd(heads, group, scale, causal, interpret, bq, bk, window, res, do):
 _flash.defvjp(_flash_fwd_res, _flash_bwd)
 
 
+# ---------------------------------------------------------------------------
+# Blockwise long-context path (single chip)
+# ---------------------------------------------------------------------------
+
+# The Pallas kernels keep K/V fully VMEM-resident per (batch, head) — the
+# fastest layout while K+V fit (8 MB leaves room for q/do blocks, fp32
+# accumulators, and double-buffering inside the 16 MB scoped-VMEM limit).
+# Past that, attention switches to the blockwise-XLA path below.
+_KV_RESIDENT_BYTES = 8 * 1024 * 1024
+# XLA fallback budget: the reference implementation materializes the full
+# (b, h, sq, sk) fp32 score tensor; beyond this it pages through HBM or
+# OOMs, so the blockwise path takes over.
+_SCORE_BYTES = 1 << 30
+
+
+def _bw_chunk(n: int, target: int) -> int:
+    c = min(target, n)
+    while n % c:
+        c -= 1
+    return c
+
+
+def _blockwise_masks(i, j, cq, ck, offs, causal, window):
+    """(cq, ck) keep-mask or None — the kernels' band mask at chunk
+    granularity with the bottom-right offset (window implies causal at the
+    API layer, so non-causal chunks are unmasked)."""
+    if not causal:
+        return None
+    return _causal_keep(i, j, cq, ck, window, offs)
+
+
+def _blockwise_kv_bounds(i, cq, ck, nk, offs, causal, window):
+    """[lo, hi) kv-chunk range intersecting q chunk ``i``'s band."""
+    hi = _causal_hi(i, cq, ck, nk, offs) if causal else nk
+    lo = _window_lo(i, cq, ck, window, offs) if window is not None else 0
+    return lo, hi
+
+
+def _bw_score(qi, kc, scale):
+    # operands keep the input dtype, fp32 accumulation (same MXU policy as
+    # the Pallas kernels)
+    return (
+        jnp.einsum(
+            "bGgqd,bGkd->bGgqk", qi, kc, preferred_element_type=jnp.float32
+        )
+        * scale
+    )
+
+
+def _kpm_chunk_keep(kpm, j, ck):
+    """(b, 1, 1, 1, ck) keep-mask slice of the key-padding mask."""
+    sl = jax.lax.dynamic_slice_in_dim(kpm, j * ck, ck, axis=1)
+    return (sl == 0)[:, None, None, None, :]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _blockwise(q5, kv, kpm, scale, causal, window, cq, ck):
+    o, _ = _blockwise_fwd_res(q5, kv, kpm, scale, causal, window, cq, ck)
+    return o
+
+
+def _blockwise_fwd_res(q5, kv, kpm, scale, causal, window, cq, ck):
+    """q5: (b, h_kv, g, sq, d); k/v: (b, h_kv, sk, d). Outer scan over q
+    chunks, inner fori over the kv chunks in the band — memory is one
+    (cq, ck) score tile per (b, h) instead of (sq, sk)."""
+    k, v = kv
+    b, h_kv, g, sq, d = q5.shape
+    sk = k.shape[2]
+    nq, nk = sq // cq, sk // ck
+    offs = sk - sq
+    has_kpm = kpm is not None
+
+    def q_chunk_step(_, i):
+        qi = jax.lax.dynamic_slice_in_dim(q5, i * cq, cq, axis=3)
+        lo, hi = _blockwise_kv_bounds(i, cq, ck, nk, offs, causal, window)
+
+        def kv_step(j, state):
+            acc, m, l = state
+            kc = jax.lax.dynamic_slice_in_dim(k, j * ck, ck, axis=2)
+            vc = jax.lax.dynamic_slice_in_dim(v, j * ck, ck, axis=2)
+            s = _bw_score(qi, kc, scale)
+            keep = _blockwise_masks(i, j, cq, ck, offs, causal, window)
+            if keep is not None:
+                s = jnp.where(keep, s, _NEG_INF)
+            if has_kpm:
+                s = jnp.where(_kpm_chunk_keep(kpm, j, ck), s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bGgqk,bGkd->bGgqd", p.astype(vc.dtype), vc,
+                preferred_element_type=jnp.float32,
+            )
+            return acc_new, m_new, l_new
+
+        init = (
+            jnp.zeros((b, h_kv, g, cq, d), jnp.float32),
+            jnp.full((b, h_kv, g, cq), _NEG_INF, jnp.float32),
+            jnp.zeros((b, h_kv, g, cq), jnp.float32),
+        )
+        acc, m, l = jax.lax.fori_loop(lo, hi, kv_step, init)
+        # fully-masked rows -> zeros + lse sentinel (same contract as the
+        # Pallas kernel, see _flash_fwd_kernel)
+        dead = m <= _NEG_INF * 0.5
+        l = jnp.maximum(l, 1e-30)
+        o_i = jnp.where(dead[..., None], 0.0, acc / l[..., None])
+        lse_i = jnp.where(dead, -_NEG_INF, m + jnp.log(l))
+        return None, (o_i.astype(q5.dtype), lse_i)
+
+    _, (o_chunks, lse_chunks) = jax.lax.scan(
+        q_chunk_step, None, jnp.arange(nq)
+    )
+    # (nq, b, G, g, cq, ...) -> (b, G, g, sq, ...)
+    o = jnp.moveaxis(o_chunks, 0, 3).reshape(b, h_kv, g, sq, d)
+    lse = jnp.moveaxis(lse_chunks, 0, 3).reshape(b, h_kv, g, sq)
+    return o, (q5, kv, kpm, o, lse)
+
+
+def _blockwise_bwd(scale, causal, window, cq, ck, res, do):
+    q5, (k, v), kpm, o, lse = res
+    b, h_kv, g, sq, d = q5.shape
+    sk = k.shape[2]
+    nq, nk = sq // cq, sk // ck
+    offs = sk - sq
+    has_kpm = kpm is not None
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (b, G, g, sq)
+
+    def recompute_p(qi, kc, i, j):
+        s = _bw_score(qi, kc, scale)
+        keep = _blockwise_masks(i, j, cq, ck, offs, causal, window)
+        lse_i = jax.lax.dynamic_slice_in_dim(lse, i * cq, cq, axis=3)
+        p = jnp.exp(s - lse_i[..., None])
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        if has_kpm:
+            p = jnp.where(_kpm_chunk_keep(kpm, j, ck), p, 0.0)
+        return p
+
+    # dq: per q chunk, accumulate over its kv band
+    def dq_step(_, i):
+        qi = jax.lax.dynamic_slice_in_dim(q5, i * cq, cq, axis=3)
+        doi = jax.lax.dynamic_slice_in_dim(do, i * cq, cq, axis=3)
+        di = jax.lax.dynamic_slice_in_dim(delta, i * cq, cq, axis=3)
+        lo, hi = _blockwise_kv_bounds(i, cq, ck, nk, offs, causal, window)
+
+        def kv_step(j, dq_i):
+            kc = jax.lax.dynamic_slice_in_dim(k, j * ck, ck, axis=2)
+            vc = jax.lax.dynamic_slice_in_dim(v, j * ck, ck, axis=2)
+            p = recompute_p(qi, kc, i, j)
+            dp = jnp.einsum(
+                "bGgqd,bGkd->bGgqk", doi, vc, preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - di[..., None]) * scale
+            return dq_i + jnp.einsum(
+                "bGgqk,bGkd->bGgqd", ds.astype(kc.dtype), kc,
+                preferred_element_type=jnp.float32,
+            )
+
+        dq_i = jax.lax.fori_loop(
+            lo, hi, kv_step, jnp.zeros((b, h_kv, g, cq, d), jnp.float32)
+        )
+        return None, dq_i
+
+    _, dq_chunks = jax.lax.scan(dq_step, None, jnp.arange(nq))
+    dq = jnp.moveaxis(dq_chunks, 0, 3).reshape(b, h_kv, g, sq, d)
+
+    # dk/dv: per kv chunk, accumulate over the q band (group summed)
+    def dkv_step(_, j):
+        kc = jax.lax.dynamic_slice_in_dim(k, j * ck, ck, axis=2)
+        vc = jax.lax.dynamic_slice_in_dim(v, j * ck, ck, axis=2)
+        lo, hi = _q_band(j, cq, ck, nq, causal, window, offs)
+
+        def q_step(i, carry):
+            dk_j, dv_j = carry
+            qi = jax.lax.dynamic_slice_in_dim(q5, i * cq, cq, axis=3)
+            doi = jax.lax.dynamic_slice_in_dim(do, i * cq, cq, axis=3)
+            di = jax.lax.dynamic_slice_in_dim(delta, i * cq, cq, axis=3)
+            p = recompute_p(qi, kc, i, j)
+            dv_j = dv_j + jnp.einsum(
+                "bGgqk,bGgqd->bGkd", p.astype(doi.dtype), doi,
+                preferred_element_type=jnp.float32,
+            )
+            dp = jnp.einsum(
+                "bGgqd,bGkd->bGgqk", doi, vc, preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - di[..., None]) * scale
+            dk_j = dk_j + jnp.einsum(
+                "bGgqk,bGgqd->bGkd", ds.astype(qi.dtype), qi,
+                preferred_element_type=jnp.float32,
+            )
+            return dk_j, dv_j
+
+        init = (
+            jnp.zeros((b, h_kv, ck, d), jnp.float32),
+            jnp.zeros((b, h_kv, ck, d), jnp.float32),
+        )
+        dk_j, dv_j = jax.lax.fori_loop(lo, hi, q_step, init)
+        return None, (dk_j, dv_j)
+
+    _, (dk_chunks, dv_chunks) = jax.lax.scan(dkv_step, None, jnp.arange(nk))
+    dk = jnp.moveaxis(dk_chunks, 0, 2).reshape(b, h_kv, sk, d).astype(k.dtype)
+    dv = jnp.moveaxis(dv_chunks, 0, 2).reshape(b, h_kv, sk, d).astype(v.dtype)
+    return dq.astype(q5.dtype), (dk, dv), None
+
+
+_blockwise.defvjp(_blockwise_fwd_res, _blockwise_bwd)
+
+
+def _attn_blockwise(q, k, v, scale, causal, window, kpm, chunk_q, chunk_k):
+    """Long-context attention by (cq, ck) tiles: O(sq·d) state + one score
+    tile live at a time. GQA-grouped, key-padding aware, rectangular-causal
+    (bottom-right) like the rest of this module."""
+    b, h, sq, d = q.shape
+    h_kv, sk = k.shape[1], k.shape[2]
+    group = h // h_kv
+    cq = _bw_chunk(sq, chunk_q)
+    ck = _bw_chunk(sk, chunk_k)
+    q5 = q.reshape(b, h_kv, group, sq, d)
+    o = _blockwise(q5, (k, v), kpm, scale, causal, window, cq, ck)
+    return o.reshape(b, h, sq, d)
+
+
 def flash_attention(
     q,
     k,
@@ -441,16 +685,40 @@ def flash_attention(
             raise ValueError(f"window must be >= 1, got {window}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    kpm_i = (
+        None
+        if key_padding_mask is None
+        else key_padding_mask.astype(jnp.int32)  # (b, sk), 1 = padded
+    )
+    if impl == "blockwise":
+        if mask is not None:
+            raise ValueError("blockwise path takes key_padding_mask, not mask")
+        return _attn_blockwise(
+            q, k, v, scale, causal, window, kpm_i, 8 * block_q, 8 * block_k
+        )
     use_pallas, interpret = resolve_impl(impl)
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    esize = jnp.dtype(q.dtype).itemsize
+    kv_resident = 2 * sk * d * esize < _KV_RESIDENT_BYTES
     pallas_ok = (
         use_pallas
         and mask is None
         and sq % bq == 0
         and sk % bk == 0
         and (not causal or sq == sk)
+        and kv_resident
     )
+    # long-context autodispatch: whenever the kernel is out (K/V past the
+    # VMEM-residency budget, or any other pallas_ok reason) AND the dense
+    # fallback's full fp32 score tensor would blow its budget, tile instead
+    if mask is None and not pallas_ok and (
+        (use_pallas and not kv_resident)
+        or 4 * b * h * sq * sk > _SCORE_BYTES
+    ):
+        return _attn_blockwise(
+            q, k, v, scale, causal, window, kpm_i, 8 * block_q, 8 * block_k
+        )
     if not pallas_ok:
         if key_padding_mask is not None:
             kp = key_padding_mask[:, None, None, :]  # (b, 1, 1, sk)
@@ -464,12 +732,7 @@ def flash_attention(
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h_kv, sk, d)
     v3 = v.reshape(b * h_kv, sk, d)
-    kpm = (
-        None
-        if key_padding_mask is None
-        else key_padding_mask.astype(jnp.int32)  # (b, sk), 1 = padded
-    )
     o = _flash(
-        q3, (k3, v3), kpm, h, group, scale, causal, interpret, bq, bk, window
+        q3, (k3, v3), kpm_i, h, group, scale, causal, interpret, bq, bk, window
     )
     return o.reshape(b, h, sq, d)

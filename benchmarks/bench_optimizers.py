@@ -230,6 +230,33 @@ def bench_attention(batch, heads, seq, dim, key):
     return out
 
 
+def bench_attention_long(key, batch=1, heads=8, seq=16384, dim=128):
+    """Single-chip long context: at 16k bf16 keys the kernel's resident-K/V
+    budget is exceeded, so auto dispatch runs the blockwise tiled path —
+    this row records what that path actually costs per step on hardware
+    (and would OOM/page with the dense XLA fallback)."""
+    from apex_tpu.ops.attention import flash_attention
+
+    q = jax.random.normal(key, (batch, heads, seq, dim), jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 1), q.shape, jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 2), q.shape, jnp.bfloat16)
+
+    def build(n):
+        def run(q, k, v):
+            def body(c, _):
+                return flash_attention(c, k, v, causal=True, impl="blockwise"), None
+
+            c, _ = jax.lax.scan(body, q, None, length=n)
+            return _scalar(c)
+
+        return run
+
+    sec = chained_seconds_per_iter(build, (q, k, v), reps=2)
+    # causal flops: 2 dots x b h s^2/2 d x 2 (MACs)
+    tflops = 2 * 2 * batch * heads * (seq * seq / 2) * dim / sec / 1e12
+    return {"blockwise": sec, "tflops": round(tflops, 1)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--params", type=int, default=None,

@@ -84,6 +84,7 @@ def run_micro():
     rec["l2norm_s"] = bo.bench_l2norm(tree, grads)
     rec["layer_norm_s"] = bo.bench_layer_norm(8192, 4096, jax.random.fold_in(key, 7))
     rec["attention_s"] = bo.bench_attention(4, 16, 2048, 128, jax.random.fold_in(key, 8))
+    rec["attention_16k_s"] = bo.bench_attention_long(jax.random.fold_in(key, 9))
     return rec
 
 
