@@ -23,7 +23,12 @@ from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.parallel import mappings
 from apex_tpu.parallel import pipeline
 from apex_tpu.parallel import random
-from apex_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+from apex_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
 from apex_tpu.parallel.utils import (
     VocabUtility,
     broadcast_data,
@@ -47,6 +52,8 @@ __all__ = [
     "random",
     "ring_attention",
     "ulysses_attention",
+    "zigzag_shard",
+    "zigzag_unshard",
     "VocabUtility",
     "broadcast_data",
     "split_tensor_along_last_dim",

@@ -27,13 +27,15 @@ Two strategies, both exact:
   Pallas flash kernel locally, and repartition back. Cheaper collectives
   for moderate contexts; requires heads % cp == 0.
 
-Causal handling in the ring: the chunk from rank j attends against local
-queries of rank i with (j < i) → full block, (j == i) → causal block,
-(j > i) → skipped entirely (``_chunk_contributes`` + ``lax.cond``; sliding
-windows additionally skip chunks behind the band). Ranks with higher
-indices still do more work per rotation — the standard ring-attention
-causal imbalance; zigzag load-balanced chunk ordering is a planned
-optimization.
+Causal handling in the ring: masks and chunk skipping are driven by GLOBAL
+position vectors (``_positions``/``_band_keep``), so chunk layout is a
+parameter. Contiguous layout keeps the classic behavior — chunk j vs local
+queries of rank i: (j < i) full, (j == i) causal, (j > i) skipped entirely
+(``_chunk_contributes`` + ``lax.cond``; sliding windows additionally skip
+chunks behind the band) — but late ranks do more work per lockstep
+rotation. ``zigzag=True`` (with ``zigzag_shard``-prepared inputs) gives
+every rank one early and one late sequence piece, equalizing per-rotation
+causal work across ranks.
 """
 
 import functools
@@ -54,45 +56,65 @@ def _rotate(tree, axis_name: str):
     )
 
 
-def _allow_mask(sq: int, kv_lo, bk: int, src, rank, causal: bool,
-                window=None):
-    """Keep-mask (sq, bk) for queries vs the kv block starting at chunk-
-    local offset ``kv_lo`` of the chunk from rank ``src`` (traced).
+def _positions(src, num_ranks, s_local: int, zigzag: bool):
+    """(s_local,) GLOBAL sequence positions of rank ``src``'s chunk
+    (``src`` may be traced).
 
-    With a sliding ``window`` the band is evaluated in GLOBAL positions
-    (query row rank*sq + i vs key col src*sq + kv_lo + j; equal shard
-    sizes are a ring invariant), composing with the causal cross-rank
-    triangle."""
+    - contiguous (zigzag=False): rank r holds rows [r*s, (r+1)*s).
+    - zigzag: the sequence is cut into 2P pieces and rank r holds pieces
+      (r, 2P-1-r) concatenated — the causal-ring load balance: every rank
+      owns one early and one late piece, so per-rotation work is equal
+      instead of growing with rank index."""
+    if not zigzag:
+        return src * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    half = s_local // 2
+    base = jnp.arange(half, dtype=jnp.int32)
+    return jnp.concatenate([
+        src * half + base,
+        (2 * num_ranks - 1 - src) * half + base,
+    ])
+
+
+def _band_keep(rows, cols, causal: bool, window=None):
+    """Keep-mask (len(rows), len(cols)) from GLOBAL positions, or None when
+    nothing is masked. One band definition for both chunk layouts."""
     if not causal and window is None:
         return None
-    rows = jnp.arange(sq)[:, None]
-    cols = kv_lo + jnp.arange(bk)[None, :]
-    if window is None:
-        tri = cols <= rows
-        return jnp.where(src < rank, True, jnp.where(src == rank, tri, False))
-    grow = rank * sq + rows
-    gcol = src * sq + cols
-    keep = gcol > grow - window
+    r = rows[:, None]
+    c = cols[None, :]
+    keep = jnp.bool_(True)
     if causal:
-        keep = jnp.logical_and(keep, gcol <= grow)
+        keep = jnp.logical_and(keep, c <= r)
+    if window is not None:
+        keep = jnp.logical_and(keep, c > r - window)
     return keep
 
 
-def _chunk_contributes(src, rank, sq: int, causal: bool, window):
-    """Whether rank ``src``'s chunk intersects the local queries' band —
-    the visiting chunk is SKIPPED entirely (lax.cond) otherwise, making a
-    windowed ring cost O(window + sq) keys per rank instead of O(seq).
-    The ring still rotates every chunk (topology), only compute is saved."""
+def _chunk_contributes(rows, cols, causal: bool, window, pieces: int = 1):
+    """Whether the visiting chunk's band intersects the local queries —
+    the chunk is SKIPPED entirely (lax.cond) otherwise, making a windowed
+    ring cost O(window + sq) keys per rank instead of O(seq).
+
+    ``pieces`` is the number of CONTIGUOUS position runs per chunk (1
+    contiguous, 2 zigzag). Bounds are evaluated per piece pair — a single
+    min/max over a split zigzag chunk would span nearly the whole
+    sequence and never skip anything, losing the windowed ring's
+    O(window) scaling. Within a piece positions ascend, so min/max are
+    its end elements."""
     if window is None and not causal:
         return jnp.bool_(True)
-    s0 = src * sq
-    r0 = rank * sq
-    ok = jnp.bool_(True)
+    r = rows.reshape(pieces, -1)
+    c = cols.reshape(pieces, -1)
+    rmin, rmax = r[:, 0], r[:, -1]
+    cmin, cmax = c[:, 0], c[:, -1]
+    pair_ok = jnp.ones((pieces, pieces), bool)
     if causal:
-        ok = jnp.logical_and(ok, s0 <= r0 + sq - 1)
+        pair_ok = jnp.logical_and(pair_ok, cmin[None, :] <= rmax[:, None])
     if window is not None:
-        ok = jnp.logical_and(ok, s0 + sq - 1 >= r0 - window + 1)
-    return ok
+        pair_ok = jnp.logical_and(
+            pair_ok, cmax[None, :] > rmin[:, None] - window
+        )
+    return jnp.any(pair_ok)
 
 
 def _chunk_block_size(s_local: int, block_size: int) -> int:
@@ -102,14 +124,15 @@ def _chunk_block_size(s_local: int, block_size: int) -> int:
     return bk
 
 
-def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size, window=None):
+def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal, block_size, window=None):
     """Stream one visiting K/V chunk through the online softmax in
-    ``block_size`` slices. state = (acc, m, l) accumulated so far.
+    ``block_size`` slices. state = (acc, m, l) accumulated so far;
+    ``rows``/``cols`` are the global positions of the local queries and
+    the visiting keys (any layout).
 
     Dot operands KEEP the input dtype (bf16 stays bf16) with fp32
     accumulation — upcasting before the einsum forces the MXU's slow fp32
     path (same policy as ops/attention.py); softmax math stays fp32."""
-    sq = q.shape[-2]
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
@@ -123,7 +146,10 @@ def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size,
             jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _allow_mask(sq, lo, bk, src, rank, causal, window)
+        allow = _band_keep(
+            rows, jax.lax.dynamic_slice_in_dim(cols, lo, bk, axis=0),
+            causal, window,
+        )
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -145,16 +171,19 @@ def _online_chunk_update(state, q, kc, vc, scale, src, rank, causal, block_size,
     return state
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring(q, k, v, axis_name, causal, scale, block_size, window):
-    o, _ = _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring(q, k, v, axis_name, causal, scale, block_size, window, zigzag):
+    o, _ = _ring_fwd_res(
+        q, k, v, axis_name, causal, scale, block_size, window, zigzag
+    )
     return o
 
 
-def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window):
+def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window, zigzag):
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    rows = _positions(rank, num_ranks, sq, zigzag)
 
     init_state = (
         jnp.zeros((b, h, sq, d), jnp.float32),
@@ -163,17 +192,19 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window):
     )
     # step 0 on the resident chunk — no rotation needed
     state = _online_chunk_update(
-        init_state, q, k, v, scale, rank, rank, causal, block_size, window
+        init_state, q, k, v, scale, rows, rows, causal, block_size, window
     )
 
     def step(carry, t):
         (kc, vc), state = carry
         kc, vc = _rotate((kc, vc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
+        cols = _positions(src, num_ranks, sq, zigzag)
         state = jax.lax.cond(
-            _chunk_contributes(src, rank, sq, causal, window),
+            _chunk_contributes(rows, cols, causal, window,
+                               2 if zigzag else 1),
             lambda st: _online_chunk_update(
-                st, q, kc, vc, scale, src, rank, causal, block_size, window
+                st, q, kc, vc, scale, rows, cols, causal, block_size, window
             ),
             lambda st: st,
             state,
@@ -191,12 +222,11 @@ def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window):
     return o, (q, k, v, o, lse)
 
 
-def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
-                      causal, block_size, window=None):
+def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
+                      cols, causal, block_size, window=None):
     """Blockwise gradient contributions of one visiting K/V chunk.
     Operand-dtype policy as in _online_chunk_update; dkc/dvc/dq accumulate
     in fp32."""
-    sq = q.shape[-2]
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
@@ -210,7 +240,10 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
             jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _allow_mask(sq, lo, bk, src, rank, causal, window)
+        allow = _band_keep(
+            rows, jax.lax.dynamic_slice_in_dim(cols, lo, bk, axis=0),
+            causal, window,
+        )
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
         p = jnp.exp(s - lse[..., None])
@@ -248,11 +281,12 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, src, rank,
     return dkc, dvc, dq
 
 
-def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
+def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
     q, k, v, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     sq = q.shape[-2]
+    rows = _positions(rank, num_ranks, sq, zigzag)
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (b, h, sq)
@@ -262,7 +296,7 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
     dq0 = jnp.zeros(q.shape, jnp.float32)
     # step 0 on the resident chunk
     dk0, dv0, dq = _chunk_bwd_update(
-        q, do, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rank, rank,
+        q, do, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rows, rows,
         causal, block_size, window,
     )
 
@@ -271,11 +305,13 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, res, do):
         # dK/dV ride the ring with their chunks
         kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
+        cols = _positions(src, num_ranks, sq, zigzag)
         dkc, dvc, dq = jax.lax.cond(
-            _chunk_contributes(src, rank, sq, causal, window),
+            _chunk_contributes(rows, cols, causal, window,
+                               2 if zigzag else 1),
             lambda ops: _chunk_bwd_update(
                 q, do, delta, lse, kc, vc, ops[0], ops[1], ops[2], scale,
-                src, rank, causal, block_size, window,
+                rows, cols, causal, block_size, window,
             ),
             lambda ops: ops,
             (dkc, dvc, dq),
@@ -305,24 +341,66 @@ def ring_attention(
     scale: float = None,
     block_size: int = 512,
     window: int = None,
+    zigzag: bool = False,
 ):
     """Exact sequence-sharded attention over the ``axis_name`` ring.
 
     q, k, v: (batch, heads, seq_local, head_dim) — the local chunk of a
-    sequence sharded in rank order over the cp axis. Call inside
-    ``shard_map``. ``block_size`` bounds the K/V slice processed at once
-    (local memory O(seq_local x block_size)). Returns the local output
-    chunk; grads flow through a second ring pass (see module docstring).
+    sequence sharded over the cp axis. Call inside ``shard_map``.
+    ``block_size`` bounds the K/V slice processed at once (local memory
+    O(seq_local x block_size)). Returns the local output chunk; grads flow
+    through a second ring pass (see module docstring).
 
     ``window`` (sliding-window, causal only) bands attention in GLOBAL
     positions across the ring's chunks — long-context mistral-style
     attention sharded over cp.
+
+    ``zigzag`` (causal load balance): shards carry pieces (r, 2P-1-r) of
+    the sequence instead of contiguous chunks — prepare them with
+    ``zigzag_shard`` and restore outputs with ``zigzag_unshard``. Under
+    contiguous causal sharding, rank r touches r+1 chunks per pass while
+    the masks kill the rest, so late ranks dominate the lockstep ring;
+    zigzag gives every rank one early and one late piece, equalizing
+    per-rotation work (~2x less wasted compute at large P).
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True (mistral semantics)")
+    if zigzag and q.shape[-2] % 2:
+        raise ValueError("zigzag needs an even per-rank sequence length")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _ring(q, k, v, axis_name, causal, scale, block_size, window)
+    return _ring(q, k, v, axis_name, causal, scale, block_size, window, zigzag)
+
+
+def _zigzag_index(s: int, num_ranks: int):
+    """Permutation placing pieces (r, 2P-1-r) consecutively for each r —
+    the single source of the zigzag order for shard AND unshard."""
+    if s % (2 * num_ranks):
+        raise ValueError(
+            f"sequence ({s}) not divisible by 2*cp ({2 * num_ranks})"
+        )
+    half = s // (2 * num_ranks)
+    return jnp.concatenate([
+        jnp.concatenate([
+            r * half + jnp.arange(half),
+            (2 * num_ranks - 1 - r) * half + jnp.arange(half),
+        ])
+        for r in range(num_ranks)
+    ])
+
+
+def zigzag_shard(x, num_ranks: int, axis: int = -2):
+    """Reorder a GLOBAL sequence axis so a contiguous cp shard hands rank r
+    the zigzag pieces (r, 2P-1-r). Apply before sharding inputs (and to
+    targets/position ids that must stay aligned); invert with
+    ``zigzag_unshard``."""
+    return jnp.take(x, _zigzag_index(x.shape[axis], num_ranks), axis=axis)
+
+
+def zigzag_unshard(x, num_ranks: int, axis: int = -2):
+    """Inverse of ``zigzag_shard`` on the same global axis."""
+    inv = jnp.argsort(_zigzag_index(x.shape[axis], num_ranks))
+    return jnp.take(x, inv, axis=axis)
 
 
 def ulysses_attention(

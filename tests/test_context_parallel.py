@@ -17,7 +17,12 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops.attention import flash_attention
 from apex_tpu.parallel import parallel_state
-from apex_tpu.parallel.ring_attention import ring_attention, ulysses_attention
+from apex_tpu.parallel.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+    zigzag_shard,
+    zigzag_unshard,
+)
 
 B, H, D = 2, 4, 8
 SEQ = 32
@@ -57,6 +62,74 @@ class TestRingAttention:
         np.testing.assert_allclose(
             run(q, k, v), full_reference(q, k, v, causal), rtol=2e-4, atol=2e-5
         )
+
+    def test_zigzag_shard_roundtrip(self, rng):
+        x = jax.random.normal(rng, (B, H, SEQ, D))
+        for cp in (2, 4, 8):
+            z = zigzag_shard(x, cp)
+            assert z.shape == x.shape
+            np.testing.assert_array_equal(
+                np.asarray(zigzag_unshard(z, cp)), np.asarray(x)
+            )
+        # rank 0's shard is pieces (0, 2P-1): first piece of the sequence
+        # followed by the last
+        cp, half = 4, SEQ // 8
+        z = zigzag_shard(x, cp)
+        np.testing.assert_array_equal(
+            np.asarray(z[..., :half, :]), np.asarray(x[..., :half, :])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(z[..., half : 2 * half, :]),
+            np.asarray(x[..., -half:, :]),
+        )
+
+    @pytest.mark.parametrize("cp", [4, 8])
+    @pytest.mark.parametrize("window", [None, 12])
+    def test_zigzag_matches_single_device(self, rng, cp, window):
+        """Load-balanced layout == contiguous math: zigzag_shard -> ring
+        (zigzag=True) -> zigzag_unshard equals full single-device causal
+        attention, forward and grads."""
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv, kc = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, H, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, H, SEQ, D), jnp.float32)
+        ct = jax.random.normal(kc, (B, H, SEQ, D), jnp.float32)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(seq_spec(),) * 3,
+            out_specs=seq_spec(), check_vma=False,
+        )
+        def run_local(q, k, v):
+            return ring_attention(
+                q, k, v, axis_name="cp", causal=True, window=window,
+                zigzag=True, block_size=8,
+            )
+
+        def run(q, k, v):
+            zq, zk, zv = (zigzag_shard(t, cp) for t in (q, k, v))
+            return zigzag_unshard(run_local(zq, zk, zv), cp)
+
+        ref = flash_attention(q, k, v, causal=True, window=window, impl="xla")
+        np.testing.assert_allclose(
+            run(q, k, v), ref, rtol=2e-4, atol=2e-5
+        )
+
+        gz = jax.grad(lambda q, k, v: jnp.sum(run(q, k, v) * ct), (0, 1, 2))(
+            q, k, v
+        )
+        gr = jax.grad(
+            lambda q, k, v: jnp.sum(
+                flash_attention(q, k, v, causal=True, window=window,
+                                impl="xla") * ct
+            ),
+            (0, 1, 2),
+        )(q, k, v)
+        for a, b in zip(gz, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
     @pytest.mark.parametrize("window", [3, 12, 100])
     def test_sliding_window_matches_single_device(self, rng, window):
