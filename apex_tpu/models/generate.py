@@ -1,14 +1,19 @@
 """Autoregressive decoding for the causal LMs.
 
-Extension beyond the reference (apex has no inference path); kept
-deliberately simple and jit-correct: a fixed-size token buffer is filled
-one position per scan step and the model recomputes the full prefix each
-step (O(S^2) per sequence — evaluation/demo grade, not a serving engine).
-Causality makes the garbage beyond the current length invisible to the
-logits that matter, so no masking bookkeeping is needed.
+Extension beyond the reference (apex has no inference path). Two modes:
+
+- ``use_cache=True`` (default): one prefill pass writes rotated K/V into
+  per-layer "cache" variables (transformer/layer.py ParallelAttention),
+  then each new token runs the model at sequence length 1 against the
+  cache through the flash key-padding fast path — O(S) attention per
+  token instead of O(S^2), the standard KV-cache decode.
+- ``use_cache=False``: the model recomputes the full prefix each step
+  (O(S^2) per token). Kept as the reference path the cache is tested
+  against, and for models without cache support.
 
 Parity: tests/test_hf_parity.py pins greedy continuations against HF
-``generate(do_sample=False)`` on the same imported weights.
+``generate(do_sample=False)`` on the same imported weights; cached and
+uncached decode are asserted token-identical.
 """
 
 from typing import Optional
@@ -19,6 +24,12 @@ import jax.numpy as jnp
 __all__ = ["generate"]
 
 
+def _select_next(next_logits, temperature, key):
+    if temperature > 0.0:
+        return jax.random.categorical(key, next_logits / temperature, axis=-1)
+    return jnp.argmax(next_logits, axis=-1)
+
+
 def generate(
     model,
     variables,
@@ -26,6 +37,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
+    use_cache: bool = True,
 ):
     """Continue ``prompt_tokens`` ((b, s) int32) by ``max_new_tokens``.
 
@@ -34,8 +46,11 @@ def generate(
     """
     b, s = prompt_tokens.shape
     total = s + max_new_tokens
+    if max_new_tokens <= 0:
+        return prompt_tokens
     max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
-    if max_pos is not None and total > max_pos:
+    # rope models may leave the field at its 0 default (no position table)
+    if max_pos and total > max_pos:
         # out-of-range positions would be silently CLAMPED by the gather
         # (jnp.take clips), yielding garbage continuations — fail loudly
         raise ValueError(
@@ -50,6 +65,45 @@ def generate(
     buf = jnp.zeros((b, total), prompt_tokens.dtype)
     buf = jax.lax.dynamic_update_slice(buf, prompt_tokens, (0, 0))
 
+    if use_cache:
+        # prefill: prompt logits + per-layer K/V cache sized for the run
+        logits, state = model.apply(
+            variables, prompt_tokens, cache_len=total, mutable=["cache"]
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _select_next(
+            logits[:, s - 1, :].astype(jnp.float32), temperature, sub
+        ).astype(buf.dtype)
+        buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, s))
+
+        def step(carry, _):
+            buf, cache, tok, cur, key = carry
+            logits, updated = model.apply(
+                {**variables, "cache": cache},
+                tok[:, None],
+                position_ids=cur[None, None],  # learned-position models
+                # cache_len sizes the rope table; the config's
+                # max_position_embeddings may legitimately be 0 for rope
+                cache_len=total,
+                decode_step=True,
+                mutable=["cache"],
+            )
+            key, sub = jax.random.split(key)
+            nxt = _select_next(
+                logits[:, 0, :].astype(jnp.float32), temperature, sub
+            ).astype(buf.dtype)
+            buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, cur + 1))
+            return (buf, updated["cache"], nxt, cur + 1, key), None
+
+        if max_new_tokens > 1:
+            (buf, _, _, _, _), _ = jax.lax.scan(
+                step,
+                (buf, state["cache"], nxt, jnp.int32(s), rng),
+                None,
+                length=max_new_tokens - 1,
+            )
+        return buf
+
     def step(carry, _):
         buf, cur, key = carry
         logits = model.apply(variables, buf)  # (b, total, vocab)
@@ -59,11 +113,7 @@ def generate(
             logits, cur - 1, 1, axis=1
         )[:, 0, :].astype(jnp.float32)
         key, sub = jax.random.split(key)
-        if temperature > 0.0:
-            nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(next_logits, axis=-1)
-        nxt = nxt.astype(buf.dtype)
+        nxt = _select_next(next_logits, temperature, key=sub).astype(buf.dtype)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, cur))
         return (buf, cur + 1, key), None
 

@@ -167,8 +167,11 @@ class GPTModel(nn.Module):
         labels=None,
         loss_mask=None,
         deterministic: bool = True,
+        cache_len=None,
+        decode_step: bool = False,
     ):
         cfg = self.config
+        cache_active = cache_len is not None or decode_step
         if self.pre_process:
             h = self.embedding(tokens, position_ids, deterministic=deterministic)
         else:
@@ -183,6 +186,13 @@ class GPTModel(nn.Module):
                 # cp-sharded sequence: build the GLOBAL table; attention
                 # slices each rank's chunk (transformer/layer.py)
                 seq = seq * _tp_size(cfg.context_axis)
+            if cache_active:
+                # KV-cache decoding: the full-length table; attention slices
+                # each call's absolute positions (prefill [0, s), step
+                # [cache_index, cache_index+1))
+                seq = cache_len if cache_len is not None else (
+                    cfg.max_position_embeddings
+                )
             rotary = rotary_embedding_for(cfg, seq)
 
         h = self.transformer(
@@ -190,6 +200,11 @@ class GPTModel(nn.Module):
             attention_mask=attention_mask,
             rotary_pos_emb=rotary,
             deterministic=deterministic,
+            **(
+                {"cache_len": cache_len, "decode_step": decode_step}
+                if cache_active
+                else {}
+            ),
         )
         if not self.post_process:
             return h

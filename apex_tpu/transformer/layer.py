@@ -230,12 +230,37 @@ class ParallelAttention(nn.Module):
         rotary_pos_emb=None,
         key_padding_mask=None,
         deterministic: bool = True,
+        cache_len: Optional[int] = None,
+        decode_step: bool = False,
     ):
         cfg = self.config
         s, b, _ = hidden_states.shape
         tp = _tp_size(cfg.tensor_axis)
         np_local = cfg.num_attention_heads // tp
         hn = cfg.kv_channels
+
+        cache_active = cache_len is not None or decode_step
+        if cache_active:
+            # KV-cache decoding (extension: the reference has no inference
+            # path). Prefill (cache_len=N): normal causal attention over the
+            # prompt + rotated K/V written into "cache" variables. Step
+            # (decode_step): one new token attends the cache through the
+            # flash key-padding fast path. TP shards the cache with the
+            # heads; SP/CP/cross-attention have no decode meaning here.
+            if self.attn_type != AttnType.self_attn:
+                raise NotImplementedError("KV cache is self-attention only")
+            if cfg.sequence_parallel and tp > 1:
+                raise NotImplementedError("KV-cache decode with sequence "
+                                          "parallelism is unsupported")
+            if cfg.context_parallel_mode is not None:
+                raise NotImplementedError("KV-cache decode under context "
+                                          "parallelism is unsupported")
+            if attention_mask is not None or key_padding_mask is not None:
+                raise NotImplementedError("KV-cache decode computes its own "
+                                          "masks")
+            if decode_step and not self.has_variable("cache", "cached_key"):
+                raise ValueError("decode_step before prefill: call once with "
+                                 "cache_len=<total length> first")
 
         groups = cfg.num_query_groups or cfg.num_attention_heads
         if groups != cfg.num_attention_heads and self.attn_type != AttnType.self_attn:
@@ -315,6 +340,10 @@ class ParallelAttention(nn.Module):
             _tp_size(cfg.context_axis) if cfg.context_parallel_mode is not None else 1
         )
 
+        cache_index = None
+        if decode_step:
+            cache_index = self.get_variable("cache", "cache_index")
+
         if rotary_pos_emb is not None:
             q_pos_emb, k_pos_emb = rotary_pos_emb
             if cp > 1:
@@ -330,6 +359,12 @@ class ParallelAttention(nn.Module):
 
                 q_pos_emb = _local_chunk(q_pos_emb, q.shape[0])
                 k_pos_emb = _local_chunk(k_pos_emb, k.shape[0])
+            if cache_active and q_pos_emb.shape[0] != s:
+                # cache mode passes the FULL-length table; this call covers
+                # absolute positions [pos0, pos0 + s)
+                pos0 = cache_index if decode_step else 0
+                q_pos_emb = jax.lax.dynamic_slice_in_dim(q_pos_emb, pos0, s, 0)
+                k_pos_emb = jax.lax.dynamic_slice_in_dim(k_pos_emb, pos0, s, 0)
             q = apply_rotary_pos_emb(q, q_pos_emb)
             k = apply_rotary_pos_emb(k, k_pos_emb)
 
@@ -337,6 +372,61 @@ class ParallelAttention(nn.Module):
         qb = jnp.transpose(q, (1, 2, 0, 3))
         kb = jnp.transpose(k, (1, 2, 0, 3))
         vb = jnp.transpose(v, (1, 2, 0, 3))
+
+        if cache_active:
+            h_kv_local = kb.shape[1]
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, h_kv_local, cache_len or 0, hn), kb.dtype,
+            )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, h_kv_local, cache_len or 0, hn), vb.dtype,
+            )
+            ci = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if decode_step:
+                if s != 1:
+                    raise NotImplementedError(
+                        "decode_step appends one token at a time; use a "
+                        "prefill call (cache_len=...) for multi-token blocks"
+                    )
+                idx = cache_index
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kb.astype(ck.value.dtype), (0, 0, idx, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vb.astype(cv.value.dtype), (0, 0, idx, 0)
+                )
+                ci.value = idx + 1
+                total = ck.value.shape[2]
+                pos = jnp.arange(total)
+                # pad out the unwritten future; the sliding window addition-
+                # ally drops keys behind the band (mistral decode)
+                padded = pos > idx
+                if cfg.attention_window is not None:
+                    padded = jnp.logical_or(
+                        padded, pos <= idx - cfg.attention_window
+                    )
+                kpm = jnp.broadcast_to(padded[None, :], (b, total))
+                ctx = flash_attention(
+                    qb, ck.value, cv.value, causal=False,
+                    key_padding_mask=kpm, impl=cfg.attention_impl,
+                )
+            else:
+                # prefill: record the (rotated) prompt K/V, then fall
+                # through to the normal attention paths below
+                assert s <= cache_len, (
+                    f"prompt ({s}) exceeds cache ({cache_len})"
+                )
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kb.astype(ck.value.dtype), (0, 0, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vb.astype(cv.value.dtype), (0, 0, 0, 0)
+                )
+                ci.value = jnp.asarray(s, jnp.int32)
 
         causal = self.attn_mask_type == AttnMaskType.causal
         # apply_query_key_layer_scaling cancels exactly (scores*norm/coeff
@@ -354,7 +444,9 @@ class ParallelAttention(nn.Module):
                 else jnp.logical_or(attention_mask, kp)
             )
             key_padding_mask = None
-        if cp > 1:
+        if decode_step:
+            pass  # ctx computed against the cache above
+        elif cp > 1:
             if (not use_flash or key_padding_mask is not None
                     or kb.shape[1] != qb.shape[1]):
                 raise NotImplementedError(
@@ -445,16 +537,21 @@ class ParallelTransformerLayer(nn.Module):
         rotary_pos_emb=None,
         key_padding_mask=None,
         deterministic: bool = True,
+        cache_len: Optional[int] = None,
+        decode_step: bool = False,
     ):
         cfg = self.config
         rdtype = jnp.float32 if cfg.fp32_residual_connection else hidden_states.dtype
+        cache_active = cache_len is not None or decode_step
 
         ln_out = Norm(config=cfg, name="input_layernorm")(hidden_states)
         attn_cls = ParallelAttention
-        if cfg.recompute_granularity == "selective":
+        if cfg.recompute_granularity == "selective" and not cache_active:
             # recompute only the attention block in backward (ref: Megatron
             # --recompute-granularity selective; core-attention checkpoint).
             # arg 0 is the module scope; ``deterministic`` (arg 6) is static.
+            # (decode has no backward — remat would only re-trace the cache
+            # mutation, so it is skipped in cache mode)
             attn_cls = nn.remat(
                 ParallelAttention, static_argnums=(6,), prevent_cse=False
             )
@@ -470,6 +567,11 @@ class ParallelTransformerLayer(nn.Module):
             rotary_pos_emb,
             key_padding_mask,
             deterministic,
+            **(
+                {"cache_len": cache_len, "decode_step": decode_step}
+                if cache_active
+                else {}
+            ),
         )
         residual = (
             ln_out if cfg.apply_residual_connection_post_layernorm else hidden_states
@@ -553,12 +655,16 @@ class ParallelTransformer(nn.Module):
         rotary_pos_emb=None,
         key_padding_mask=None,
         deterministic: bool = True,
+        cache_len: Optional[int] = None,
+        decode_step: bool = False,
     ):
         cfg = self.config
         n = self.num_layers if self.num_layers is not None else cfg.num_layers
+        cache_active = cache_len is not None or decode_step
         layer_cls = ParallelTransformerLayer
-        if cfg.recompute_granularity == "full":
+        if cfg.recompute_granularity == "full" and not cache_active:
             # arg 0 is the module scope; ``deterministic`` (arg 7) is static
+            # (no backward in decode — see ParallelTransformerLayer)
             layer_cls = nn.remat(
                 ParallelTransformerLayer,
                 static_argnums=(7,),
@@ -575,6 +681,11 @@ class ParallelTransformer(nn.Module):
                 rotary_pos_emb,
                 key_padding_mask,
                 deterministic,
+                **(
+                    {"cache_len": cache_len, "decode_step": decode_step}
+                    if cache_active
+                    else {}
+                ),
             )
         if self.post_layer_norm:
             hidden_states = Norm(config=cfg, name="final_layernorm")(hidden_states)

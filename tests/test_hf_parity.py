@@ -211,6 +211,89 @@ def test_greedy_generation_matches_hf(hf_llama):
     )
     np.testing.assert_array_equal(out, ref)
 
+    # the uncached reference path must agree token-for-token too
+    out_nc = np.asarray(
+        generate(model, variables, jnp.asarray(prompt), max_new_tokens=12,
+                 use_cache=False)
+    )
+    np.testing.assert_array_equal(out_nc, ref)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(position_embedding_type="learned"),
+    dict(position_embedding_type="rope", num_query_groups=2),
+    dict(position_embedding_type="rope", attention_window=5),
+])
+def test_kv_cache_decode_logits_match_full_forward(kw):
+    """Per-step decode logits through the KV cache == slicing a full
+    forward pass at the same position — exact semantics, no argmax (random
+    init leaves near-tied logits where fp reassociation flips greedy picks,
+    so token-level equality is only asserted on real imported weights
+    above)."""
+    from apex_tpu.models import GPTModel
+    from apex_tpu.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=97,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0, **kw,
+    )
+    model = GPTModel(config=cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 97)
+    variables = model.init(jax.random.PRNGKey(0), tokens[:, :1])
+
+    full = model.apply(variables, tokens)  # (b, s, vocab)
+
+    s0 = 5
+    logits, state = model.apply(
+        variables, tokens[:, :s0], cache_len=12, mutable=["cache"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full[:, :s0]), atol=2e-5
+    )
+    cache = state["cache"]
+    for pos in range(s0, 12):
+        step_logits, upd = model.apply(
+            {**variables, "cache": cache},
+            tokens[:, pos : pos + 1],
+            position_ids=jnp.full((1, 1), pos),
+            decode_step=True,
+            mutable=["cache"],
+        )
+        cache = upd["cache"]
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]), np.asarray(full[:, pos]),
+            atol=2e-5,
+            err_msg=f"decode step at position {pos} ({kw})",
+        )
+
+
+def test_generate_edge_cases():
+    """max_new_tokens=0 returns the prompt untouched (the cached path once
+    clamped the first sampled token over the last prompt token), and rope
+    models with max_position_embeddings left at its 0 default still decode
+    (the rope table is sized from the cache length, not the config)."""
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generate import generate
+    from apex_tpu.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4, vocab_size=61,
+        max_position_embeddings=0, position_embedding_type="rope",
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPTModel(config=cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (1, 6), 0, 61)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+
+    np.testing.assert_array_equal(
+        np.asarray(generate(model, variables, prompt, max_new_tokens=0)),
+        np.asarray(prompt),
+    )
+    out = generate(model, variables, prompt, max_new_tokens=4)
+    assert out.shape == (1, 10)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]), np.asarray(prompt))
+
 
 def test_qkv_regroup_roundtrip():
     from apex_tpu.models.hf_import import _regroup_qkv
