@@ -24,8 +24,31 @@ import jax.numpy as jnp
 __all__ = ["generate"]
 
 
-def _select_next(next_logits, temperature, key):
+def _filter_logits(next_logits, top_k, top_p):
+    """Standard nucleus/top-k truncation: logits outside the kept set are
+    driven to -inf so categorical sampling never picks them."""
+    if top_k is not None:
+        top_k = min(top_k, next_logits.shape[-1])  # HF clamps (default k=50)
+        kth = jnp.sort(next_logits, axis=-1)[:, -top_k][:, None]
+        next_logits = jnp.where(next_logits < kth, -jnp.inf, next_logits)
+    if top_p is not None:
+        sorted_desc = jnp.sort(next_logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        csum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p; the
+        # shifted comparison always keeps the top token
+        keep_sorted = jnp.roll(csum < top_p, 1, axis=-1).at[:, 0].set(True)
+        kept = jnp.sum(keep_sorted, axis=-1)  # per-row cutoff count
+        cutoff = jnp.take_along_axis(
+            sorted_desc, (kept - 1)[:, None], axis=-1
+        )
+        next_logits = jnp.where(next_logits < cutoff, -jnp.inf, next_logits)
+    return next_logits
+
+
+def _select_next(next_logits, temperature, key, top_k=None, top_p=None):
     if temperature > 0.0:
+        next_logits = _filter_logits(next_logits, top_k, top_p)
         return jax.random.categorical(key, next_logits / temperature, axis=-1)
     return jnp.argmax(next_logits, axis=-1)
 
@@ -38,11 +61,15 @@ def generate(
     temperature: float = 0.0,
     rng: Optional[jax.Array] = None,
     use_cache: bool = True,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ):
     """Continue ``prompt_tokens`` ((b, s) int32) by ``max_new_tokens``.
 
     ``temperature == 0``: greedy argmax. Otherwise softmax sampling at the
-    given temperature using ``rng``. Returns (b, s + max_new_tokens).
+    given temperature using ``rng``, optionally truncated to the ``top_k``
+    highest logits and/or the ``top_p`` probability nucleus (both are the
+    HF-convention semantics). Returns (b, s + max_new_tokens).
     """
     b, s = prompt_tokens.shape
     total = s + max_new_tokens
@@ -72,7 +99,8 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         nxt = _select_next(
-            logits[:, s - 1, :].astype(jnp.float32), temperature, sub
+            logits[:, s - 1, :].astype(jnp.float32), temperature, sub,
+            top_k, top_p,
         ).astype(buf.dtype)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, s))
 
@@ -90,7 +118,8 @@ def generate(
             )
             key, sub = jax.random.split(key)
             nxt = _select_next(
-                logits[:, 0, :].astype(jnp.float32), temperature, sub
+                logits[:, 0, :].astype(jnp.float32), temperature, sub,
+                top_k, top_p,
             ).astype(buf.dtype)
             buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, cur + 1))
             return (buf, updated["cache"], nxt, cur + 1, key), None
@@ -113,7 +142,9 @@ def generate(
             logits, cur - 1, 1, axis=1
         )[:, 0, :].astype(jnp.float32)
         key, sub = jax.random.split(key)
-        nxt = _select_next(next_logits, temperature, key=sub).astype(buf.dtype)
+        nxt = _select_next(
+            next_logits, temperature, key=sub, top_k=top_k, top_p=top_p
+        ).astype(buf.dtype)
         buf = jax.lax.dynamic_update_slice(buf, nxt[:, None], (0, cur))
         return (buf, cur + 1, key), None
 

@@ -247,6 +247,11 @@ class ParallelAttention(nn.Module):
             # (decode_step): one new token attends the cache through the
             # flash key-padding fast path. TP shards the cache with the
             # heads; SP/CP/cross-attention have no decode meaning here.
+            # CONTRACT: at most N - prompt_len decode steps after a
+            # cache_len=N prefill. The index is traced, so overstepping
+            # cannot raise here — the dynamic updates would clamp and
+            # silently rewrite position N-1. models.generate sizes the
+            # cache so this cannot happen; direct callers must too.
             if self.attn_type != AttnType.self_attn:
                 raise NotImplementedError("KV cache is self-attention only")
             if cfg.sequence_parallel and tp > 1:

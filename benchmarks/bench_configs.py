@@ -348,12 +348,67 @@ def bench_llama(tpu):
             "value": round(sps * batch * seq, 2), "unit": "tokens/sec"}
 
 
+def bench_decode(tpu):
+    """KV-cache decode throughput (extension config; the reference has no
+    inference path). Tokens/sec of greedy generation on the llama-flavored
+    stack, slope-timed between two generation lengths so prefill and every
+    per-call constant cancel (same methodology as the training rows)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generate import generate
+    from apex_tpu.transformer import TransformerConfig
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
+
+    common = dict(
+        hidden_dropout=0.0, attention_dropout=0.0,
+        normalization="rmsnorm", activation="swiglu",
+        add_bias_linear=False, position_embedding_type="rope",
+        share_embeddings_and_output_weights=False,
+    )
+    if tpu:
+        cfg = TransformerConfig(
+            num_layers=16, hidden_size=1024, num_attention_heads=16,
+            num_query_groups=4, ffn_hidden_size=2816, vocab_size=32000,
+            max_position_embeddings=2048, compute_dtype=jnp.bfloat16,
+            **common,
+        )
+        batch, prompt_len = 8, 128
+    else:
+        cfg = TransformerConfig(
+            num_layers=2, hidden_size=64, num_attention_heads=4,
+            num_query_groups=2, ffn_hidden_size=160, vocab_size=512,
+            # covers prompt + the span escalation's largest chain (257)
+            max_position_embeddings=512, compute_dtype=jnp.float32,
+            **common,
+        )
+        batch, prompt_len = 2, 16
+    model = GPTModel(config=cfg)
+    key = jax.random.PRNGKey(0)
+    prompt = jax.random.randint(key, (batch, prompt_len), 0, cfg.vocab_size)
+    variables = jax.jit(model.init)(key, prompt)
+
+    def build(k):
+        def run(variables, prompt):
+            out = generate(model, variables, prompt, max_new_tokens=k)
+            return jnp.sum(out.astype(jnp.float32))
+
+        return run
+
+    sec_per_tok = chained_seconds_per_iter(
+        build, (variables, prompt), reps=2, max_span=256
+    )
+    return {"config": "decode_kv_cache", "metric": "tokens_per_sec",
+            "value": round(batch / sec_per_tok, 2), "unit": "tokens/sec"}
+
+
 CONFIGS = {
     "mlp": bench_mlp,
     "dp": bench_dp_syncbn,
     "bert": bench_bert,
     "gpt": bench_gpt_tp,
     "llama": bench_llama,
+    "decode": bench_decode,
 }
 
 

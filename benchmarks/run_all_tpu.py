@@ -108,7 +108,7 @@ def run_configs():
     import bench_configs as bc
 
     out = {}
-    for name in ("mlp", "bert", "dp", "gpt", "llama"):
+    for name in ("mlp", "bert", "dp", "gpt", "llama", "decode"):
         t0 = time.time()
         out[name] = bc.CONFIGS[name](tpu=True)
         out[name]["elapsed_s"] = round(time.time() - t0, 1)
