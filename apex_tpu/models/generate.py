@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
 def _filter_logits(next_logits, top_k, top_p):
@@ -48,9 +48,27 @@ def _filter_logits(next_logits, top_k, top_p):
 
 def _select_next(next_logits, temperature, key, top_k=None, top_p=None):
     if temperature > 0.0:
-        next_logits = _filter_logits(next_logits, top_k, top_p)
-        return jax.random.categorical(key, next_logits / temperature, axis=-1)
+        # temperature BEFORE truncation (HF warper order): the nucleus is
+        # computed on the tempered distribution, so high temperatures keep
+        # more tokens — filtering raw logits would diverge from HF whenever
+        # temperature != 1
+        next_logits = _filter_logits(next_logits / temperature, top_k, top_p)
+        return jax.random.categorical(key, next_logits, axis=-1)
     return jnp.argmax(next_logits, axis=-1)
+
+
+def _check_position_bound(model, s, max_new_tokens):
+    max_pos = getattr(
+        getattr(model, "config", None), "max_position_embeddings", None
+    )
+    # rope models may leave the field at its 0 default (no position table)
+    if max_pos and s + max_new_tokens > max_pos:
+        # out-of-range positions would be silently CLAMPED by the gather
+        # (jnp.take clips), yielding garbage continuations — fail loudly
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+            f"model's max_position_embeddings ({max_pos})"
+        )
 
 
 def generate(
@@ -75,15 +93,7 @@ def generate(
     total = s + max_new_tokens
     if max_new_tokens <= 0:
         return prompt_tokens
-    max_pos = getattr(getattr(model, "config", None), "max_position_embeddings", None)
-    # rope models may leave the field at its 0 default (no position table)
-    if max_pos and total > max_pos:
-        # out-of-range positions would be silently CLAMPED by the gather
-        # (jnp.take clips), yielding garbage continuations — fail loudly
-        raise ValueError(
-            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
-            f"model's max_position_embeddings ({max_pos})"
-        )
+    _check_position_bound(model, s, max_new_tokens)
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if rng is None:
@@ -152,3 +162,98 @@ def generate(
         step, (buf, jnp.int32(s), rng), None, length=max_new_tokens
     )
     return buf
+
+
+def beam_search(
+    model,
+    variables,
+    prompt_tokens,
+    max_new_tokens: int,
+    num_beams: int,
+    length_penalty: float = 1.0,
+):
+    """Beam-search decoding over the KV cache.
+
+    Standard fixed-width beam search: the prompt is prefilled once per
+    batch row, the cache is expanded to ``b*num_beams`` rows, and every
+    step scores all ``num_beams * vocab`` continuations, keeps the top
+    ``num_beams``, and REORDERS the cache rows to follow their beams (the
+    jnp.take on the cache pytree is the TPU analogue of HF's
+    ``_reorder_cache``). Returns ``(tokens, scores)`` with tokens
+    (b, num_beams, s + max_new_tokens) sorted best-first and scores the
+    length-normalized sequence log-probs (sum logp / len^length_penalty,
+    the HF convention).
+
+    No early stopping / EOS handling: the models here have no reserved
+    tokens; generation always runs ``max_new_tokens`` steps.
+    """
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    b, s = prompt_tokens.shape
+    total = s + max_new_tokens
+    if max_new_tokens > 0:
+        _check_position_bound(model, s, max_new_tokens)
+    if max_new_tokens <= 0:
+        scores = jnp.zeros((b, num_beams), jnp.float32)
+        return jnp.broadcast_to(
+            prompt_tokens[:, None, :], (b, num_beams, s)
+        ), scores
+    k = num_beams
+
+    # prefill once per row, then tile rows to beams
+    logits, state = model.apply(
+        variables, prompt_tokens, cache_len=total, mutable=["cache"]
+    )
+    logp0 = jax.nn.log_softmax(logits[:, s - 1, :].astype(jnp.float32), -1)
+    vocab = logp0.shape[-1]
+    first = jax.lax.top_k(logp0, k)  # (b, k) values/indices
+
+    def tile_beams(x):
+        # row r -> beams r*k .. r*k+k-1; scalar bookkeeping leaves
+        # (cache_index) are shared by all beams and stay as they are
+        return jnp.repeat(x, k, axis=0) if x.ndim else x
+
+    cache = jax.tree_util.tree_map(tile_beams, state["cache"])
+    buf = jnp.zeros((b * k, total), prompt_tokens.dtype)
+    buf = jax.lax.dynamic_update_slice(buf, tile_beams(prompt_tokens), (0, 0))
+    buf = buf.at[:, s].set(first[1].reshape(b * k))
+    scores = first[0].reshape(b * k)  # cumulative log-prob per beam
+    tok = first[1].reshape(b * k)
+
+    def step(carry, _):
+        buf, cache, tok, cur, scores = carry
+        logits, upd = model.apply(
+            {**variables, "cache": cache},
+            tok[:, None],
+            position_ids=cur[None, None],
+            cache_len=total,
+            decode_step=True,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(logits[:, 0, :].astype(jnp.float32), -1)
+        # (b, k*vocab) joint scores; top-k per batch row
+        joint = (scores[:, None] + logp).reshape(b, k * vocab)
+        best, flat_idx = jax.lax.top_k(joint, k)  # (b, k)
+        src_beam = flat_idx // vocab              # which beam it extends
+        nxt = (flat_idx % vocab).reshape(b * k)
+        rows = (jnp.arange(b)[:, None] * k + src_beam).reshape(b * k)
+        # follow the winning beams: reorder history, cache, and scores
+        buf = jnp.take(buf, rows, axis=0)
+        cache = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, rows, axis=0) if x.ndim else x,
+            upd["cache"],
+        )
+        buf = jax.lax.dynamic_update_slice(
+            buf, nxt[:, None].astype(buf.dtype), (0, cur + 1)
+        )
+        return (buf, cache, nxt.astype(tok.dtype), cur + 1,
+                best.reshape(b * k)), None
+
+    if max_new_tokens > 1:
+        (buf, _, _, _, scores), _ = jax.lax.scan(
+            step, (buf, cache, tok, jnp.int32(s), scores), None,
+            length=max_new_tokens - 1,
+        )
+    norm = scores / (max_new_tokens ** length_penalty)
+    # beams are already best-first per batch row (top_k sorts descending)
+    return buf.reshape(b, k, total), norm.reshape(b, k)

@@ -358,7 +358,10 @@ def bench_decode(tpu):
     from apex_tpu.models import GPTModel
     from apex_tpu.models.generate import generate
     from apex_tpu.transformer import TransformerConfig
-    from apex_tpu.utils.benchmarking import chained_seconds_per_iter
+    from apex_tpu.utils.benchmarking import (
+        chained_seconds_per_iter,
+        full_reduce,
+    )
 
     common = dict(
         hidden_dropout=0.0, attention_dropout=0.0,
@@ -391,7 +394,7 @@ def bench_decode(tpu):
     def build(k):
         def run(variables, prompt):
             out = generate(model, variables, prompt, max_new_tokens=k)
-            return jnp.sum(out.astype(jnp.float32))
+            return full_reduce(out)
 
         return run
 

@@ -7,6 +7,7 @@ per-token loss.  Catches qkv-packing, gelu-flavor, LN-placement, scale, and
 tying bugs that self-referential tests cannot see.
 """
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -342,6 +343,69 @@ def test_topk_topp_filtering():
     # top_k=1 at any temperature IS greedy
     ref = generate(model, variables, prompt, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+class _MarkovLM(nn.Module):
+    """Stub LM whose next-token logits depend only on the current token —
+    a lookup table, so beam-search outcomes are analytically known."""
+
+    table: tuple  # (vocab, vocab) row-stochastic log-probs
+
+    @nn.compact
+    def __call__(self, tokens, position_ids=None, cache_len=None,
+                 decode_step=False, labels=None, loss_mask=None,
+                 deterministic=True):
+        self.variable(
+            "cache", "dummy", lambda: jnp.zeros((tokens.shape[0], 1))
+        )
+        return jnp.asarray(self.table)[tokens]  # (b, s, vocab)
+
+
+def test_beam_search():
+    from apex_tpu.models.generate import beam_search, generate
+
+    # trap distribution from state 0: token 1 is the greedy pick (p=.5)
+    # but dead-ends (uniform continuations); token 2 (p=.4) leads to
+    # token 3 with p=.9 — the 2-step optimum is [2, 3]
+    import numpy as onp
+
+    V = 4
+    tbl = onp.full((V, V), 1.0 / V)
+    tbl[0] = [0.05, 0.5, 0.4, 0.05]
+    tbl[2] = [0.02, 0.03, 0.05, 0.9]
+    table = tuple(map(tuple, onp.log(tbl)))
+    model = _MarkovLM(table=table)
+    prompt = jnp.zeros((2, 1), jnp.int32)
+    variables = {"params": {}}
+
+    toks, scores = beam_search(model, variables, prompt,
+                               max_new_tokens=2, num_beams=2)
+    assert toks.shape == (2, 2, 3) and scores.shape == (2, 2)
+    # best beam took the trap exit, not the greedy dead end
+    np.testing.assert_array_equal(np.asarray(toks[:, 0, 1:]), [[2, 3], [2, 3]])
+    np.testing.assert_allclose(
+        np.asarray(scores[:, 0]), np.log(0.4 * 0.9) / 2, rtol=1e-5
+    )
+    # greedy walks into the trap
+    g = generate(model, variables, prompt, max_new_tokens=2)
+    np.testing.assert_array_equal(np.asarray(g[:, 1]), [1, 1])
+    # beams are sorted best-first
+    assert np.all(np.asarray(scores[:, 0]) >= np.asarray(scores[:, 1]))
+
+
+def test_beam_width_one_is_greedy(hf_llama):
+    """num_beams=1 must reproduce cached greedy token-for-token — same
+    logits through the same cache path, argmax == top-1 of log_softmax."""
+    from apex_tpu.models.generate import beam_search, generate
+    from apex_tpu.models.hf_import import llama_from_hf
+
+    model, variables = llama_from_hf(hf_llama)
+    prompt = jnp.asarray(np.random.RandomState(11).randint(0, 128, (2, 6)))
+    greedy = generate(model, variables, prompt, max_new_tokens=8)
+    beams, scores = beam_search(model, variables, prompt,
+                                max_new_tokens=8, num_beams=1)
+    np.testing.assert_array_equal(np.asarray(beams[:, 0]), np.asarray(greedy))
+    assert np.isfinite(np.asarray(scores)).all()
 
 
 def test_qkv_regroup_roundtrip():

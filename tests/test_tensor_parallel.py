@@ -220,6 +220,45 @@ class TestGPTTensorParallel:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0] - 0.2, losses
 
+    @pytest.mark.parametrize("gqa", [False, True])
+    def test_tp_kv_cache_decode_matches_full_forward(self, rng, gqa):
+        """KV-cache decoding with the cache sharded over tp (heads split
+        across ranks): per-step decode logits must equal full-forward
+        slices on every rank's vocab shard."""
+        mesh = tp_mesh()
+        kw = dict(num_attention_heads=16, num_query_groups=8) if gqa else {}
+        model = GPTModel(config=tiny_cfg(**kw))
+        tokens = jax.random.randint(rng, (2, 12), 0, VOCAB)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def run(tokens):
+            variables = model.init(jax.random.PRNGKey(0), tokens[:, :1])
+            full = model.apply(variables, tokens)  # (b, 12, vocab_local)
+            logits, st = model.apply(
+                variables, tokens[:, :5], cache_len=12, mutable=["cache"]
+            )
+            cache = st["cache"]
+            err = jnp.max(jnp.abs(logits - full[:, :5]))
+            for pos in range(5, 12):
+                sl, upd = model.apply(
+                    {**variables, "cache": cache},
+                    tokens[:, pos : pos + 1],
+                    position_ids=jnp.full((1, 1), pos),
+                    decode_step=True,
+                    mutable=["cache"],
+                )
+                cache = upd["cache"]
+                err = jnp.maximum(
+                    err, jnp.max(jnp.abs(sl[:, 0] - full[:, pos]))
+                )
+            return jax.lax.pmax(err, "tp")
+
+        assert float(run(tokens)) < 2e-5
+
     def test_sp_matches_non_sp(self, rng):
         """Same per-rank params ⇒ identical losses with/without SP (the SP
         mappings are pure re-partitionings; ref mappings.py:213-272)."""
