@@ -31,11 +31,31 @@ import jax
 import numpy as np
 
 __all__ = [
+    "enable_persistent_cache",
     "fetch",
     "full_reduce",
     "chained_seconds_per_iter",
     "seconds_per_iter",
 ]
+
+
+def enable_persistent_cache(default_dir: str) -> None:
+    """Point JAX's persistent compilation cache at ``APEX_TPU_COMPILE_CACHE``
+    (or ``default_dir``), so a relay drop / fresh process re-pays zero
+    compiles for programs an earlier attempt already compiled.  One shared
+    helper so bench.py and the benchmark harness cannot drift apart on the
+    cache location."""
+    import os
+    import sys
+
+    cache_dir = os.environ.get("APEX_TPU_COMPILE_CACHE", default_dir)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # older jax / read-only fs: slower, not fatal
+        sys.stderr.write(f"[benchmarking] compilation cache unavailable: {e}\n")
 
 
 def full_reduce(tree):
@@ -81,6 +101,7 @@ def chained_seconds_per_iter(
     target_signal: float = 0.4,
     max_span: int = 1024,
     return_output: bool = False,
+    deadline: float | None = None,
 ):
     """Seconds per iteration of the loop body that ``build(k)`` chains k times.
 
@@ -105,10 +126,23 @@ def chained_seconds_per_iter(
     With ``return_output=True``, returns ``(seconds, last_output)`` where
     ``last_output`` is the fetched numpy output of the longest chain —
     callers use it as a correctness gate on the exact computation timed.
+
+    ``deadline`` (``time.monotonic()`` value) bounds span escalation: each
+    escalation costs one more remote compile against a possibly-flaky relay
+    (round 3's micro section burned 12,671 s this way), so past the deadline
+    the next escalation raises instead of starting.  An in-flight fetch is
+    never interrupted — only the decision to start another one is gated.
     """
+
+    def _check_deadline(where):
+        if deadline is not None and time.monotonic() > deadline:
+            raise RuntimeError(f"measurement budget exhausted before {where}")
+
+    _check_deadline("first compile")
     t1, _ = _best_of(jax.jit(build(1)), args, reps)
     span = 32
     while True:
+        _check_deadline(f"span={span} compile")
         t2, out = _best_of(jax.jit(build(1 + span)), args, reps)
         signal = t2 - t1
         # accept once the slope signal dwarfs the jitter; otherwise escalate

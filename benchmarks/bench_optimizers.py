@@ -78,7 +78,7 @@ def make_param_tree(total_params, key):
     return tree
 
 
-def bench_adam(tree, grads):
+def bench_adam(tree, grads, deadline=None):
     import optax
 
     from apex_tpu.optimizers import fused_adam
@@ -100,11 +100,12 @@ def bench_adam(tree, grads):
 
             return run
 
-        results[mode] = chained_seconds_per_iter(build, (grads, state, tree))
+        results[mode] = chained_seconds_per_iter(build, (grads, state, tree),
+                                                 deadline=deadline)
     return results
 
 
-def bench_l2norm(tree, grads):
+def bench_l2norm(tree, grads, deadline=None):
     from apex_tpu.ops.multi_tensor import flatten_pytree, multi_tensor_l2norm
     from apex_tpu.optimizers._fused_kernels import l2norm_flat
 
@@ -142,8 +143,8 @@ def bench_l2norm(tree, grads):
         return run
 
     return {
-        "tree": chained_seconds_per_iter(build_tree, (grads,)),
-        "flat": chained_seconds_per_iter(build_flat, (flat,)),
+        "tree": chained_seconds_per_iter(build_tree, (grads,), deadline=deadline),
+        "flat": chained_seconds_per_iter(build_flat, (flat,), deadline=deadline),
     }
 
 
@@ -184,7 +185,7 @@ def bench_adam_vs_torch_eager(tree, grads, ours_tree_sec):
     return {"torch_eager": torch_sec, "fused_tree": ours_tree_sec}
 
 
-def bench_layer_norm(batch, hidden, key):
+def bench_layer_norm(batch, hidden, key, deadline=None):
     from apex_tpu.ops.layer_norm import layer_norm
 
     x = jax.random.normal(key, (batch, hidden), jnp.float32)
@@ -203,11 +204,11 @@ def bench_layer_norm(batch, hidden, key):
 
             return run
 
-        out[impl] = chained_seconds_per_iter(build, (x, w, b))
+        out[impl] = chained_seconds_per_iter(build, (x, w, b), deadline=deadline)
     return out
 
 
-def bench_attention(batch, heads, seq, dim, key):
+def bench_attention(batch, heads, seq, dim, key, deadline=None):
     from apex_tpu.ops.attention import flash_attention
 
     q = jax.random.normal(key, (batch, heads, seq, dim), jnp.bfloat16)
@@ -226,11 +227,11 @@ def bench_attention(batch, heads, seq, dim, key):
 
             return run
 
-        out[impl] = chained_seconds_per_iter(build, (q, k, v))
+        out[impl] = chained_seconds_per_iter(build, (q, k, v), deadline=deadline)
     return out
 
 
-def bench_attention_long(key, batch=1, heads=8, seq=16384, dim=128):
+def bench_attention_long(key, batch=1, heads=8, seq=16384, dim=128, deadline=None):
     """Single-chip long context: at 16k bf16 keys the kernel's resident-K/V
     budget is exceeded, so auto dispatch runs the blockwise tiled path —
     this row records what that path actually costs per step on hardware
@@ -251,7 +252,7 @@ def bench_attention_long(key, batch=1, heads=8, seq=16384, dim=128):
 
         return run
 
-    sec = chained_seconds_per_iter(build, (q, k, v), reps=2)
+    sec = chained_seconds_per_iter(build, (q, k, v), reps=2, deadline=deadline)
     # causal flops: 2 dots x b h s^2/2 d x 2 (MACs)
     tflops = 2 * 2 * batch * heads * (seq * seq / 2) * dim / sec / 1e12
     return {"blockwise": sec, "tflops": round(tflops, 1)}

@@ -1,0 +1,130 @@
+"""Relay-window harvester: retry the TPU capture until everything lands.
+
+The axon relay comes and goes (round 3: one ~40-minute window in ~12 h).
+This supervisor loops for ``--hours``:
+
+1. Probe: a child process calls ``jax.devices()`` with a kill-timeout.
+   Probes hold no TPU claim, so killing a hung probe is safe (measured in
+   rounds 1-3; it is mid-CLAIM kills that wedge the relay).
+2. If the probe answers with a non-CPU platform, run
+   ``benchmarks/run_all_tpu.py`` as a child and WAIT without killing it —
+   its sections enforce their own wall-clock budgets internally for
+   everything except an in-flight relay fetch, and killing mid-claim
+   wedges the relay.  The wait is still bounded by the harvest window
+   (``--hours``): if the child is hung past it, we log and exit, leaving
+   the already-appended section records as the deliverable.
+3. Exit once ALL sections (headline, smoke, micro, configs) have a
+   successful record; the exit code reflects only whether the headline
+   landed.  A smoke record with rc=1 (deterministic kernel failure) counts
+   as captured — the failure IS the evidence; rc=2 (budget skip) retries.
+
+Run: nohup python benchmarks/harvest.py --hours 10 &   (or in a tmux pane)
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROBE_TIMEOUT = 120
+SLEEP_BETWEEN_PROBES = 240
+
+
+def log(msg):
+    print(f"[harvest {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def results_state(out_path):
+    """Which sections have a captured record already?
+
+    smoke: rc=0 (all OK) and rc=1 (deterministic kernel FAIL — retrying
+    re-spends a relay window on the same answer) both count as captured;
+    rc=2 means the budget ran out mid-run, so retry it.
+    """
+    done = set()
+    if not os.path.exists(out_path):
+        return done
+    with open(out_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("ok") and rec.get("section"):
+                if rec["section"] == "smoke" and rec.get("rc") not in (0, 1):
+                    continue
+                done.add(rec["section"])
+    return done
+
+
+def probe():
+    code = ("import jax, json; d = jax.devices()[0]; "
+            "print(json.dumps({'platform': d.platform, 'kind': d.device_kind}))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                              text=True, timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "platform" in rec:
+                return rec
+        except ValueError:
+            continue
+    return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=10.0)
+    ap.add_argument("--out", default=os.path.join(HERE, "tpu_results.jsonl"))
+    args = ap.parse_args()
+    stop_at = time.monotonic() + args.hours * 3600
+
+    attempt = 0
+    while time.monotonic() < stop_at:
+        done = results_state(args.out)
+        if {"headline", "smoke", "micro", "configs"} <= done:
+            log(f"all sections captured: {sorted(done)}; exiting")
+            break
+        p = probe()
+        if p is None or p.get("platform") in (None, "cpu"):
+            log(f"probe: relay not answering (got {p}); sleeping {SLEEP_BETWEEN_PROBES}s")
+            time.sleep(SLEEP_BETWEEN_PROBES)
+            continue
+        attempt += 1
+        skip = ",".join(done) if done else ""
+        log(f"relay UP ({p}); capture attempt {attempt}, skipping done sections: [{skip}]")
+        cmd = [sys.executable, os.path.join(HERE, "run_all_tpu.py"), "--out", args.out]
+        if skip:
+            cmd += ["--skip", skip]
+        # Popen + bounded wait, never kill: sections self-budget, but an
+        # in-flight relay fetch can hang past every internal deadline — if
+        # that outlives the harvest window, exit and keep what landed.
+        proc = subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        next_log = time.monotonic() + 600
+        while proc.poll() is None and time.monotonic() < stop_at:
+            if time.monotonic() > next_log:
+                log(f"capture attempt {attempt} still running; "
+                    f"sections so far: {sorted(results_state(args.out))}")
+                next_log = time.monotonic() + 600
+            time.sleep(20)
+        if proc.poll() is None:
+            log(f"harvest window over with capture attempt {attempt} still "
+                "running (relay hang mid-fetch); leaving it be and exiting")
+            break
+        log(f"capture attempt {attempt} exited rc={proc.returncode}")
+        time.sleep(30)
+
+    done = results_state(args.out)
+    log(f"window over; captured sections: {sorted(done)}")
+    return 0 if done & {"headline", "headline_o2"} else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

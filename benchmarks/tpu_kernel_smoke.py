@@ -34,7 +34,22 @@ def check(name, got, want, tol):
     return True
 
 
-def main():
+def main(deadline=None):
+    """Run every kernel smoke; ``deadline`` (time.monotonic value) stops
+    BETWEEN kernel families so a flaky relay can't strand the harness —
+    skipped families are reported, not silently dropped.
+
+    Return codes: 0 = all checked kernels OK; 1 = a numerics/lowering
+    FAILURE (deterministic — retrying wastes a relay window); 2 = budget
+    ran out with everything checked so far OK (worth retrying)."""
+    import time
+
+    def out_of_time(where):
+        if deadline is not None and time.monotonic() > deadline:
+            print(f"SKIP remaining (budget exhausted before {where})")
+            return True
+        return False
+
     dev = jax.devices()[0]
     print(f"backend: {dev.platform} / {dev.device_kind}")
     ok = True
@@ -48,11 +63,15 @@ def main():
     # a per-step partials layout was rejected by Mosaic's 8-sublane rule),
     # and (1024, 4096) is the shape whose fp32 temporaries blew the 16MB
     # scoped-vmem limit before _pick_block_rows budgeted 1MB/operand.
+    # bf16 at 4096 covers VERDICT r3 item 2: grid>1 + wide hidden + bf16.
     for rows, hidden, dtype, ftol, btol in [
         (512, 1024, jnp.float32, 2e-5, 2e-4),
         (1024, 4096, jnp.float32, 2e-5, 2e-3),
         (512, 1024, jnp.bfloat16, 2e-2, 2e-2),
+        (1024, 4096, jnp.bfloat16, 3e-2, 3e-2),
     ]:
+        if out_of_time(f"layer_norm {rows}x{hidden}"):
+            return 2 if ok else 1
         x = jax.random.normal(key, (rows, hidden), jnp.float32).astype(dtype)
         w = (jax.random.normal(jax.random.fold_in(key, 1), (hidden,)) * 0.1 + 1.0).astype(dtype)
         b = (jax.random.normal(jax.random.fold_in(key, 2), (hidden,)) * 0.1).astype(dtype)
@@ -70,6 +89,8 @@ def main():
             ok &= check(f"{name} bwd {tag}", g_p(x, w, b), g_x(x, w, b), btol)
 
     # ---- flash attention fwd+bwd (causal + non-causal) ----
+    if out_of_time("flash_attention"):
+        return 2 if ok else 1
     from apex_tpu.ops import flash_attention
 
     # Tolerances are hardware-calibrated, not wishful: on TPU the fp32 dots in
@@ -90,6 +111,8 @@ def main():
         ok &= check(f"flash_attention bwd causal={causal}", g_p(q, k_, v), g_x(q, k_, v), 5e-2)
 
     # ---- GQA / sliding window / key-padding fast paths (compiled) ----
+    if out_of_time("GQA/window/kpm"):
+        return 2 if ok else 1
     q4 = jax.random.normal(jax.random.fold_in(key, 10), (2, 4, 256, 64), jnp.float32)
     k4 = jax.random.normal(jax.random.fold_in(key, 11), (2, 2, 256, 64), jnp.float32)
     v4 = jax.random.normal(jax.random.fold_in(key, 12), (2, 2, 256, 64), jnp.float32)
@@ -116,6 +139,8 @@ def main():
     ok &= check("flash_attention kpm fwd", kp_p(q, k_, v), kp_x(q, k_, v), 2e-2)
 
     # ---- flat optimizer engine ----
+    if out_of_time("flat optimizer engine"):
+        return 2 if ok else 1
     from apex_tpu.optimizers._fused_kernels import adam_flat, l2norm_flat
     from apex_tpu.ops.multi_tensor import CHUNK_SIZE
 
