@@ -111,7 +111,12 @@ def _attn_ref(q, k, v, scale, causal, mask=None, window=None):
     if mask is not None:
         s = jnp.where(mask, _NEG_INF, s)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+    # fully-masked rows (e.g. the whole sliding window padded out) must be
+    # ZERO, not uniform-softmax leakage over equal -1e30 scores — the same
+    # dead-row contract as the Pallas kernel and the blockwise/ring paths
+    dead = jnp.all(s <= _NEG_INF * 0.5, axis=-1, keepdims=True)
+    return jnp.where(dead, jnp.zeros((), out.dtype), out)
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, bq, bk,
@@ -630,15 +635,38 @@ _blockwise.defvjp(_blockwise_fwd_res, _blockwise_bwd)
 def _attn_blockwise(q, k, v, scale, causal, window, kpm, chunk_q, chunk_k):
     """Long-context attention by (cq, ck) tiles: O(sq·d) state + one score
     tile live at a time. GQA-grouped, key-padding aware, rectangular-causal
-    (bottom-right) like the rest of this module."""
+    (bottom-right) like the rest of this module.
+
+    Non-multiple sequence lengths are FRONT-padded up to the target chunk
+    instead of shrinking the chunk toward a divisor (a prime 16k+1 length
+    would otherwise degrade to chunk 1 and run thousands of tiny tiles).
+    Front padding preserves the bottom-right causal/window alignment for
+    any pad amounts: real row i maps to i+pq, real key j to j+pk, and the
+    band bound j' <= i' + (sk'-sq') reduces exactly to j <= i + (sk-sq);
+    padded keys are masked through the key-padding path and padded query
+    rows are sliced off the output (their grads vanish through the same
+    pad/slice AD)."""
     b, h, sq, d = q.shape
     h_kv, sk = k.shape[1], k.shape[2]
     group = h // h_kv
-    cq = _bw_chunk(sq, chunk_q)
-    ck = _bw_chunk(sk, chunk_k)
-    q5 = q.reshape(b, h_kv, group, sq, d)
+    cq_t = max(1, min(chunk_q, sq))
+    ck_t = max(1, min(chunk_k, sk))
+    pq = (-sq) % cq_t
+    pk = (-sk) % ck_t
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (pk, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (pk, 0), (0, 0)))
+        base = kpm if kpm is not None else jnp.zeros((b, sk), bool)
+        kpm = jnp.concatenate([jnp.ones((b, pk), bool), base], axis=1)
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (pq, 0), (0, 0)))
+    sq_p, sk_p = sq + pq, sk + pk
+    cq = _bw_chunk(sq_p, cq_t)  # sq_p % cq_t == 0, so this is cq_t
+    ck = _bw_chunk(sk_p, ck_t)
+    q5 = q.reshape(b, h_kv, group, sq_p, d)
     o = _blockwise(q5, (k, v), kpm, scale, causal, window, cq, ck)
-    return o.reshape(b, h, sq, d)
+    o = o.reshape(b, h, sq_p, d)
+    return o[:, :, pq:, :] if pq else o
 
 
 def flash_attention(
@@ -721,13 +749,9 @@ def flash_attention(
         )
     if not pallas_ok:
         if key_padding_mask is not None:
+            # _attn_ref's dead-row zeroing covers fully-padded rows
             kp = key_padding_mask[:, None, None, :]  # (b, 1, 1, sk)
             mask = kp if mask is None else jnp.logical_or(mask, kp)
-            out = _attn_ref(q, k, v, scale, causal, mask, window)
-            # fully-padded rows are zero (not uniform-softmax leakage) in
-            # the Pallas kernel; match exactly here
-            dead = jnp.all(key_padding_mask, axis=-1)[:, None, None, None]
-            return jnp.where(dead, jnp.zeros((), out.dtype), out)
         return _attn_ref(q, k, v, scale, causal, mask, window)
     q3 = q.reshape(b * h, sq, d)
     k3 = k.reshape(b * h_kv, sk, d)

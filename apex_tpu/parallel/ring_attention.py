@@ -25,7 +25,14 @@ Two strategies, both exact:
 - **Ulysses** (`ulysses_attention`): two ``all_to_all``s repartition
   sequence-sharded activations to head-sharded, run the full-sequence
   Pallas flash kernel locally, and repartition back. Cheaper collectives
-  for moderate contexts; requires heads % cp == 0.
+  for moderate contexts; requires heads % cp == 0 (and kv_heads % cp == 0
+  under GQA).
+
+Both strategies take GQA/MQA-grouped K/V (heads % kv_heads == 0; the ring
+rotates the grouped heads — heads/kv_heads x less ICI traffic than
+repeating before the ring) and a sequence-sharded ``key_padding_mask``
+whose local shard rotates/gathers with its keys; an all-padded visiting
+chunk is skipped like an out-of-band one.
 
 Causal handling in the ring: masks and chunk skipping are driven by GLOBAL
 position vectors (``_positions``/``_band_keep``), so chunk layout is a
@@ -124,11 +131,30 @@ def _chunk_block_size(s_local: int, block_size: int) -> int:
     return bk
 
 
-def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal, block_size, window=None):
+def _allow_mask(rows, cols_b, causal, window, keep_b):
+    """Combined (sq, bk) band mask x (b, bk) key-validity mask, broadcast
+    to the grouped score shape (b, G, g, sq, bk); None when unmasked."""
+    band = _band_keep(rows, cols_b, causal, window)
+    allow = None
+    if band is not None:
+        allow = band[None, None, None]
+    if keep_b is not None:
+        kb = keep_b[:, None, None, None, :]
+        allow = kb if allow is None else jnp.logical_and(allow, kb)
+    return allow
+
+
+def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal,
+                         block_size, window=None, keep=None):
     """Stream one visiting K/V chunk through the online softmax in
     ``block_size`` slices. state = (acc, m, l) accumulated so far;
     ``rows``/``cols`` are the global positions of the local queries and
     the visiting keys (any layout).
+
+    ``q`` is GQA-grouped (b, h_kv, g, sq, d) against kc/vc (b, h_kv, s, d)
+    — grouped K/V means the ring rotates h_kv heads, not h (g x less ICI
+    traffic than repeating K/V before the ring).  ``keep`` is the visiting
+    chunk's (b, s_kv) key-validity mask (False = padded-out key).
 
     Dot operands KEEP the input dtype (bf16 stays bf16) with fp32
     accumulation — upcasting before the einsum forces the MXU's slow fp32
@@ -143,12 +169,15 @@ def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal, block_size
         kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2)
         vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2)
         s = (
-            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
+            jnp.einsum("bGgqd,bGkd->bGgqk", q, kb,
+                       preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _band_keep(
+        allow = _allow_mask(
             rows, jax.lax.dynamic_slice_in_dim(cols, lo, bk, axis=0),
             causal, window,
+            None if keep is None
+            else jax.lax.dynamic_slice_in_dim(keep, lo, bk, axis=1),
         )
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
@@ -159,7 +188,7 @@ def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal, block_size
             p = jnp.where(allow, p, 0.0)  # exp(-inf - (-inf)) guard
         l_new = l * alpha + jnp.sum(p, axis=-1)
         acc_new = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+            "bGgqk,bGkd->bGgqd", p.astype(vb.dtype), vb,
             preferred_element_type=jnp.float32,
         )
         return (acc_new, m_new, l_new), None
@@ -171,60 +200,85 @@ def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal, block_size
     return state
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _ring(q, k, v, axis_name, causal, scale, block_size, window, zigzag):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _ring(q, k, v, kbias, axis_name, causal, scale, block_size, window, zigzag):
     o, _ = _ring_fwd_res(
-        q, k, v, axis_name, causal, scale, block_size, window, zigzag
+        q, k, v, kbias, axis_name, causal, scale, block_size, window, zigzag
     )
     return o
 
 
-def _ring_fwd_res(q, k, v, axis_name, causal, scale, block_size, window, zigzag):
+def _keep_from_bias(kbias):
+    """(b, s) float bias (0 valid / _NEG_INF padded) -> bool validity mask.
+    The bias is float (not bool) only so it can ride the custom_vjp as a
+    differentiable primal with a zero cotangent."""
+    return None if kbias is None else kbias > 0.5 * _NEG_INF
+
+
+def _ring_fwd_res(q, k, v, kbias, axis_name, causal, scale, block_size,
+                  window, zigzag):
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    q5 = q.reshape(b, h_kv, g, sq, d)
     rows = _positions(rank, num_ranks, sq, zigzag)
+    keep0 = _keep_from_bias(kbias)
 
     init_state = (
-        jnp.zeros((b, h, sq, d), jnp.float32),
-        jnp.full((b, h, sq), _NEG_INF, jnp.float32),
-        jnp.zeros((b, h, sq), jnp.float32),
+        jnp.zeros((b, h_kv, g, sq, d), jnp.float32),
+        jnp.full((b, h_kv, g, sq), _NEG_INF, jnp.float32),
+        jnp.zeros((b, h_kv, g, sq), jnp.float32),
     )
     # step 0 on the resident chunk — no rotation needed
     state = _online_chunk_update(
-        init_state, q, k, v, scale, rows, rows, causal, block_size, window
+        init_state, q5, k, v, scale, rows, rows, causal, block_size, window,
+        keep0,
     )
 
     def step(carry, t):
-        (kc, vc), state = carry
-        kc, vc = _rotate((kc, vc), axis_name)
+        (kc, vc, biasc), state = carry
+        kc, vc, biasc = _rotate((kc, vc, biasc), axis_name)
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         cols = _positions(src, num_ranks, sq, zigzag)
+        # trace-time None check: with no kpm the carry holds a (b, 0)
+        # placeholder, which must NOT become an all-False keep mask
+        keep_c = _keep_from_bias(biasc) if kbias is not None else None
+        contributes = _chunk_contributes(rows, cols, causal, window,
+                                         2 if zigzag else 1)
+        if keep_c is not None:
+            # an all-padded visiting chunk is skipped like an out-of-band one
+            contributes = jnp.logical_and(contributes, jnp.any(keep_c))
         state = jax.lax.cond(
-            _chunk_contributes(rows, cols, causal, window,
-                               2 if zigzag else 1),
+            contributes,
             lambda st: _online_chunk_update(
-                st, q, kc, vc, scale, rows, cols, causal, block_size, window
+                st, q5, kc, vc, scale, rows, cols, causal, block_size,
+                window, keep_c,
             ),
             lambda st: st,
             state,
         )
-        return ((kc, vc), state), None
+        return ((kc, vc, biasc), state), None
 
     if num_ranks > 1:
-        ((_, _), state), _ = jax.lax.scan(
-            step, ((k, v), state), jnp.arange(1, num_ranks)
+        # a None bias still needs a rotatable placeholder in the carry
+        bias_carry = kbias if kbias is not None else jnp.zeros((b, 0))
+        ((_, _, _), state), _ = jax.lax.scan(
+            step, ((k, v, bias_carry), state), jnp.arange(1, num_ranks)
         )
     acc, m, l = state
     l = jnp.maximum(l, 1e-30)
-    o = (acc / l[..., None]).astype(q.dtype)
-    lse = m + jnp.log(l)
-    return o, (q, k, v, o, lse)
+    o = (acc / l[..., None]).reshape(b, h, sq, d).astype(q.dtype)
+    lse = m + jnp.log(l)  # (b, h_kv, g, sq)
+    return o, (q, k, v, kbias, o, lse)
 
 
 def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
-                      cols, causal, block_size, window=None):
+                      cols, causal, block_size, window=None, keep=None):
     """Blockwise gradient contributions of one visiting K/V chunk.
+    GQA-grouped like _online_chunk_update (q/do/delta/lse carry the
+    (b, h_kv, g, ...) layout; kc/vc/dkc/dvc the (b, h_kv, ...) one).
     Operand-dtype policy as in _online_chunk_update; dkc/dvc/dq accumulate
     in fp32."""
     s_kv = kc.shape[-2]
@@ -237,12 +291,15 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
         kb = jax.lax.dynamic_slice_in_dim(kc, lo, bk, axis=2)
         vb = jax.lax.dynamic_slice_in_dim(vc, lo, bk, axis=2)
         s = (
-            jnp.einsum("bhqd,bhkd->bhqk", q, kb, preferred_element_type=jnp.float32)
+            jnp.einsum("bGgqd,bGkd->bGgqk", q, kb,
+                       preferred_element_type=jnp.float32)
             * scale
         )
-        allow = _band_keep(
+        allow = _allow_mask(
             rows, jax.lax.dynamic_slice_in_dim(cols, lo, bk, axis=0),
             causal, window,
+            None if keep is None
+            else jax.lax.dynamic_slice_in_dim(keep, lo, bk, axis=1),
         )
         if allow is not None:
             s = jnp.where(allow, s, _NEG_INF)
@@ -250,19 +307,19 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
         if allow is not None:
             p = jnp.where(allow, p, 0.0)
         dv_b = jnp.einsum(
-            "bhqk,bhqd->bhkd", p.astype(do.dtype), do,
+            "bGgqk,bGgqd->bGkd", p.astype(do.dtype), do,
             preferred_element_type=jnp.float32,
         )
         dp = jnp.einsum(
-            "bhqd,bhkd->bhqk", do, vb, preferred_element_type=jnp.float32
+            "bGgqd,bGkd->bGgqk", do, vb, preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta[..., None]) * scale
         ds_lo = ds.astype(kb.dtype)
         dq = dq + jnp.einsum(
-            "bhqk,bhkd->bhqd", ds_lo, kb, preferred_element_type=jnp.float32
+            "bGgqk,bGkd->bGgqd", ds_lo, kb, preferred_element_type=jnp.float32
         )
         dk_b = jnp.einsum(
-            "bhqk,bhqd->bhkd", ds_lo, q, preferred_element_type=jnp.float32
+            "bGgqk,bGgqd->bGkd", ds_lo, q, preferred_element_type=jnp.float32
         )
         dkc = jax.lax.dynamic_update_slice_in_dim(
             dkc, jax.lax.dynamic_slice_in_dim(dkc, lo, bk, 2) + dk_b, lo, 2
@@ -282,51 +339,66 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
 
 
 def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
-    q, k, v, o, lse = res
+    q, k, v, kbias, o, lse = res
     num_ranks = jax.lax.psum(1, axis_name)
     rank = jax.lax.axis_index(axis_name)
-    sq = q.shape[-2]
+    b, h, sq, d = q.shape
+    h_kv = k.shape[1]
+    g = h // h_kv
+    q5 = q.reshape(b, h_kv, g, sq, d)
+    do5 = do.reshape(b, h_kv, g, sq, d)
+    o5 = o.reshape(b, h_kv, g, sq, d)
     rows = _positions(rank, num_ranks, sq, zigzag)
+    keep0 = _keep_from_bias(kbias)
     delta = jnp.sum(
-        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
-    )  # (b, h, sq)
+        do5.astype(jnp.float32) * o5.astype(jnp.float32), axis=-1
+    )  # (b, h_kv, g, sq)
 
     zeros_k = jnp.zeros(k.shape, jnp.float32)
     zeros_v = jnp.zeros(v.shape, jnp.float32)
-    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq0 = jnp.zeros(q5.shape, jnp.float32)
     # step 0 on the resident chunk
     dk0, dv0, dq = _chunk_bwd_update(
-        q, do, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rows, rows,
-        causal, block_size, window,
+        q5, do5, delta, lse, k, v, zeros_k, zeros_v, dq0, scale, rows, rows,
+        causal, block_size, window, keep0,
     )
 
     def step(carry, t):
-        (kc, vc, dkc, dvc), dq = carry
+        (kc, vc, biasc, dkc, dvc), dq = carry
         # dK/dV ride the ring with their chunks
-        kc, vc, dkc, dvc = _rotate((kc, vc, dkc, dvc), axis_name)
+        kc, vc, biasc, dkc, dvc = _rotate(
+            (kc, vc, biasc, dkc, dvc), axis_name
+        )
         src = jax.lax.rem(rank - t + num_ranks, num_ranks)
         cols = _positions(src, num_ranks, sq, zigzag)
+        keep_c = _keep_from_bias(biasc) if kbias is not None else None
+        contributes = _chunk_contributes(rows, cols, causal, window,
+                                         2 if zigzag else 1)
+        if keep_c is not None:
+            contributes = jnp.logical_and(contributes, jnp.any(keep_c))
         dkc, dvc, dq = jax.lax.cond(
-            _chunk_contributes(rows, cols, causal, window,
-                               2 if zigzag else 1),
+            contributes,
             lambda ops: _chunk_bwd_update(
-                q, do, delta, lse, kc, vc, ops[0], ops[1], ops[2], scale,
-                rows, cols, causal, block_size, window,
+                q5, do5, delta, lse, kc, vc, ops[0], ops[1], ops[2], scale,
+                rows, cols, causal, block_size, window, keep_c,
             ),
             lambda ops: ops,
             (dkc, dvc, dq),
         )
-        return ((kc, vc, dkc, dvc), dq), None
+        return ((kc, vc, biasc, dkc, dvc), dq), None
 
-    carry = ((k, v, dk0, dv0), dq)
+    bias_carry = kbias if kbias is not None else jnp.zeros((b, 0))
+    carry = ((k, v, bias_carry, dk0, dv0), dq)
     if num_ranks > 1:
         carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))
-    (kc, vc, dk, dv), dq = carry
+    (kc, vc, _, dk, dv), dq = carry
     # one homing rotation: after P-1 rotations the accumulators sit one rank
     # short of their owners
     if num_ranks > 1:
         dk, dv = _rotate((dk, dv), axis_name)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dkbias = None if kbias is None else jnp.zeros_like(kbias)
+    return (dq.reshape(b, h, sq, d).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype), dkbias)
 
 
 _ring.defvjp(_ring_fwd_res, _ring_bwd)
@@ -342,11 +414,15 @@ def ring_attention(
     block_size: int = 512,
     window: int = None,
     zigzag: bool = False,
+    key_padding_mask=None,
 ):
     """Exact sequence-sharded attention over the ``axis_name`` ring.
 
-    q, k, v: (batch, heads, seq_local, head_dim) — the local chunk of a
-    sequence sharded over the cp axis. Call inside ``shard_map``.
+    q: (batch, heads, seq_local, head_dim); k, v: (batch, kv_heads,
+    seq_local, head_dim) with heads % kv_heads == 0 (GQA/MQA: the ring
+    rotates the GROUPED K/V, heads/kv_heads x less ICI traffic than
+    repeating keys before the ring) — the local chunk of a sequence
+    sharded over the cp axis. Call inside ``shard_map``.
     ``block_size`` bounds the K/V slice processed at once (local memory
     O(seq_local x block_size)). Returns the local output chunk; grads flow
     through a second ring pass (see module docstring).
@@ -354,6 +430,12 @@ def ring_attention(
     ``window`` (sliding-window, causal only) bands attention in GLOBAL
     positions across the ring's chunks — long-context mistral-style
     attention sharded over cp.
+
+    ``key_padding_mask``: (batch, seq_local) bool, True = padded-out key —
+    the LOCAL shard of the global padding mask, sharded exactly like k/v
+    (zigzag-reordered with ``zigzag_shard`` when zigzag=True). It rotates
+    around the ring with its K/V chunk, and an all-padded visiting chunk
+    is skipped entirely like an out-of-band one.
 
     ``zigzag`` (causal load balance): shards carry pieces (r, 2P-1-r) of
     the sequence instead of contiguous chunks — prepare them with
@@ -367,9 +449,24 @@ def ring_attention(
         raise ValueError("window requires causal=True (mistral semantics)")
     if zigzag and q.shape[-2] % 2:
         raise ValueError("zigzag needs an even per-rank sequence length")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"heads ({q.shape[1]}) not divisible by kv_heads ({k.shape[1]})"
+        )
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _ring(q, k, v, axis_name, causal, scale, block_size, window, zigzag)
+    kbias = None
+    if key_padding_mask is not None:
+        if key_padding_mask.shape != (q.shape[0], k.shape[2]):
+            raise ValueError(
+                f"key_padding_mask {key_padding_mask.shape} != "
+                f"(batch, seq_local) = {(q.shape[0], k.shape[2])}"
+            )
+        # float carrier (0 valid / -inf padded) so the mask can be a
+        # differentiable custom_vjp primal with a zero cotangent
+        kbias = jnp.where(key_padding_mask, _NEG_INF, 0.0).astype(jnp.float32)
+    return _ring(q, k, v, kbias, axis_name, causal, scale, block_size,
+                 window, zigzag)
 
 
 def _zigzag_index(s: int, num_ranks: int):
@@ -412,14 +509,22 @@ def ulysses_attention(
     scale: float = None,
     window: int = None,
     attn_fn=None,
+    key_padding_mask=None,
 ):
     """DeepSpeed-Ulysses-style attention: all-to-all from sequence-sharded
     to head-sharded, full-sequence local attention, all-to-all back.
 
-    q, k, v: (batch, heads, seq_local, head_dim) with heads divisible by
-    the cp size. ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to
-    the Pallas flash kernel. The two all_to_alls transpose to their own
-    inverses under autodiff, so no custom backward is needed.
+    q: (batch, heads, seq_local, head_dim); k, v may carry fewer (GQA)
+    heads — both counts must be divisible by the cp size (each rank keeps
+    whole query groups, so the local attention stays a plain GQA call).
+    ``attn_fn(q, k, v, causal=..., scale=...)`` defaults to the Pallas
+    flash kernel. The two all_to_alls transpose to their own inverses
+    under autodiff, so no custom backward is needed.
+
+    ``key_padding_mask``: (batch, seq_local) bool local shard (True =
+    padded) — all-gathered over cp (cheap: bytes per key, vs the d-dim
+    K/V that ride the all_to_alls) so each head-sharded rank masks the
+    full sequence it now sees.
     """
     if attn_fn is None:
         from apex_tpu.ops.attention import flash_attention
@@ -429,6 +534,10 @@ def ulysses_attention(
     assert q.shape[1] % num_ranks == 0, (
         f"heads ({q.shape[1]}) not divisible by cp size ({num_ranks}); "
         "use ring_attention for head counts below the cp degree"
+    )
+    assert k.shape[1] % num_ranks == 0, (
+        f"kv_heads ({k.shape[1]}) not divisible by cp size ({num_ranks}); "
+        "use ring_attention for grouped-KV head counts below the cp degree"
     )
 
     # With cp=1 this degrades to plain attention.
@@ -443,5 +552,9 @@ def ulysses_attention(
     # heads are sharded but each rank sees the FULL sequence, so the local
     # attention supports windows natively
     kw = {} if window is None else {"window": window}
+    if key_padding_mask is not None:
+        kw["key_padding_mask"] = jax.lax.all_gather(
+            key_padding_mask, axis_name, axis=1, tiled=True
+        )
     oh = attn_fn(qh, kh, vh, causal=causal, scale=scale, **kw)
     return to_seq(oh)

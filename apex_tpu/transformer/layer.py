@@ -452,23 +452,26 @@ class ParallelAttention(nn.Module):
         if decode_step:
             pass  # ctx computed against the cache above
         elif cp > 1:
-            if (not use_flash or key_padding_mask is not None
-                    or kb.shape[1] != qb.shape[1]):
+            if not use_flash:
                 raise NotImplementedError(
-                    "context parallelism supports causal/unmasked MHA "
-                    "attention without dropout, padding masks, or grouped "
-                    "KV heads (like the reference's fused paths)"
+                    "context parallelism requires the flash path: no "
+                    "dense attention_mask and no attention dropout (like "
+                    "the reference's fused paths); GQA and key-padding "
+                    "masks are supported"
                 )
             from apex_tpu.parallel.ring_attention import (
                 ring_attention,
                 ulysses_attention,
             )
 
+            # key_padding_mask here is the LOCAL (b, s_local) shard — the
+            # layer runs inside shard_map with sequence-sharded inputs, so
+            # the mask arrives sharded exactly like the keys it pads
             win = cfg.attention_window if causal else None
             if cfg.context_parallel_mode == "ring":
                 ctx = ring_attention(
                     qb, kb, vb, axis_name=cfg.context_axis, causal=causal,
-                    window=win,
+                    window=win, key_padding_mask=key_padding_mask,
                 )
             else:
                 ctx = ulysses_attention(
@@ -481,6 +484,7 @@ class ParallelAttention(nn.Module):
                     attn_fn=functools.partial(
                         flash_attention, impl=cfg.attention_impl
                     ),
+                    key_padding_mask=key_padding_mask,
                 )
         elif use_flash:
             ctx = flash_attention(

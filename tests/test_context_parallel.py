@@ -281,6 +281,133 @@ class TestRingAttention:
             np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-4)
 
 
+class TestRingGQAAndKeyPadding:
+    """GQA x causal x window x kpm through the ring (VERDICT r3 item 3):
+    grouped K/V rotate (not repeated pre-ring), the sequence-sharded
+    key_padding_mask rides with its chunk, and an all-padded visiting
+    chunk is skipped like an out-of-band one."""
+
+    def _kpm(self):
+        # last ring chunk (positions 24..31 at cp=4) fully padded in EVERY
+        # batch row -> exercises whole-chunk skipping; row 0 additionally
+        # pads a partial tail inside chunk 2
+        kpm = jnp.zeros((B, SEQ), bool)
+        kpm = kpm.at[:, 24:].set(True).at[0, 20:].set(True)
+        return kpm
+
+    @pytest.mark.parametrize("h_kv", [4, 2, 1])
+    @pytest.mark.parametrize("causal,window",
+                             [(False, None), (True, None), (True, 12)])
+    @pytest.mark.parametrize("use_kpm", [False, True])
+    def test_parity_and_grads(self, rng, h_kv, causal, window, use_kpm):
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv, kc = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, h_kv, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, h_kv, SEQ, D), jnp.float32)
+        ct = jax.random.normal(kc, (B, H, SEQ, D), jnp.float32)
+        kpm = self._kpm() if use_kpm else None
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(seq_spec(), seq_spec(), seq_spec(), P(None, "cp")),
+            out_specs=seq_spec(), check_vma=False,
+        )
+        def run(q, k, v, kpm):
+            return ring_attention(
+                q, k, v, axis_name="cp", causal=causal, window=window,
+                key_padding_mask=kpm, block_size=8,
+            )
+
+        def ring(q, k, v):
+            if kpm is None:
+                # shard_map in_specs are fixed; route None via a zero mask
+                return run(q, k, v, jnp.zeros((B, SEQ), bool))
+            return run(q, k, v, kpm)
+
+        ref_fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, window=window, key_padding_mask=kpm,
+            impl="xla",
+        )
+        np.testing.assert_allclose(
+            ring(q, k, v), ref_fn(q, k, v), rtol=2e-4, atol=2e-5
+        )
+        gp = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v) * ct),
+                      (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) * ct),
+                      (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_zigzag_gqa_kpm(self, rng):
+        """The load-balanced layout composes with GQA + kpm: the mask is
+        zigzag-reordered exactly like the keys it pads."""
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv, kc = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, 2, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, 2, SEQ, D), jnp.float32)
+        ct = jax.random.normal(kc, (B, H, SEQ, D), jnp.float32)
+        kpm = self._kpm()
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(seq_spec(), seq_spec(), seq_spec(), P(None, "cp")),
+            out_specs=seq_spec(), check_vma=False,
+        )
+        def run_local(q, k, v, kpm):
+            return ring_attention(
+                q, k, v, axis_name="cp", causal=True,
+                key_padding_mask=kpm, zigzag=True, block_size=8,
+            )
+
+        def run(q, k, v):
+            zq, zk, zv = (zigzag_shard(t, cp) for t in (q, k, v))
+            zm = zigzag_shard(kpm, cp, axis=-1)
+            return zigzag_unshard(run_local(zq, zk, zv, zm), cp)
+
+        ref_fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, key_padding_mask=kpm, impl="xla"
+        )
+        np.testing.assert_allclose(
+            run(q, k, v), ref_fn(q, k, v), rtol=2e-4, atol=2e-5
+        )
+        gp = jax.grad(lambda q, k, v: jnp.sum(run(q, k, v) * ct),
+                      (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) * ct),
+                      (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
+
+    def test_ring_rejects_indivisible_heads(self, rng):
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=4, devices=jax.devices()[:4]
+        )
+        q = jnp.zeros((B, 4, SEQ, D))
+        k = jnp.zeros((B, 3, SEQ, D))
+        with pytest.raises(ValueError, match="not divisible"):
+
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(seq_spec(),) * 3,
+                out_specs=seq_spec(), check_vma=False,
+            )
+            def run(q, k, v):
+                return ring_attention(q, k, v, axis_name="cp")
+
+            run(q, k, k)
+
+
 class TestUlyssesAttention:
     @pytest.mark.parametrize("causal", [False, True])
     def test_forward_parity(self, rng, causal):
@@ -307,6 +434,45 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(
             run(q, k, v), full_reference(q, k, v, causal), rtol=2e-4, atol=2e-5
         )
+
+    def test_gqa_and_kpm_parity(self, rng):
+        """GQA K/V (kv_heads % cp == 0) plus an all-gathered sequence-
+        sharded key-padding mask through the all-to-all path."""
+        cp = 2
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        kq, kk, kv, kc = jax.random.split(rng, 4)
+        q = jax.random.normal(kq, (B, H, SEQ, D), jnp.float32)
+        k = jax.random.normal(kk, (B, 2, SEQ, D), jnp.float32)
+        v = jax.random.normal(kv, (B, 2, SEQ, D), jnp.float32)
+        ct = jax.random.normal(kc, (B, H, SEQ, D), jnp.float32)
+        kpm = jnp.zeros((B, SEQ), bool).at[0, 20:].set(True)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(seq_spec(), seq_spec(), seq_spec(), P(None, "cp")),
+            out_specs=seq_spec(), check_vma=False,
+        )
+        def run(q, k, v, kpm):
+            return ulysses_attention(
+                q, k, v, axis_name="cp", causal=True, key_padding_mask=kpm
+            )
+
+        ref_fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, key_padding_mask=kpm, impl="xla"
+        )
+        np.testing.assert_allclose(
+            run(q, k, v, kpm), ref_fn(q, k, v), rtol=2e-4, atol=2e-5
+        )
+        gp = jax.grad(lambda q, k, v: jnp.sum(run(q, k, v, kpm) * ct),
+                      (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(ref_fn(q, k, v) * ct),
+                      (0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4)
 
     def test_grad_flows(self, rng):
         cp = 4

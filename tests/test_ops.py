@@ -235,6 +235,37 @@ class TestFlashAttention:
             q, k, v, key_padding_mask=kpm, impl="xla")))(q)
         np.testing.assert_allclose(np.asarray(gb), np.asarray(gr), atol=5e-5)
 
+    def test_blockwise_non_divisible_lengths(self, rng):
+        """Prime sequence lengths must run padded full-size tiles, not
+        degrade the chunk toward 1 (advisor finding r3): sq=131, sk=257
+        have no useful divisors, so this exercises the front-padding path
+        (pq, pk > 0) including causal band alignment, window, kpm, grads."""
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        q = jax.random.normal(k1, (2, 4, 131, 32))
+        k = jax.random.normal(k2, (2, 2, 257, 32))
+        v = jax.random.normal(k3, (2, 2, 257, 32))
+        for kwargs in ({}, {"causal": True}, {"causal": True, "window": 60}):
+            out = flash_attention(q, k, v, impl="blockwise",
+                                  block_q=8, block_k=8, **kwargs)
+            ref = flash_attention(q, k, v, impl="xla", **kwargs)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, err_msg=str(kwargs))
+
+        kpm = jnp.zeros((2, 257), bool).at[0, 200:].set(True)
+        out = flash_attention(q, k, v, key_padding_mask=kpm,
+                              impl="blockwise", block_q=8, block_k=8)
+        ref = flash_attention(q, k, v, key_padding_mask=kpm, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+        ct = jax.random.normal(k4, q.shape)
+        gb = jax.grad(lambda q, k, v: jnp.sum(ct * flash_attention(
+            q, k, v, causal=True, impl="blockwise", block_q=8, block_k=8)),
+            (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(ct * flash_attention(
+            q, k, v, causal=True, impl="xla")), (0, 1, 2))(q, k, v)
+        for a, b in zip(gb, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
     def test_blockwise_rectangular_causal(self, rng):
         # sq != sk causal (bottom-right aligned) — the kernel path refuses
         # this; blockwise covers it exactly
