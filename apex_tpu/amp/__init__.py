@@ -38,6 +38,12 @@ from apex_tpu.amp.scaler import (
     unscale_grads,
 )
 from apex_tpu.amp.grad_scaler import GradScaler
+from apex_tpu.amp.fp8 import (
+    Fp8TensorState,
+    fp8_dense,
+    init_fp8_state,
+    update_fp8_state,
+)
 from apex_tpu.amp.cast_engine import (
     cast_ops,
     float_function,
@@ -49,6 +55,10 @@ from apex_tpu.amp.cast_engine import (
 )
 
 __all__ = [
+    "Fp8TensorState",
+    "fp8_dense",
+    "init_fp8_state",
+    "update_fp8_state",
     "cast_ops",
     "half_function",
     "float_function",
