@@ -21,6 +21,7 @@ import threading
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.amp import cast_lists
 
@@ -45,7 +46,13 @@ _state = _State()
 
 
 def _is_float(x):
-    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+    # must be an actual ARRAY (incl. tracers), not merely dtype-carrying:
+    # dtype classes like jnp.float32 passed as arguments (jnp.zeros(shape,
+    # jnp.float32) inside a patched op) have .dtype too and would crash
+    # the converters' .astype
+    return isinstance(x, (jax.Array, np.ndarray)) and jnp.issubdtype(
+        x.dtype, jnp.floating
+    )
 
 
 def _tree_cast(tree, convert):

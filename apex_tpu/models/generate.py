@@ -181,8 +181,9 @@ def beam_search(
     jnp.take on the cache pytree is the TPU analogue of HF's
     ``_reorder_cache``). Returns ``(tokens, scores)`` with tokens
     (b, num_beams, s + max_new_tokens) sorted best-first and scores the
-    length-normalized sequence log-probs (sum logp / len^length_penalty,
-    the HF convention).
+    length-normalized sequence log-probs
+    (sum logp / (s + max_new_tokens)^length_penalty — HF's BeamHypotheses
+    convention of dividing by the FULL hypothesis length incl. prompt).
 
     No early stopping / EOS handling: the models here have no reserved
     tokens; generation always runs ``max_new_tokens`` steps.
@@ -254,6 +255,10 @@ def beam_search(
             step, (buf, cache, tok, jnp.int32(s), scores), None,
             length=max_new_tokens - 1,
         )
-    norm = scores / (max_new_tokens ** length_penalty)
+    # HF's BeamHypotheses normalizes by the FULL hypothesis length
+    # (prompt + generated), not just the generated span — all beams share
+    # one length here so ranking is unaffected, but the reported scores
+    # match HF's convention only with the full length.
+    norm = scores / (total ** length_penalty)
     # beams are already best-first per batch row (top_k sorts descending)
     return buf.reshape(b, k, total), norm.reshape(b, k)

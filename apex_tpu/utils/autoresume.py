@@ -76,6 +76,7 @@ class AutoResume:
         self._requested = False
         self._saved_for_termination = False
         self._prev_handlers = {}
+        self._consensus = None  # lazily-built (sharding, jitted max) pair
         if install_handlers:
             for sig in signals:
                 self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
@@ -112,12 +113,20 @@ class AutoResume:
             return self._requested
         # the collective path runs on ANY multi-device mesh so the CPU-mesh
         # tests exercise the code multi-host actually uses (on one process
-        # it reduces identical flags; the cost is one scalar all-reduce)
+        # it reduces identical flags; the cost is one scalar all-reduce).
+        # The mesh/sharding/jitted reduction are built ONCE and reused —
+        # a fresh jax.jit per poll would re-trace and re-dispatch every
+        # step, dwarfing the advertised one-scalar-all-reduce cost.
+        if self._consensus is None:
+            mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("hosts",))
+            sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("hosts")
+            )
+            reduce = jax.jit(jnp.max, out_shardings=jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec()))
+            self._consensus = (sharding, reduce)
+        sharding, reduce = self._consensus
         local = np.asarray([np.float32(self._requested)])
-        mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("hosts",))
-        sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec("hosts")
-        )
         # every device in this process carries the process-local flag
         per_dev = [
             jax.device_put(local, d) for d in jax.local_devices()
@@ -125,8 +134,7 @@ class AutoResume:
         global_flags = jax.make_array_from_single_device_arrays(
             (jax.device_count(),), sharding, per_dev
         )
-        anyone = jax.jit(jnp.max, out_shardings=jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec()))(global_flags)
+        anyone = reduce(global_flags)
         return bool(np.asarray(anyone)[()] > 0)
 
     # -- loop API ----------------------------------------------------------

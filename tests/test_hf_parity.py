@@ -383,8 +383,10 @@ def test_beam_search():
     assert toks.shape == (2, 2, 3) and scores.shape == (2, 2)
     # best beam took the trap exit, not the greedy dead end
     np.testing.assert_array_equal(np.asarray(toks[:, 0, 1:]), [[2, 3], [2, 3]])
+    # normalized by the FULL hypothesis length (prompt 1 + generated 2),
+    # HF's BeamHypotheses convention
     np.testing.assert_allclose(
-        np.asarray(scores[:, 0]), np.log(0.4 * 0.9) / 2, rtol=1e-5
+        np.asarray(scores[:, 0]), np.log(0.4 * 0.9) / 3, rtol=1e-5
     )
     # greedy walks into the trap
     g = generate(model, variables, prompt, max_new_tokens=2)
