@@ -30,6 +30,17 @@ _BUILD_DIR = os.path.join(_ROOT, "csrc", "build")
 _SO = os.path.join(_BUILD_DIR, "libapex_tpu_C.so")
 
 
+def _installed_ext() -> Optional[str]:
+    """A wheel/editable install may have built the extension as
+    ``apex_tpu/_C.*.so`` (setup.py, optional) — prefer it over an on-demand
+    compile, which needs the repo-layout ``csrc/`` next to the package."""
+    import glob
+
+    hits = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "_C*.so")))
+    return hits[0] if hits else None
+
+
 def _compile() -> Optional[str]:
     if not os.path.exists(_SRC):
         return None
@@ -59,7 +70,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _TRIED:
             return _LIB
         _TRIED = True
-        so = _compile()
+        so = _installed_ext() or _compile()
         if so is None:
             return None
         try:
