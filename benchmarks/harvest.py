@@ -56,6 +56,10 @@ def results_state(out_path):
             if rec.get("ok") and rec.get("section"):
                 if rec["section"] == "smoke" and rec.get("rc") not in (0, 1):
                     continue
+                if rec.get("incomplete"):
+                    # budget-skipped / transiently-errored items inside an
+                    # otherwise-ok section: the section must be retried
+                    continue
                 done.add(rec["section"])
     return done
 

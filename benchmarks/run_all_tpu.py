@@ -155,17 +155,26 @@ def run_micro(deadline):
             4, 16, 2048, 128, jax.random.fold_in(key, 8), deadline=d)),
         ("attention_16k_s", lambda d: bo.bench_attention_long(
             jax.random.fold_in(key, 9), deadline=d)),
+        # openfold-tier small shapes (VERDICT r3 item 9)
+        ("small_shapes", lambda d: __import__("bench_small_shapes").run_all(
+            jax.random.fold_in(key, 10), deadline=d)),
     ]
+    incomplete = []
     for i, (name, fn) in enumerate(items):
         remaining = deadline - time.monotonic()
         if remaining <= 30:
             rec[name] = "skipped: section budget exhausted"
+            incomplete.append(name)
             continue
         item_deadline = time.monotonic() + remaining / (len(items) - i)
         try:
             rec[name] = fn(item_deadline)
         except Exception as e:
             rec[name] = f"error: {e}"
+            incomplete.append(name)
+    if incomplete:
+        # harvest.py retries sections whose record carries `incomplete`
+        rec["incomplete"] = incomplete
     return rec
 
 
@@ -173,17 +182,23 @@ def run_configs(deadline):
     import bench_configs as bc
 
     out = {}
+    incomplete = []
     for name in ("mlp", "bert", "dp", "gpt", "llama", "decode"):
         if time.monotonic() > deadline:
             out[name] = {"skipped": "section budget exhausted"}
+            incomplete.append(name)
             continue
         t0 = time.time()
         try:
             out[name] = bc.CONFIGS[name](tpu=True)
         except Exception as e:
             out[name] = {"error": str(e)[-500:]}
+            incomplete.append(name)
         out[name]["elapsed_s"] = round(time.time() - t0, 1)
-    return {"configs": out}
+    rec = {"configs": out}
+    if incomplete:
+        rec["incomplete"] = incomplete
+    return rec
 
 
 def main():
