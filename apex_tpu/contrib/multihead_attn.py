@@ -33,13 +33,25 @@ from apex_tpu.ops.softmax import fused_scale_mask_softmax
 
 
 def _attend(q, k, v, mask_additive_bias, key_padding_mask, dropout, scaling,
-            deterministic, dropout_rng_module, causal=False):
-    """q,k,v: (b*h grouped as b, h, s, d) -> (b, h, sq, d)."""
-    if mask_additive_bias is None and (dropout == 0.0 or deterministic):
+            deterministic, dropout_rng_module, causal=False, impl="auto"):
+    """q,k,v: (b*h grouped as b, h, s, d) -> (b, h, sq, d).
+
+    ``impl`` mirrors the reference modules' constructor knob ('fast' vs
+    'default'): "auto" dispatches to the flash kernel on TPU, "xla" forces
+    the unfused composition (the ref's 'default')."""
+    if (mask_additive_bias is None and (dropout == 0.0 or deterministic)
+            and impl != "xla"):
         # key padding stays on the flash fast path (ops/attention.py kpm)
         return flash_attention(
             q, k, v, causal=causal, scale=scaling,
-            key_padding_mask=key_padding_mask,
+            key_padding_mask=key_padding_mask, impl=impl,
+        )
+    if impl == "pallas":
+        # forcing the kernel must not silently degrade to the unfused path
+        # (ops/_dispatch semantics: "pallas" means the compiled kernel)
+        raise ValueError(
+            "impl='pallas' requires the fused path: additive masks and "
+            "active attention dropout only run on the unfused composition"
         )
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
@@ -78,6 +90,9 @@ class SelfMultiheadAttn(nn.Module):
     mask_additive: bool = False
     causal: bool = False
     params_dtype: jnp.dtype = jnp.float32
+    # 'fast'/'default' in the reference; here "auto" (flash on TPU) / "xla"
+    # (unfused composition) / "pallas"
+    impl: str = "auto"
 
     def setup(self):
         assert self.embed_dim % self.num_heads == 0, (
@@ -180,6 +195,7 @@ class SelfMultiheadAttn(nn.Module):
         ctx = _attend(
             qb, kb, vb, additive, kpm, self.dropout, hd**-0.5,
             deterministic, self.attn_dropout, causal=self.causal,
+            impl=self.impl,
         )
         # (b, h, s, hd) -> (s, b, e)
         out = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
@@ -205,6 +221,7 @@ class EncdecMultiheadAttn(nn.Module):
     bias: bool = False
     include_norm_add: bool = False
     params_dtype: jnp.dtype = jnp.float32
+    impl: str = "auto"  # see SelfMultiheadAttn
 
     def setup(self):
         assert self.embed_dim % self.num_heads == 0
@@ -264,6 +281,7 @@ class EncdecMultiheadAttn(nn.Module):
         ctx = _attend(
             shape_bh(q), shape_bh(k), shape_bh(v), None, key_padding_mask,
             self.dropout, hd**-0.5, deterministic, self.attn_dropout,
+            impl=self.impl,
         )
         out = jnp.transpose(ctx, (2, 0, 1, 3)).reshape(sq, b, e)
         out = out @ self.out_proj_weight.astype(out.dtype)

@@ -164,12 +164,16 @@ class GPTModel(nn.Module):
         tokens,
         position_ids=None,
         attention_mask=None,
+        key_padding_mask=None,
         labels=None,
         loss_mask=None,
         deterministic: bool = True,
         cache_len=None,
         decode_step: bool = False,
     ):
+        # key_padding_mask: (b, s) bool, True = padded-out key; stays on the
+        # attention fast paths (flash kernel, ring/Ulysses CP — under cp>1
+        # pass the LOCAL sequence shard, sharded exactly like tokens)
         cfg = self.config
         cache_active = cache_len is not None or decode_step
         if self.pre_process:
@@ -198,6 +202,7 @@ class GPTModel(nn.Module):
         h = self.transformer(
             h,
             attention_mask=attention_mask,
+            key_padding_mask=key_padding_mask,
             rotary_pos_emb=rotary,
             deterministic=deterministic,
             **(

@@ -171,7 +171,11 @@ def run_micro(deadline):
             rec[name] = fn(item_deadline)
         except Exception as e:
             rec[name] = f"error: {e}"
-            incomplete.append(name)
+            # only BUDGET exhaustion is worth a retry in a later window; a
+            # raised measurement is a captured (deterministic) answer — the
+            # same reasoning as smoke's rc=1-counts-as-captured
+            if "budget exhausted" in str(e):
+                incomplete.append(name)
     if incomplete:
         # harvest.py retries sections whose record carries `incomplete`
         rec["incomplete"] = incomplete
@@ -193,7 +197,8 @@ def run_configs(deadline):
             out[name] = bc.CONFIGS[name](tpu=True)
         except Exception as e:
             out[name] = {"error": str(e)[-500:]}
-            incomplete.append(name)
+            if "budget exhausted" in str(e):  # see run_micro
+                incomplete.append(name)
         out[name]["elapsed_s"] = round(time.time() - t0, 1)
     rec = {"configs": out}
     if incomplete:

@@ -122,6 +122,29 @@ class TestGPT:
         assert losses.shape == (2, 16)
         assert bool(jnp.all(jnp.isfinite(losses)))
 
+    def test_key_padding_mask_blocks_padded_keys(self, rng):
+        """key_padding_mask through GPTModel: tokens at padded-out MIDDLE
+        positions must not influence later positions' logits (causally they
+        would, so this isolates the mask), matching the flash kernel's kpm
+        semantics end to end."""
+        cfg = tiny_cfg()
+        model = GPTModel(config=cfg)
+        tokens, _ = data(rng)
+        kpm = jnp.zeros(tokens.shape, bool).at[:, 5:8].set(True)
+        params = model.init(rng, tokens)
+        tokens2 = tokens.at[:, 5:8].set((tokens[:, 5:8] + 7) % VOCAB)
+
+        l1 = model.apply(params, tokens, key_padding_mask=kpm)
+        l2 = model.apply(params, tokens2, key_padding_mask=kpm)
+        np.testing.assert_allclose(
+            np.asarray(l1[:, 8:]), np.asarray(l2[:, 8:]), atol=1e-5
+        )
+        # and without the mask the same perturbation DOES propagate
+        l3 = model.apply(params, tokens2)
+        assert float(jnp.max(jnp.abs(
+            l3[:, 8:] - model.apply(params, tokens)[:, 8:]
+        ))) > 1e-3
+
     def test_dropout_training_path(self, rng):
         """deterministic=False with dropout>0 must run (regression: inline
         Dropout in a setup()-based module crashed the training path)."""
