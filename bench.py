@@ -155,6 +155,64 @@ def run_bench():
     return 0
 
 
+def harvested_tpu_record(path=None, max_age_h=None):
+    """Newest FRESH successful headline record in
+    benchmarks/tpu_results.jsonl (written by run_all_tpu.py during relay
+    windows — the CPU fallback never writes there, so everything in the
+    file ran on the real backend), or None.
+
+    Freshness: records older than ``max_age_h`` (default 24, env
+    ``APEX_TPU_REPLAY_MAX_AGE_H``) are ignored — the file is git-tracked,
+    so without this bound a record committed in a past round would replay
+    as current-session data long after the measured code changed.
+    Recency beats completeness: a newer partial 'headline_o2' wins over an
+    older full 'headline' (the newer one measured the current code)."""
+    if path is None:
+        path = os.environ.get("APEX_TPU_RESULTS") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks", "tpu_results.jsonl")
+    if max_age_h is None:
+        max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
+    if not os.path.exists(path):
+        return None
+
+    def ts_epoch(rec):
+        try:
+            return time.mktime(
+                time.strptime(rec.get("ts", ""), "%Y-%m-%dT%H:%M:%S")
+            )
+        except ValueError:
+            return 0.0
+
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if not (rec.get("ok") and rec.get("value")):
+                    continue
+                if rec.get("section") not in ("headline", "headline_o2"):
+                    continue
+                if time.time() - ts_epoch(rec) > max_age_h * 3600:
+                    continue
+                # newer wins; at equal ts the full record beats its own
+                # headline_o2 partial (emitted moments earlier)
+                if best is None or ts_epoch(rec) >= ts_epoch(best):
+                    best = rec
+    except OSError:
+        return None
+    if best is None:
+        return None
+    keep = {k: best[k] for k in
+            ("metric", "value", "unit", "vs_baseline", "o0_value", "ts")
+            if k in best}
+    keep.setdefault("vs_baseline", None)
+    return keep
+
+
 def run_probe():
     """Init the backend and print its platform — nothing else.  Isolates the
     known axon failure modes (fast raise AND indefinite hang) in a child the
@@ -237,7 +295,24 @@ def main():
         diagnostics.append(f"probe saw platform={probe.get('probe_platform')!r}; "
                            "skipping TPU attempts")
 
-    # 3) Unconditional CPU-smoke fallback inside the reserve.
+    # 3) Harvested-TPU replay: benchmarks/harvest.py captures the headline
+    #    during any relay window this session (the relay is up for ~minutes
+    #    per ~hours — round 3 lost its only window to section ordering). A
+    #    record measured on the REAL chip earlier today by the same
+    #    committed harness beats re-measuring on the CPU fallback; it is
+    #    emitted with explicit provenance, never silently.
+    rec = harvested_tpu_record()
+    if rec is not None:
+        rec["platform"] = "tpu_harvested"
+        rec["diagnostic"] = (
+            "no live TPU measurement this run (see attempt log); replaying "
+            "the headline captured on the real TPU by benchmarks/harvest.py "
+            f"at {rec.get('ts')}; " + "; ".join(diagnostics)
+        )[-2000:]
+        print(json.dumps(rec))
+        return 0
+
+    # 4) Unconditional CPU-smoke fallback inside the reserve.
     sys.stderr.write("[bench] no TPU record; CPU smoke fallback\n")
     rec = child(["--run"],
                 extra_env={"APEX_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu"},
@@ -249,7 +324,7 @@ def main():
         print(json.dumps(rec))
         return 0
 
-    # 4) Last resort: the supervisor itself emits the record.  One parsed
+    # 5) Last resort: the supervisor itself emits the record.  One parsed
     #    JSON line, unconditionally — even with the chip unplugged AND the
     #    CPU fallback broken.
     print(json.dumps({
