@@ -181,7 +181,7 @@ def harvested_tpu_record(path=None, max_age_h=None):
             return time.mktime(
                 time.strptime(rec.get("ts", ""), "%Y-%m-%dT%H:%M:%S")
             )
-        except ValueError:
+        except (ValueError, TypeError):  # absent/malformed/non-string ts
             return 0.0
 
     best = None
@@ -301,7 +301,13 @@ def main():
     #    record measured on the REAL chip earlier today by the same
     #    committed harness beats re-measuring on the CPU fallback; it is
     #    emitted with explicit provenance, never silently.
-    rec = harvested_tpu_record()
+    # a corrupt results file must degrade to the CPU fallback, not crash
+    # the supervisor out of its one-JSON-line contract
+    try:
+        rec = harvested_tpu_record()
+    except Exception as e:
+        diagnostics.append(f"harvested replay failed: {e!r}")
+        rec = None
     if rec is not None:
         rec["platform"] = "tpu_harvested"
         rec["diagnostic"] = (

@@ -1,0 +1,160 @@
+"""Long-context training with ring context parallelism (GQA + padding).
+
+The capability the reference does not have (its long-context story tops
+out at Megatron SP + a seq<=512 fused MHA kernel): a GPT whose SEQUENCE is
+sharded over the `cp` mesh axis, attention running as zigzag ring
+attention with grouped (GQA) K/V rotating over the ring, and ragged
+documents handled by a sequence-sharded key-padding mask that rides with
+its K/V chunk. Each chip holds seq/cp of every activation, so max context
+scales linearly in cp.
+
+CPU smoke (8 virtual devices):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python examples/long_context/train_ring_cp.py --steps 10 --cp 4
+
+On a real TPU pod slice the same script runs with cp = number of chips
+along the context axis; only the mesh construction changes.
+"""
+
+import argparse
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(globals().get("__file__", "."))),
+    "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="ring-CP long-context training")
+    p.add_argument("--cp", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--seq-len", type=int, default=256,
+                   help="GLOBAL sequence length (sharded seq/cp per rank)")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--kv-heads", type=int, default=2,
+                   help="GQA: the ring rotates only these")
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--doc-len-min", type=int, default=128,
+                   help="ragged docs: tokens beyond each doc's length are "
+                        "padded out via the key-padding mask")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    if jax.default_backend() == "cpu" and len(jax.devices()) < args.cp:
+        raise SystemExit(
+            f"need {args.cp} devices; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={args.cp}"
+        )
+
+    import optax
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.transformer import TransformerConfig
+
+    mesh = parallel_state.initialize_model_parallel(
+        context_parallel_size=args.cp, devices=jax.devices()[: args.cp]
+    )
+    cfg = TransformerConfig(
+        num_layers=args.layers,
+        hidden_size=args.hidden,
+        num_attention_heads=args.heads,
+        num_query_groups=args.kv_heads,
+        vocab_size=args.vocab,
+        max_position_embeddings=args.seq_len,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        compute_dtype=jnp.float32,
+        context_parallel_mode="ring",
+    )
+    model = GPTModel(config=cfg)
+    opt = fused_adam(lr=args.lr)
+
+    rng = np.random.RandomState(args.seed)
+    # markov-ish stream so the LM has structure to learn; ragged doc
+    # lengths exercise the padding path
+    base = np.cumsum(rng.randint(1, 5, size=(args.batch, args.seq_len)),
+                     axis=1) % args.vocab
+    doc_len = rng.randint(args.doc_len_min, args.seq_len + 1,
+                          size=(args.batch,))
+    pos = np.arange(args.seq_len)[None, :]
+    kpm_np = pos >= doc_len[:, None]  # True = padded-out token
+
+    tokens = jnp.asarray(base, jnp.int32)
+    labels = jnp.roll(tokens, -1, axis=1)
+    kpm = jnp.asarray(kpm_np)
+    loss_mask = (~kpm).astype(jnp.float32)
+
+    # zigzag layout: every rank gets one early + one late sequence piece so
+    # causal ring work is balanced; every seq-aligned tensor reorders the
+    # same way (zigzag handled by the attention layer positions internally
+    # for contiguous layout — this example uses contiguous shards, the
+    # zigzag_shard variant is exercised in tests/test_context_parallel.py)
+    seq_sharded = P(None, "cp")
+
+    @jax.jit
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), seq_sharded, seq_sharded, seq_sharded, seq_sharded),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def train_step(params, opt_state, tokens, labels, kpm, loss_mask):
+        def loss_fn(p):
+            losses = model.apply(
+                p, tokens, labels=labels, key_padding_mask=kpm,
+                loss_mask=loss_mask,
+            )
+            # mean over REAL tokens, globally: sum over cp shards
+            s = jax.lax.psum(jnp.sum(losses), "cp")
+            n = jax.lax.psum(jnp.sum(loss_mask), "cp")
+            return s / jnp.maximum(n, 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(seq_sharded,), out_specs=P(), check_vma=False,
+    )
+    def init_params(tokens):
+        return model.init(jax.random.PRNGKey(args.seed), tokens)
+
+    params = init_params(tokens)
+    opt_state = jax.jit(opt.init)(params)
+
+    print(f"ring-CP GPT: cp={args.cp}  seq {args.seq_len} "
+          f"({args.seq_len // args.cp}/rank)  heads {args.heads} "
+          f"kv_heads {args.kv_heads}  docs {doc_len.tolist()}")
+    for step in range(args.steps):
+        params, opt_state, loss = train_step(
+            params, opt_state, tokens, labels, kpm, loss_mask
+        )
+        print(f"step {step:4d} loss {float(loss):9.4f}")
+    assert np.isfinite(float(loss)), "diverged"
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,7 @@ import pytest  # noqa: E402
 _SLOW_TESTS = {
     "test_amp_mlp_example",
     "test_imagenet_example",
+    "test_long_context_ring_cp_example",
     "test_gpt_pretrain_example",
     "test_gpt_pretrain_resume",
     "test_sparsity_example",

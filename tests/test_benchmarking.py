@@ -95,3 +95,78 @@ def test_nonpositive_slope_raises_instead_of_recording_garbage(monkeypatch):
         B.chained_seconds_per_iter(
             lambda k: lambda: None, (), target_signal=1e9, max_span=32
         )
+
+
+class TestHarvestedReplay:
+    """bench.py's harvested-TPU replay selection (freshness + recency)."""
+
+    def _bench(self):
+        import importlib.util
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", os.path.join(root, "bench.py")
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _write(self, tmp_path, records):
+        import json
+
+        p = tmp_path / "results.jsonl"
+        p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+        return str(p)
+
+    def test_fresh_partial_beats_stale_full(self, tmp_path):
+        import time
+
+        bench = self._bench()
+        now = time.strftime("%Y-%m-%dT%H:%M:%S")
+        old = time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.localtime(time.time() - 48 * 3600)
+        )
+        p = self._write(tmp_path, [
+            {"section": "headline", "ok": True, "metric": "m",
+             "value": 1200.0, "unit": "u", "vs_baseline": 2.0, "ts": old},
+            {"section": "headline_o2", "ok": True, "metric": "m",
+             "value": 4000.0, "unit": "u", "ts": now},
+        ])
+        rec = bench.harvested_tpu_record(p)
+        assert rec["value"] == 4000.0 and rec["vs_baseline"] is None
+
+    def test_stale_records_never_replay(self, tmp_path):
+        import time
+
+        bench = self._bench()
+        old = time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.localtime(time.time() - 48 * 3600)
+        )
+        p = self._write(tmp_path, [
+            {"section": "headline", "ok": True, "metric": "m",
+             "value": 1200.0, "unit": "u", "vs_baseline": 2.0, "ts": old},
+        ])
+        assert bench.harvested_tpu_record(p) is None
+
+    def test_full_record_beats_its_own_partial(self, tmp_path):
+        import time
+
+        bench = self._bench()
+        now = time.strftime("%Y-%m-%dT%H:%M:%S")
+        p = self._write(tmp_path, [
+            {"section": "headline_o2", "ok": True, "metric": "m",
+             "value": 1500.0, "unit": "u", "ts": now},
+            {"section": "headline", "ok": True, "metric": "m",
+             "value": 1500.0, "unit": "u", "vs_baseline": 2.1, "ts": now},
+        ])
+        assert bench.harvested_tpu_record(p)["vs_baseline"] == 2.1
+
+    def test_missing_or_failed_records_yield_none(self, tmp_path):
+        bench = self._bench()
+        assert bench.harvested_tpu_record(str(tmp_path / "nope.jsonl")) is None
+        p = self._write(tmp_path, [
+            {"section": "headline", "ok": False, "value": 9.0},
+            {"section": "micro", "ok": True, "value": 1.0},
+        ])
+        assert bench.harvested_tpu_record(p) is None

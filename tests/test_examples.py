@@ -87,3 +87,11 @@ def test_llama_finetune_example():
 def test_sparsity_example():
     out = _run("examples/sparsity/prune_mlp.py", ["--steps", "6"])
     assert "2:4 zeros preserved through training" in out
+
+
+def test_long_context_ring_cp_example():
+    out = _run("examples/long_context/train_ring_cp.py",
+               ["--steps", "4", "--cp", "4", "--seq-len", "64",
+                "--doc-len-min", "32", "--hidden", "32", "--heads", "4",
+                "--kv-heads", "2"])
+    assert "done" in out and "step    3" in out
