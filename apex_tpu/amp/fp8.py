@@ -25,7 +25,7 @@ the dot; the recipe state machine — what the reference's amax groups
 exist to serve — is identical either way, and it is what the tests pin.
 """
 
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -14,7 +14,6 @@ likewise irrelevant: statistics are computed in fp32 regardless of input
 dtype, matching the kernel's accumulation type.
 """
 
-from typing import Optional
 
 import flax.linen as nn
 import jax

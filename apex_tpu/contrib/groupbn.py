@@ -18,7 +18,6 @@ from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax
-import jax.numpy as jnp
 
 from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
 

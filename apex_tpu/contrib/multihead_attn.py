@@ -21,10 +21,7 @@ Megatron-style (seq, batch, hidden), matching the reference's
 (T, B, H) convention.
 """
 
-from typing import Optional
-
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from apex_tpu.ops.attention import flash_attention

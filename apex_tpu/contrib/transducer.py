@@ -30,7 +30,6 @@ TPU design notes:
   masks, which composes with XLA's fusion at no extra memory traffic.
 """
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

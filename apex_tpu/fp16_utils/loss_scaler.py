@@ -6,7 +6,6 @@ drive the loop manually; the jittable functional scaler lives in
 apex_tpu.amp.scaler (one shared implementation underneath).
 """
 
-import jax.numpy as jnp
 
 from apex_tpu.utils.pytree import tree_any_non_finite
 

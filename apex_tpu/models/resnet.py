@@ -22,7 +22,6 @@ from functools import partial
 from typing import Any, Callable, Sequence, Tuple
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm

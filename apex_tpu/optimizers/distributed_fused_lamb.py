@@ -16,7 +16,6 @@ static segment-id array. Math matches apex's multi_tensor_lamb exactly
 correction, decoupled weight decay, trust ratio ||p||/||update||.
 """
 
-import dataclasses
 from typing import Any, NamedTuple, Tuple
 
 import jax

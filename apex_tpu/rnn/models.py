@@ -10,7 +10,6 @@ default layout).
 from typing import Optional, Type
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from apex_tpu.rnn.cells import (

@@ -13,7 +13,6 @@ and atomic step directories natively.
 import os
 from typing import Any, Optional
 
-import jax
 
 
 def _checkpointer():

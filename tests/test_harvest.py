@@ -1,0 +1,53 @@
+"""Harvester section-state semantics (benchmarks/harvest.py).
+
+What gets retried across relay windows is a correctness question: a
+deterministic kernel failure must count as captured (retrying re-spends a
+window on the same answer) while budget-truncated sections must retry.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "benchmarks"
+))
+
+import harvest
+
+
+def _write(tmp_path, records):
+    p = tmp_path / "r.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+    return str(p)
+
+
+def test_smoke_rc_semantics(tmp_path):
+    # rc=0 (all OK) and rc=1 (deterministic FAIL) are captured; rc=2
+    # (budget skip) retries
+    for rc, captured in [(0, True), (1, True), (2, False)]:
+        p = _write(tmp_path, [{"section": "smoke", "ok": True, "rc": rc}])
+        assert ("smoke" in harvest.results_state(p)) is captured, rc
+
+
+def test_incomplete_sections_retry(tmp_path):
+    p = _write(tmp_path, [
+        {"section": "micro", "ok": True, "adam_step_s": {"flat": 1.0},
+         "incomplete": ["layer_norm_s"]},
+        {"section": "configs", "ok": True, "configs": {}},
+    ])
+    state = harvest.results_state(p)
+    assert "micro" not in state and "configs" in state
+
+
+def test_failed_sections_retry_and_partials_count(tmp_path):
+    p = _write(tmp_path, [
+        {"section": "headline", "ok": False, "error": "relay dropped"},
+        {"section": "headline_o2", "ok": True, "value": 100.0},
+    ])
+    state = harvest.results_state(p)
+    assert "headline" not in state and "headline_o2" in state
+
+
+def test_missing_file_is_empty(tmp_path):
+    assert harvest.results_state(str(tmp_path / "none.jsonl")) == set()
