@@ -1,0 +1,69 @@
+"""Module-style fused dense layers — the ``apex.fused_dense`` surface.
+
+Reference parity: ``from apex.fused_dense import FusedDense,
+FusedDenseGeluDense`` (fused_dense/fused_dense.py:64,82 — cublasLt GEMMs
+with fused bias/GELU epilogues).  The functional forms are
+``apex_tpu.ops.fused_dense``; these flax modules are the drop-in class
+API with the reference's constructor signatures (``bias`` kwarg included;
+weights stored (out, in) like the reference's nn.Parameter layout).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.fused_dense import fused_dense, fused_dense_gelu_dense
+
+__all__ = ["FusedDense", "FusedDenseGeluDense"]
+
+
+class FusedDense(nn.Module):
+    """Drop-in for ``apex.fused_dense.FusedDense`` (fused_dense.py:64)."""
+
+    in_features: int
+    out_features: int
+    bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param(
+            "weight", nn.initializers.lecun_normal(),
+            (self.out_features, self.in_features), self.params_dtype,
+        )
+        b = (
+            self.param("bias", nn.initializers.zeros_init(),
+                       (self.out_features,), self.params_dtype)
+            if self.bias else None
+        )
+        return fused_dense(x, w, b)
+
+
+class FusedDenseGeluDense(nn.Module):
+    """Drop-in for ``apex.fused_dense.FusedDenseGeluDense``
+    (fused_dense.py:82; like the reference, ``bias=False`` is not
+    supported)."""
+
+    in_features: int
+    intermediate_features: int
+    out_features: int
+    bias: bool = True
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        assert self.bias, (
+            "DenseGeluDense module without bias is currently not supported"
+        )
+        init = nn.initializers.lecun_normal()
+        zeros = nn.initializers.zeros_init()
+        w1 = self.param("weight1", init,
+                        (self.intermediate_features, self.in_features),
+                        self.params_dtype)
+        b1 = self.param("bias1", zeros, (self.intermediate_features,),
+                        self.params_dtype)
+        w2 = self.param("weight2", init,
+                        (self.out_features, self.intermediate_features),
+                        self.params_dtype)
+        b2 = self.param("bias2", zeros, (self.out_features,),
+                        self.params_dtype)
+        return fused_dense_gelu_dense(x, w1, b1, w2, b2)

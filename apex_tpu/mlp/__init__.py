@@ -1,0 +1,58 @@
+"""Module-style fused MLP — the ``apex.mlp`` import surface.
+
+Reference parity: ``from apex.mlp import MLP`` (mlp/mlp.py:33 — the C++
+cuBLAS GEMM chain with fused bias/activation epilogues).  The forward
+delegates to ``apex_tpu.ops.mlp.mlp_apply`` (one implementation of the
+accumulation/activation/cast chain); init matches the reference's
+``reset_parameters`` (mlp/mlp.py:71-79): weights ~ N(0, sqrt(2/(fan_in +
+fan_out))), biases ~ N(0, sqrt(1/fan_out)).
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.mlp import _ACTIVATIONS, mlp_apply
+
+__all__ = ["MLP"]
+
+
+class MLP(nn.Module):
+    """Drop-in for ``apex.mlp.MLP`` (mlp/mlp.py:33): same
+    ``mlp_sizes``/``bias``/``activation`` constructor; activation applied
+    to every layer but the last."""
+
+    mlp_sizes: Sequence[int]
+    bias: bool = True
+    activation: str = "relu"
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.activation not in _ACTIVATIONS:
+            raise TypeError("activation must be none, relu, or sigmoid")
+        n = len(self.mlp_sizes) - 1
+        weights, biases = [], []
+        for i in range(n):
+            fan_in, fan_out = self.mlp_sizes[i], self.mlp_sizes[i + 1]
+
+            def w_init(key, shape, dtype, s=(2.0 / (fan_in + fan_out)) ** 0.5):
+                return jax.random.normal(key, shape, dtype) * s
+
+            def b_init(key, shape, dtype, s=(1.0 / fan_out) ** 0.5):
+                return jax.random.normal(key, shape, dtype) * s
+
+            weights.append(self.param(
+                f"weight_{i}", w_init, (fan_out, fan_in), self.params_dtype
+            ))
+            biases.append(
+                self.param(f"bias_{i}", b_init, (fan_out,), self.params_dtype)
+                if self.bias
+                else jnp.zeros((fan_out,), self.params_dtype)
+            )
+        return mlp_apply(
+            {"weights": weights, "biases": biases}, x,
+            activation=self.activation,
+        )

@@ -1,0 +1,116 @@
+"""Module-style fused norms — the ``apex.normalization`` import surface.
+
+Reference parity: ``from apex.normalization import FusedLayerNorm,
+MixedFusedLayerNorm, FusedRMSNorm, MixedFusedRMSNorm``
+(/root/reference/apex/normalization/__init__.py:1;
+fused_layer_norm.py:230/329 for the class semantics).  The functional
+kernels live in ``apex_tpu.ops.layer_norm``; these flax modules provide
+the drop-in class API for users migrating module definitions:
+
+- ``elementwise_affine=False`` runs the no-affine path (ref
+  FusedLayerNormFunction, fused_layer_norm.py:139);
+- ``memory_efficient=True`` recomputes the normalization in backward via
+  ``jax.checkpoint`` instead of saving intermediates (the ref's
+  memory_efficient ctx flag);
+- the Mixed* variants are the mixed-dtype AffineMixedDtypesFunction
+  classes — here the kernels are mixed-dtype by construction (params may
+  be fp32 while activations are bf16), so they differ from the plain
+  classes only in keeping the params_dtype independent of the input, which
+  the plain classes ALSO allow; both names are provided for import parity.
+
+``normalized_shape`` must be the trailing dimension(s); multi-dim shapes
+are flattened into one trailing axis for the kernel (same reduction set).
+"""
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.ops.layer_norm import layer_norm, rms_norm
+
+__all__ = [
+    "FusedLayerNorm",
+    "MixedFusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedRMSNorm",
+]
+
+
+def _shape_tuple(normalized_shape) -> tuple:
+    if isinstance(normalized_shape, (int, np.integer)):
+        return (int(normalized_shape),)
+    return tuple(int(d) for d in normalized_shape)
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in for ``apex.normalization.FusedLayerNorm``
+    (fused_layer_norm.py:230)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        n = int(np.prod(shape))
+        assert x.shape[-len(shape):] == shape, (
+            f"input trailing dims {x.shape[-len(shape):]} != "
+            f"normalized_shape {shape}"
+        )
+        lead = x.shape[: x.ndim - len(shape)]
+        x2 = x.reshape(lead + (n,))
+        if self.elementwise_affine:
+            # params keep the reference's normalized_shape layout
+            # (Parameter(torch.empty(*normalized_shape))) so checkpoint
+            # conversion is shape-for-shape; flattened only for the kernel
+            w = self.param("weight", nn.initializers.ones_init(), shape,
+                           self.params_dtype).reshape(n)
+            b = self.param("bias", nn.initializers.zeros_init(), shape,
+                           self.params_dtype).reshape(n)
+        else:
+            w = b = None
+        out = layer_norm(x2, w, b, eps=self.eps,
+                         memory_efficient=self.memory_efficient)
+        return out.reshape(x.shape)
+
+
+class FusedRMSNorm(nn.Module):
+    """Drop-in for ``apex.normalization.FusedRMSNorm``
+    (fused_layer_norm.py:329)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    params_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        n = int(np.prod(shape))
+        assert x.shape[-len(shape):] == shape, (
+            f"input trailing dims {x.shape[-len(shape):]} != "
+            f"normalized_shape {shape}"
+        )
+        lead = x.shape[: x.ndim - len(shape)]
+        x2 = x.reshape(lead + (n,))
+        w = (
+            self.param("weight", nn.initializers.ones_init(), shape,
+                       self.params_dtype).reshape(n)
+            if self.elementwise_affine else None
+        )
+        out = rms_norm(x2, w, eps=self.eps,
+                       memory_efficient=self.memory_efficient)
+        return out.reshape(x.shape)
+
+
+# Mixed-dtype variants: the TPU kernels are mixed-dtype by construction
+# (see module docstring) — aliases kept for import parity with the
+# reference's MixedFused* classes (fused_layer_norm.py:94,117).
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm

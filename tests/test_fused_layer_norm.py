@@ -103,3 +103,68 @@ def test_layer_norm_memory_efficient(rng):
     a = layer_norm(x, w, b, memory_efficient=True, impl="xla")
     bb = layer_norm(x, w, b, memory_efficient=False, impl="xla")
     np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-6)
+
+
+class TestModuleStyleAPI:
+    """apex.normalization import-surface parity: module classes over the
+    functional kernels (ref fused_layer_norm.py:230/329)."""
+
+    def test_fused_layer_norm_module(self, rng):
+        from apex_tpu.normalization import FusedLayerNorm, MixedFusedLayerNorm
+
+        x = jax.random.normal(rng, (4, 6, 32))
+        m = FusedLayerNorm(normalized_shape=32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-5
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        assert MixedFusedLayerNorm is FusedLayerNorm
+
+    def test_multidim_normalized_shape(self, rng):
+        from apex_tpu.normalization import FusedLayerNorm
+
+        x = jax.random.normal(rng, (3, 4, 8))
+        m = FusedLayerNorm(normalized_shape=(4, 8))  # reduce over both
+        params = m.init(jax.random.PRNGKey(0), x)
+        # params keep the reference layout: Parameter(*normalized_shape)
+        assert params["params"]["weight"].shape == (4, 8)
+        out = m.apply(params, x)
+        flat = x.reshape(3, 32)
+        ref = ((flat - flat.mean(-1, keepdims=True)) / jnp.sqrt(
+            flat.var(-1, keepdims=True) + 1e-5)).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_no_affine_and_rms(self, rng):
+        from apex_tpu.normalization import FusedRMSNorm
+
+        x = jax.random.normal(rng, (4, 32))
+        m = FusedRMSNorm(normalized_shape=32, elementwise_affine=False)
+        params = m.init(jax.random.PRNGKey(0), x)
+        assert not jax.tree_util.tree_leaves(params)  # no params at all
+        out = m.apply(params, x)
+        ref = x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_memory_efficient_grads_match(self, rng):
+        from apex_tpu.normalization import FusedLayerNorm
+
+        x = jax.random.normal(rng, (4, 32))
+
+        def loss(params, m):
+            return jnp.sum(jnp.sin(m.apply(params, x)))
+
+        m1 = FusedLayerNorm(normalized_shape=32)
+        m2 = FusedLayerNorm(normalized_shape=32, memory_efficient=True)
+        params = m1.init(jax.random.PRNGKey(0), x)
+        g1 = jax.grad(loss)(params, m1)
+        g2 = jax.grad(loss)(params, m2)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ), g1, g2,
+        )
