@@ -44,6 +44,30 @@ def _shape_tuple(normalized_shape) -> tuple:
     return tuple(int(d) for d in normalized_shape)
 
 
+def _flatten_normalized(module, x, with_bias: bool):
+    """Shared prologue of both norm modules: validate the trailing dims,
+    flatten them to one axis for the kernel, and create affine params in
+    the reference's normalized_shape layout (checkpoint-conversion is
+    shape-for-shape; flattened only for the kernel call).
+
+    Returns (x2, w, b) with w/b None when elementwise_affine=False."""
+    shape = _shape_tuple(module.normalized_shape)
+    n = int(np.prod(shape))
+    assert x.shape[-len(shape):] == shape, (
+        f"input trailing dims {x.shape[-len(shape):]} != "
+        f"normalized_shape {shape}"
+    )
+    x2 = x.reshape(x.shape[: x.ndim - len(shape)] + (n,))
+    w = b = None
+    if module.elementwise_affine:
+        w = module.param("weight", nn.initializers.ones_init(), shape,
+                         module.params_dtype).reshape(n)
+        if with_bias:
+            b = module.param("bias", nn.initializers.zeros_init(), shape,
+                             module.params_dtype).reshape(n)
+    return x2, w, b
+
+
 class FusedLayerNorm(nn.Module):
     """Drop-in for ``apex.normalization.FusedLayerNorm``
     (fused_layer_norm.py:230)."""
@@ -56,24 +80,7 @@ class FusedLayerNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        shape = _shape_tuple(self.normalized_shape)
-        n = int(np.prod(shape))
-        assert x.shape[-len(shape):] == shape, (
-            f"input trailing dims {x.shape[-len(shape):]} != "
-            f"normalized_shape {shape}"
-        )
-        lead = x.shape[: x.ndim - len(shape)]
-        x2 = x.reshape(lead + (n,))
-        if self.elementwise_affine:
-            # params keep the reference's normalized_shape layout
-            # (Parameter(torch.empty(*normalized_shape))) so checkpoint
-            # conversion is shape-for-shape; flattened only for the kernel
-            w = self.param("weight", nn.initializers.ones_init(), shape,
-                           self.params_dtype).reshape(n)
-            b = self.param("bias", nn.initializers.zeros_init(), shape,
-                           self.params_dtype).reshape(n)
-        else:
-            w = b = None
+        x2, w, b = _flatten_normalized(self, x, with_bias=True)
         out = layer_norm(x2, w, b, eps=self.eps,
                          memory_efficient=self.memory_efficient)
         return out.reshape(x.shape)
@@ -91,19 +98,7 @@ class FusedRMSNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        shape = _shape_tuple(self.normalized_shape)
-        n = int(np.prod(shape))
-        assert x.shape[-len(shape):] == shape, (
-            f"input trailing dims {x.shape[-len(shape):]} != "
-            f"normalized_shape {shape}"
-        )
-        lead = x.shape[: x.ndim - len(shape)]
-        x2 = x.reshape(lead + (n,))
-        w = (
-            self.param("weight", nn.initializers.ones_init(), shape,
-                       self.params_dtype).reshape(n)
-            if self.elementwise_affine else None
-        )
+        x2, w, _ = _flatten_normalized(self, x, with_bias=False)
         out = rms_norm(x2, w, eps=self.eps,
                        memory_efficient=self.memory_efficient)
         return out.reshape(x.shape)

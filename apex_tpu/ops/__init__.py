@@ -26,7 +26,11 @@ from apex_tpu.ops.softmax import (
     generic_scaled_masked_softmax,
     fused_scale_mask_softmax,
 )
-from apex_tpu.ops.rope import apply_rotary_pos_emb, rope_frequencies
+from apex_tpu.ops.rope import (
+    apply_rotary_pos_emb,
+    apply_rotary_pos_emb_cached,
+    rope_frequencies,
+)
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
 from apex_tpu.ops.fused_dense import fused_dense, fused_dense_gelu_dense
 from apex_tpu.ops.mlp import mlp_apply, mlp_init
@@ -51,6 +55,7 @@ __all__ = [
     "fused_scale_mask_softmax",
     "apply_rotary_pos_emb",
     "rope_frequencies",
+    "apply_rotary_pos_emb_cached",
     "softmax_cross_entropy_loss",
     "fused_dense",
     "fused_dense_gelu_dense",

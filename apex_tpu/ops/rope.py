@@ -32,6 +32,21 @@ def _rotate_half(x):
     return jnp.concatenate([-x2, x1], axis=-1)
 
 
+def apply_rotary_pos_emb_cached(t, cos_, sin_):
+    """Cached-cos/sin RoPE (ref: fused_apply_rotary_pos_emb_cached,
+    transformer/functional/fused_rope.py:121 — t (s, b, h, d), cos_/sin_
+    (s, 1, 1, rot_dim)).  ``transpose_output_memory`` is a CUDA memory-
+    format knob with no XLA meaning and is intentionally absent."""
+    rot_dim = cos_.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    tr = t_rot.astype(jnp.float32)
+    out = (tr * cos_.astype(jnp.float32)
+           + _rotate_half(tr) * sin_.astype(jnp.float32)).astype(t.dtype)
+    if t_pass.shape[-1] == 0:
+        return out
+    return jnp.concatenate([out, t_pass], axis=-1)
+
+
 def apply_rotary_pos_emb(t, freqs):
     """Apply RoPE to the first ``rot_dim`` channels of ``t``.
 

@@ -22,6 +22,7 @@ from apex_tpu.parallel.layers import (
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
 from apex_tpu.parallel import mappings
 from apex_tpu.parallel import pipeline
+from apex_tpu.optimizers.larc import LARC, larc
 from apex_tpu.parallel import random
 from apex_tpu.parallel.ring_attention import (
     ring_attention,
@@ -37,6 +38,8 @@ from apex_tpu.parallel.utils import (
 
 __all__ = [
     "parallel_state",
+    "LARC",  # ref: apex.parallel re-exports LARC (apex/parallel/__init__.py)
+    "larc",
     "DistributedDataParallel",
     "Reducer",
     "all_reduce_gradients",

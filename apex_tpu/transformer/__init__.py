@@ -1,5 +1,14 @@
-"""Megatron-style transformer building blocks (ref: apex/transformer)."""
+"""Megatron-style transformer building blocks (ref: apex/transformer).
 
+The reference's submodule namespace (its __init__.py re-exports amp,
+functional, parallel_state, pipeline_parallel, tensor_parallel, utils) is
+reproduced so Megatron-style imports migrate by substituting the package
+root; implementations live in apex_tpu.parallel / apex_tpu.ops.
+"""
+
+from apex_tpu.parallel import parallel_state
+from apex_tpu.transformer import amp, functional, pipeline_parallel, tensor_parallel
+from apex_tpu.transformer import utils
 from apex_tpu.transformer.config import TransformerConfig
 from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
 from apex_tpu.transformer.layer import (
@@ -21,6 +30,12 @@ from apex_tpu.transformer.utils import (
 )
 
 __all__ = [
+    "amp",
+    "functional",
+    "parallel_state",
+    "pipeline_parallel",
+    "tensor_parallel",
+    "utils",
     "MoEMLP",
     "average_losses_across_data_parallel_group",
     "calc_params_l2_norm",

@@ -1,0 +1,7 @@
+"""``apex.transformer.amp`` import-surface alias (reference:
+/root/reference/apex/transformer/amp/__init__.py — GradScaler with
+found_inf synchronized over the model-parallel axes)."""
+
+from apex_tpu.amp.grad_scaler import GradScaler
+
+__all__ = ["GradScaler"]

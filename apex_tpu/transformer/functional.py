@@ -1,0 +1,22 @@
+"""``apex.transformer.functional`` import-surface alias.
+
+Reference parity: /root/reference/apex/transformer/functional/__init__.py
+(``FusedScaleMaskSoftmax``, ``fused_apply_rotary_pos_emb``,
+``fused_apply_rotary_pos_emb_cached``).  Implementations in
+``apex_tpu.ops`` (softmax dispatcher; RoPE with precomputed-frequency
+variant).
+"""
+
+from apex_tpu.ops.rope import (
+    apply_rotary_pos_emb as fused_apply_rotary_pos_emb,
+)
+from apex_tpu.ops.rope import (
+    apply_rotary_pos_emb_cached as fused_apply_rotary_pos_emb_cached,
+)
+from apex_tpu.ops.softmax import FusedScaleMaskSoftmax
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_cached",
+]

@@ -1,0 +1,62 @@
+"""``apex.transformer.tensor_parallel`` import-surface alias.
+
+Reference parity: /root/reference/apex/transformer/tensor_parallel/
+__init__.py — the names Megatron-style user code imports.  The
+implementations live in ``apex_tpu.parallel`` (the TPU design keeps one
+parallel package instead of mirroring the reference's split); this module
+re-exports them under the reference's path so
+``from apex.transformer.tensor_parallel import ColumnParallelLinear``
+migrates by substituting the package root.
+
+CUDA-only attribute helpers (set_tensor_model_parallel_attributes etc.)
+have no TPU meaning — sharding is carried by the mesh/PartitionSpec, not
+per-tensor attributes — and are intentionally absent; ``checkpoint`` and
+the RNG helpers map per docs/migration.md (fold_in replaces the CUDA RNG
+state tracker).
+"""
+
+from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.parallel.random import (
+    checkpoint,
+    model_parallel_rng_key,
+    model_parallel_seed,
+)
+from apex_tpu.parallel.utils import (
+    VocabUtility,
+    broadcast_data,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "broadcast_data",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "checkpoint",
+    "model_parallel_rng_key",
+    "model_parallel_seed",
+    "split_tensor_along_last_dim",
+    "VocabUtility",
+]
