@@ -38,6 +38,7 @@ from apex_tpu.amp.scaler import (
     unscale_grads,
 )
 from apex_tpu.amp.grad_scaler import GradScaler
+from apex_tpu.amp.optimizer import AmpOptimizer, AmpOptimizerState, master_params
 from apex_tpu.amp.fp8 import (
     Fp8TensorState,
     fp8_dense,
@@ -45,6 +46,7 @@ from apex_tpu.amp.fp8 import (
     update_fp8_state,
 )
 from apex_tpu.amp.cast_engine import (
+    disable_casts,
     cast_ops,
     float_function,
     half_function,
@@ -55,6 +57,10 @@ from apex_tpu.amp.cast_engine import (
 )
 
 __all__ = [
+    "disable_casts",
+    "AmpOptimizer",
+    "AmpOptimizerState",
+    "master_params",
     "Fp8TensorState",
     "fp8_dense",
     "init_fp8_state",

@@ -13,6 +13,13 @@ Autodiff falls out for free: every cast is ``astype``, whose VJP is a cast
 back, so gradients arrive in each input's original dtype — the reference
 asserts the same (test_basic_casts.py run_layer_test: ``x.grad.type() ==
 MATCH_INPUT[typ]``).
+
+Scope caveat (differs from the reference, which also wraps torch.Tensor
+METHODS): jax.Array operator sugar (``x @ y``, ``x.dot(y)``) binds its
+implementations at class-definition time and is NOT intercepted — only
+module-level calls (``jnp.matmul``, ``lax.dot_general``, ``jax.nn.*`` and
+the flax layers in FP16_MODULE_CALLS, which is where model FLOPs actually
+live) are cast.
 """
 
 import contextlib
@@ -37,6 +44,7 @@ class _State:
 
     def __init__(self):
         self.depth = 0
+        self.disabled = 0  # disable_casts() nesting count (depth untouched)
         self.half_dtype = None
         self.saved = []  # [(module, name, original)]
         self.lock = threading.RLock()
@@ -83,7 +91,7 @@ def _make_cast_wrapper(orig, convert):
 
     @functools.wraps(orig)
     def wrapper(*args, **kwargs):
-        if _state.depth == 0:  # context exited but a stale ref survived
+        if _state.depth == 0 or _state.disabled:
             return orig(*args, **kwargs)
         args, kwargs = _tree_cast((args, kwargs), convert)
         return orig(*args, **kwargs)
@@ -100,7 +108,7 @@ def _make_promote_wrapper(orig):
 
     @functools.wraps(orig)
     def wrapper(*args, **kwargs):
-        if _state.depth == 0:
+        if _state.depth == 0 or _state.disabled:
             return orig(*args, **kwargs)
         leaves = [
             x for x in jax.tree_util.tree_leaves((args, kwargs)) if _is_float(x)
@@ -124,7 +132,7 @@ def _make_half_output_wrapper(orig, to_half):
     @functools.wraps(orig)
     def wrapper(self, *args, **kwargs):
         out = orig(self, *args, **kwargs)
-        if _state.depth == 0:
+        if _state.depth == 0 or _state.disabled:
             return out
         return _tree_cast(out, to_half)
 
@@ -261,6 +269,26 @@ def float_function(fn):
 def promote_function(fn):
     """Decorator: promote mixed half/fp32 args to fp32 under O1."""
     return _make_promote_wrapper(fn)
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """Temporarily run ops WITHOUT O1 casting inside an active ``cast_ops``
+    region (ref: apex.amp.disable_casts, handle.py:164 — used around
+    fp32-sensitive blocks like optimizer math or custom losses).
+
+    A separate nesting COUNTER, deliberately not a mutation of ``depth``:
+    zeroing depth would let a cast_ops entered inside the disabled region
+    double-patch (and its exit strip the outer region's wrappers), and
+    concurrent enters would corrupt the pairing — the wrappers instead
+    check ``disabled`` alongside ``depth``."""
+    with _state.lock:
+        _state.disabled += 1
+    try:
+        yield
+    finally:
+        with _state.lock:
+            _state.disabled -= 1
 
 
 @contextlib.contextmanager

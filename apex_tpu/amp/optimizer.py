@@ -111,3 +111,14 @@ class AmpOptimizer:
 
     def load_state_dict(self, state: AmpOptimizerState, d: dict) -> AmpOptimizerState:
         return state.replace(scaler=self.scaler.load_state_dict(d["scaler"]))
+
+
+def master_params(state: AmpOptimizerState):
+    """The fp32 master params owned by an ``AmpOptimizer`` state.
+
+    Ref: ``apex.amp.master_params(optimizer)`` (_amp_state.py:50) — there a
+    generator over optimizer.param_groups; here the functional state's
+    master pytree is returned directly (leaves, like the reference, via
+    ``jax.tree_util.tree_leaves`` if iteration is wanted).
+    """
+    return state.master

@@ -398,3 +398,39 @@ class TestCastThroughRNNScan:
             )(params)
         for leaf in jax.tree_util.tree_leaves(g):
             assert jnp.all(jnp.isfinite(leaf))
+
+
+def test_disable_casts_inside_cast_ops():
+    """ref apex.amp.disable_casts (handle.py:164): a block inside an active
+    O1 region runs at full precision, and casting resumes after."""
+    from apex_tpu.amp import disable_casts
+
+    x = jnp.ones((4, 4), jnp.float32)
+    dot = lambda: jax.lax.dot_general(x, x, (((1,), (0,)), ((), ())))
+    with _ctx(jnp.bfloat16):
+        assert dot().dtype == jnp.bfloat16
+        with disable_casts():
+            assert dot().dtype == jnp.float32
+        assert dot().dtype == jnp.bfloat16
+    assert dot().dtype == jnp.float32
+
+
+def test_cast_ops_nested_inside_disable_casts():
+    """Entering cast_ops inside a disabled region must neither double-patch
+    nor strip the outer region's wrappers on exit."""
+    from apex_tpu.amp import disable_casts
+    from apex_tpu.amp import cast_engine
+
+    x = jnp.ones((4, 4), jnp.float32)
+    dot = lambda: jax.lax.dot_general(x, x, (((1,), (0,)), ((), ())))
+    with _ctx(jnp.bfloat16):
+        n_saved = len(cast_engine._state.saved)
+        with disable_casts():
+            with _ctx(jnp.bfloat16):  # reentrant enter while disabled
+                assert len(cast_engine._state.saved) == n_saved  # no re-patch
+                assert dot().dtype == jnp.float32  # still disabled
+        # outer region's wrappers intact and active again
+        assert len(cast_engine._state.saved) == n_saved
+        assert dot().dtype == jnp.bfloat16
+    assert not cast_engine._state.saved
+    assert dot().dtype == jnp.float32
