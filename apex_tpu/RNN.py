@@ -1,0 +1,10 @@
+"""``apex.RNN`` import-surface alias (reference:
+/root/reference/apex/RNN/__init__.py — the deprecated-but-shipped RNN
+factories).  Implementations live in ``apex_tpu.rnn`` (lowercase, the
+package's own naming); this alias keeps
+``from apex.RNN import LSTM`` migrations working verbatim."""
+
+from apex_tpu.rnn import models
+from apex_tpu.rnn.models import GRU, LSTM, ReLU, Tanh, mLSTM
+
+__all__ = ["models", "LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]

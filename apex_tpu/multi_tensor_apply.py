@@ -1,0 +1,35 @@
+"""``apex.multi_tensor_apply`` import-surface alias (reference:
+/root/reference/apex/multi_tensor_apply/__init__.py — a ``MultiTensorApply``
+class instantiated once as ``multi_tensor_applier``).
+
+The TPU engine lives in ``apex_tpu.ops.multi_tensor``; its
+``multi_tensor_applier`` is a function with the reference's call contract
+``applier(op, noop_flag, tensor_lists, *args)``.  ``MultiTensorApply``
+is kept as a constructor-compatible shim: the chunk-size argument sized
+CUDA kernel launches and has no meaning under XLA fusion (the engine's own
+CHUNK_SIZE governs the flat Pallas kernels), so instances simply forward
+to the function."""
+
+from apex_tpu.ops.multi_tensor import CHUNK_SIZE
+from apex_tpu.ops.multi_tensor import multi_tensor_applier as _applier_fn
+
+__all__ = ["MultiTensorApply", "multi_tensor_applier"]
+
+
+class MultiTensorApply:
+    """Constructor-compatible shim for ``apex.multi_tensor_apply.
+    MultiTensorApply(chunk_size)`` (multi_tensor_apply.py:25-31)."""
+
+    available = True
+
+    def __init__(self, chunk_size: int = CHUNK_SIZE):
+        self.chunk_size = chunk_size  # recorded; XLA owns tiling
+
+    def __call__(self, op, noop_flag, tensor_lists, *args):
+        return _applier_fn(op, noop_flag, tensor_lists, *args)
+
+
+# an INSTANCE, exactly like the reference's module-level singleton —
+# reference code pervasively gates on `multi_tensor_applier.available`
+# (e.g. apex/optimizers/fused_sgd.py), which a bare function would break
+multi_tensor_applier = MultiTensorApply(CHUNK_SIZE)
