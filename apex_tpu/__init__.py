@@ -70,3 +70,27 @@ def deprecated_warning(msg: str) -> None:
     import warnings
 
     warnings.warn(msg, FutureWarning, stacklevel=2)
+
+
+# Eager subpackage imports, mirroring the reference's top-level __init__
+# (apex/__init__.py: __all__ = amp, fp16_utils, optimizers, normalization,
+# transformer [+ parallel]) so `import apex_tpu; apex_tpu.amp.initialize(...)`
+# works like `import apex; apex.amp...`.
+from apex_tpu import amp  # noqa: E402
+from apex_tpu import fp16_utils  # noqa: E402
+from apex_tpu import normalization  # noqa: E402
+from apex_tpu import optimizers  # noqa: E402
+from apex_tpu import parallel  # noqa: E402
+from apex_tpu import transformer  # noqa: E402
+
+__all__ = [
+    "amp",
+    "fp16_utils",
+    "optimizers",
+    "normalization",
+    "transformer",
+    "parallel",
+    "get_logger",
+    "set_logging_level",
+    "deprecated_warning",
+]

@@ -114,3 +114,16 @@ def test_top_level_package_aliases():
 
     out, flag = applier(scale_op, noop, [[jnp.ones(4)]], 2.0)
     np.testing.assert_allclose(np.asarray(out[0][0]), 2.0)
+
+
+def test_import_apex_tpu_exposes_subpackages():
+    """`import apex_tpu; apex_tpu.amp...` works like `import apex`
+    (ref apex/__init__.py __all__)."""
+    import apex_tpu
+
+    assert callable(apex_tpu.amp.initialize)
+    assert callable(apex_tpu.optimizers.FusedAdam)
+    assert apex_tpu.normalization.FusedLayerNorm is not None
+    assert apex_tpu.parallel.DistributedDataParallel is not None
+    assert apex_tpu.transformer.TransformerConfig is not None
+    assert apex_tpu.fp16_utils.FP16_Optimizer is not None
