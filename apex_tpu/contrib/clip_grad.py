@@ -1,0 +1,11 @@
+"""``apex.contrib.clip_grad`` import-surface alias (reference:
+contrib/clip_grad/__init__.py — ``clip_grad_norm_``).  The TPU
+implementation lives in ``apex_tpu.optimizers.clip_grad``; the
+underscore name is kept for import parity, but being functional it
+RETURNS (clipped_tree, total_norm) instead of mutating .grad in place."""
+
+from apex_tpu.optimizers.clip_grad import clip_grad_norm
+
+clip_grad_norm_ = clip_grad_norm
+
+__all__ = ["clip_grad_norm_", "clip_grad_norm"]

@@ -1,0 +1,8 @@
+"""``apex.contrib.cudnn_gbn`` import-surface alias (reference:
+contrib/cudnn_gbn/__init__.py — ``GroupBatchNorm2d`` over cudnn).  Same
+capability as contrib.groupbn on TPU (one psum-based implementation —
+see apex_tpu/contrib/groupbn.py), re-exported under the cudnn path."""
+
+from apex_tpu.contrib.groupbn import GroupBatchNorm2d
+
+__all__ = ["GroupBatchNorm2d"]

@@ -1,0 +1,12 @@
+"""``apex.contrib.fmha`` import-surface alias (reference: contrib/fmha —
+the MLPerf-BERT fused MHA, seq <= 512, packed variable-seqlen QKV).
+
+Superseded on TPU by the Pallas flash-attention kernel (no sequence cap;
+variable sequence lengths via ``key_padding_mask`` instead of the CUDA
+packed cu_seqlens layout — see ops/attention.py).  ``fmha`` is exported
+as that kernel for migrating call sites."""
+
+from apex_tpu.ops.attention import flash_attention as fmha
+from apex_tpu.ops.attention import flash_attention
+
+__all__ = ["fmha", "flash_attention"]

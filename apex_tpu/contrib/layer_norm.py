@@ -1,0 +1,9 @@
+"""``apex.contrib.layer_norm`` import-surface alias (reference:
+contrib/layer_norm/__init__.py — ``FastLayerNorm``, the fast_layer_norm
+CUDA kernels).  On TPU one Pallas LayerNorm serves both the
+apex.normalization tier and this "fast" tier (same kernel, no seq cap),
+so FastLayerNorm is the module class from ``apex_tpu.normalization``."""
+
+from apex_tpu.normalization import FusedLayerNorm as FastLayerNorm
+
+__all__ = ["FastLayerNorm"]

@@ -1,0 +1,19 @@
+"""``apex.contrib.openfold_triton`` import-surface alias (reference:
+contrib/openfold_triton — AlphaFold-shape-specialized Triton kernels:
+LayerNormSmallShapeOptImpl, small fused MHA, FusedAdamSWA).
+
+TPU mapping:
+
+- ``FusedAdamSWA`` is a full port (``apex_tpu.optimizers.fused_adam_swa``).
+- ``LayerNormSmallShapeOptImpl`` and the small-MHA tier map onto the
+  generic Pallas/XLA kernels; whether those need a small-shape-tuned path
+  is a MEASURED question — ``benchmarks/bench_small_shapes.py`` runs the
+  openfold evoformer shapes (LN hidden 64/128, MHA seq<=256 head_dim
+  8/16) and BENCH.md carries the decision row.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as LayerNormSmallShapeOptImpl
+from apex_tpu.ops.attention import flash_attention as AttnTri
+from apex_tpu.optimizers.fused_adam_swa import FusedAdamSWA
+
+__all__ = ["FusedAdamSWA", "LayerNormSmallShapeOptImpl", "AttnTri"]

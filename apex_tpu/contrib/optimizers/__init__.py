@@ -1,0 +1,26 @@
+"""``apex.contrib.optimizers`` import-surface alias (reference:
+contrib/optimizers — DistributedFusedAdam/LAMB ZeRO optimizers plus the
+deprecated contrib copies of FusedAdam/LAMB/SGD and FP16_Optimizer).
+Implementations live in ``apex_tpu.optimizers`` / ``apex_tpu.fp16_utils``."""
+
+from apex_tpu.fp16_utils import FP16_Optimizer
+from apex_tpu.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    FusedAdam,
+    FusedLAMB,
+    FusedSGD,
+    distributed_fused_adam,
+    distributed_fused_lamb,
+)
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "distributed_fused_adam",
+    "distributed_fused_lamb",
+    "FusedAdam",
+    "FusedLAMB",
+    "FusedSGD",
+    "FP16_Optimizer",
+]

@@ -1,0 +1,11 @@
+"""``apex.contrib.peer_memory`` import-surface alias (reference:
+contrib/peer_memory — PeerMemoryPool + PeerHaloExchanger1d over CUDA IPC).
+
+On TPU peer-to-peer halo exchange is a pair of ``ppermute``s over the
+mesh's spatial axis — no memory pool to manage (XLA owns buffers); the
+mechanism lives in ``apex_tpu.contrib.bottleneck.halo_exchange_1d`` and is
+re-exported here under the reference's path."""
+
+from apex_tpu.contrib.bottleneck import halo_exchange_1d
+
+__all__ = ["halo_exchange_1d"]
