@@ -37,6 +37,7 @@ _SLOW_TESTS = {
     "test_amp_mlp_example",
     "test_imagenet_example",
     "test_long_context_ring_cp_example",
+    "test_gpt_cp_tp_sp_matches_tp_only",
     "test_gpt_pretrain_example",
     "test_gpt_pretrain_resume",
     "test_sparsity_example",
