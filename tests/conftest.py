@@ -39,6 +39,7 @@ _SLOW_TESTS = {
     "test_long_context_ring_cp_example",
     "test_gpt_cp_tp_sp_matches_tp_only",
     "test_pp_cp_tp_loss_matches_cp_disabled",
+    "test_zero_dp_inside_pp_mesh_trains",
     "test_gpt_pretrain_example",
     "test_gpt_pretrain_resume",
     "test_sparsity_example",

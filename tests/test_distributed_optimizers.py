@@ -332,3 +332,94 @@ class TestDistributedFusedLAMB:
         shard = int(init(params))
         assert shard * DP >= total
         assert shard <= max(padded, total) // DP
+
+
+class TestZeROInPipelineTopology:
+    def test_zero_dp_inside_pp_mesh_trains(self, rng):
+        """ZeRO-2 over the dp axis while pp>1 partitions the model: each
+        pp rank keeps its own stage params, the optimizer state is 1/dp
+        per device WITHIN each stage, and two training steps through the
+        compiled pipeline schedule decrease the loss. (The dense-parity
+        tests pin the math on a pure-dp mesh; this pins the topology the
+        reference's DistributedFusedAdam actually runs in.)"""
+        import jax.numpy as jnp
+
+        from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+        from apex_tpu.parallel.pipeline import forward_backward_with_pre_post
+        from apex_tpu.transformer import TransformerConfig
+
+        pp, dp = 2, 4
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp,
+            devices=jax.devices()[: pp * dp],
+        )
+        vocab, seq, mb, num_micro = 32, 8, 2, 2
+        cfg = TransformerConfig(
+            num_layers=2 * pp,
+            hidden_size=16,
+            num_attention_heads=4,
+            vocab_size=vocab,
+            max_position_embeddings=seq,
+            hidden_dropout=0.0,
+            attention_dropout=0.0,
+            compute_dtype=jnp.float32,
+        )
+        parts = build_gpt_pipeline(cfg, pp)
+        opt = distributed_fused_adam(
+            lr=5e-3, axis_size=dp, average_grads=True, max_grad_norm=1.0
+        )
+        key = jax.random.PRNGKey(0)
+        n_steps = 4
+        tokens = jax.random.randint(
+            key, (n_steps, num_micro, mb * dp, seq), 0, vocab
+        )
+        labels = jnp.roll(tokens, -1, axis=3)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(None, None, "dp"), P(None, None, "dp")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        def train(tokens, labels):
+            init_key = jax.random.PRNGKey(0)
+            pre = parts.embed.init(init_key, tokens[0, 0])["params"]
+            h0 = parts.pre_fn(pre, tokens[0, 0])
+            r = jax.lax.axis_index("pp")
+            stage = parts.chunk.init(
+                jax.random.fold_in(jax.random.fold_in(init_key, 7), r), h0
+            )["params"]
+            params = {
+                "pre": pre,
+                "stages": stage,
+                "post": parts.init_post(jax.random.fold_in(init_key, 9)),
+            }
+            state = opt.init(params)
+
+            def one_step(carry, batch):
+                params, state = carry
+                step_tokens, step_labels = batch
+                loss, _, grads = forward_backward_with_pre_post(
+                    parts.pre_fn, parts.stage_fn, parts.post_loss_fn,
+                    params, step_tokens, step_labels, axis_name="pp",
+                )
+                # ZeRO's psum_scatter over dp IS the gradient sync
+                updates, state = opt.update(grads, state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, state), jax.lax.pmean(
+                    jax.lax.pmean(loss, "dp"), "pp"
+                )
+
+            (params, state), losses = jax.lax.scan(
+                one_step, (params, state), (tokens, labels)
+            )
+            return losses, jnp.asarray(state.master_shard.shape[0])
+
+        losses, shard = train(tokens, labels)
+        losses = np.asarray(losses)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+        # ZeRO property inside the pp mesh: a real (nonzero) per-device
+        # shard exists and dp of them cover this rank's padded params
+        assert int(shard) > 0
