@@ -155,6 +155,29 @@ def run_bench():
     return 0
 
 
+def ts_epoch(rec, key="ts"):
+    """Epoch seconds of a result record's timestamp (0.0 when absent or
+    malformed).  Shared by the replay selector below and run_all_tpu's
+    sub-record reuse so the two staleness gates can't drift apart."""
+    try:
+        return time.mktime(
+            time.strptime(rec.get(key, ""), "%Y-%m-%dT%H:%M:%S")
+        )
+    except (ValueError, TypeError):  # absent/malformed/non-string ts
+        return 0.0
+
+
+def measured_epoch(rec):
+    """When the record's VALUE was actually measured: a reuse-assembled
+    'headline' record is re-stamped at assembly time by emit(), so the
+    original capture time lives in o2_reused_from_ts — freshness must gate
+    on that, or an O2 measured up to max_age_h before its reassembly would
+    replay long past the documented bound."""
+    if rec.get("o2_reused_from_ts"):
+        return ts_epoch(rec, "o2_reused_from_ts")
+    return ts_epoch(rec)
+
+
 def harvested_tpu_record(path=None, max_age_h=None):
     """Newest FRESH successful headline record in
     benchmarks/tpu_results.jsonl (written by run_all_tpu.py during relay
@@ -176,15 +199,8 @@ def harvested_tpu_record(path=None, max_age_h=None):
     if not os.path.exists(path):
         return None
 
-    def ts_epoch(rec):
-        try:
-            return time.mktime(
-                time.strptime(rec.get("ts", ""), "%Y-%m-%dT%H:%M:%S")
-            )
-        except (ValueError, TypeError):  # absent/malformed/non-string ts
-            return 0.0
-
     best = None
+    best_o0 = None
     try:
         with open(path) as f:
             for line in f:
@@ -194,9 +210,13 @@ def harvested_tpu_record(path=None, max_age_h=None):
                     continue
                 if not (rec.get("ok") and rec.get("value")):
                     continue
-                if rec.get("section") not in ("headline", "headline_o2"):
+                if time.time() - measured_epoch(rec) > max_age_h * 3600:
                     continue
-                if time.time() - ts_epoch(rec) > max_age_h * 3600:
+                if rec.get("section") == "headline_o0":
+                    if best_o0 is None or ts_epoch(rec) >= ts_epoch(best_o0):
+                        best_o0 = rec
+                    continue
+                if rec.get("section") not in ("headline", "headline_o2"):
                     continue
                 # newer wins; at equal ts the full record beats its own
                 # headline_o2 partial (emitted moments earlier)
@@ -209,6 +229,14 @@ def harvested_tpu_record(path=None, max_age_h=None):
     keep = {k: best[k] for k in
             ("metric", "value", "unit", "vs_baseline", "o0_value", "ts")
             if k in best}
+    # Pair a fresh O2 with a fresh standalone O0 captured in a DIFFERENT
+    # relay window: run_all_tpu emits each half the moment it lands, and a
+    # hung fetch can split them across attempts (2026-07-31).  Same chip,
+    # same committed harness — the ratio is as real as a one-window pair.
+    if keep.get("vs_baseline") is None and best_o0 is not None:
+        keep["o0_value"] = float(best_o0["value"])
+        keep["o0_ts"] = best_o0.get("ts")
+        keep["vs_baseline"] = round(float(keep["value"]) / float(best_o0["value"]), 3)
     keep.setdefault("vs_baseline", None)
     return keep
 

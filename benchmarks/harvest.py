@@ -37,14 +37,26 @@ def log(msg):
     print(f"[harvest {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
+MAX_NULL_HEADLINE_RETRIES = 3
+
+
 def results_state(out_path):
     """Which sections have a captured record already?
 
     smoke: rc=0 (all OK) and rc=1 (deterministic kernel FAIL — retrying
     re-spends a relay window on the same answer) both count as captured;
     rc=2 means the budget ran out mid-run, so retry it.
+
+    headline: ok with ``vs_baseline: null`` means the O2 half landed but
+    the O0 half didn't (budget / relay drop) — retry, since run_all_tpu
+    reuses the captured O2 sub-record and spends the window on O0 alone.
+    But only MAX_NULL_HEADLINE_RETRIES times: a DETERMINISTIC O0 failure
+    would otherwise re-burn every remaining window on the same answer
+    (the smoke-rc=1 principle), and transient-vs-deterministic can't be
+    classified from the note text reliably.
     """
     done = set()
+    null_headlines = 0
     if not os.path.exists(out_path):
         return done
     with open(out_path) as f:
@@ -60,6 +72,10 @@ def results_state(out_path):
                     # budget-skipped / transiently-errored items inside an
                     # otherwise-ok section: the section must be retried
                     continue
+                if rec["section"] == "headline" and rec.get("vs_baseline") is None:
+                    null_headlines += 1
+                    if null_headlines <= MAX_NULL_HEADLINE_RETRIES:
+                        continue
                 done.add(rec["section"])
     return done
 

@@ -83,28 +83,80 @@ def section(out_path, name, fn):
         })
 
 
+def fresh_subrecord(out_path, section_name, max_age_h=None):
+    """Newest successful sub-record of ``section_name`` from an earlier
+    capture attempt, if measured recently enough to still describe the
+    current code.  The bound and the timestamp parsing are bench.py's
+    (``APEX_TPU_REPLAY_MAX_AGE_H``, default 24 h): what is fresh enough to
+    REPLAY is exactly what is fresh enough to REUSE.
+
+    Relay windows are minutes long and a hung fetch can strand one attempt
+    mid-headline (2026-07-31: O2 landed at 01:04, the O0 fetch then hung),
+    so a retry must spend its window on the MISSING half, not re-measure
+    the half that already landed."""
+    from bench import ts_epoch
+
+    if max_age_h is None:
+        max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
+    if not os.path.exists(out_path):
+        return None
+    best = None
+    with open(out_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("section") == section_name and rec.get("ok") and rec.get("value"):
+                best = rec  # append-ordered file: last one is newest
+    if best is None:
+        return None
+    age = time.time() - ts_epoch(best)
+    return best if 0 <= age <= max_age_h * 3600 else None
+
+
 def run_headline(deadline, out_path):
     import jax.numpy as jnp
 
     from bench import measure
 
     # O2 first, emitted immediately: this alone is the round's deliverable.
-    o2 = measure(jnp.bfloat16, 256, 224, deadline=deadline)
-    emit(out_path, {
-        "section": "headline_o2", "ok": True,
-        "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
-        "value": round(o2, 2), "unit": "imgs/sec/chip",
-    })
+    # A fresh capture from an earlier attempt in this session is reused so
+    # a retry window goes straight to whatever is still missing.
+    prior_o2 = fresh_subrecord(out_path, "headline_o2")
+    if prior_o2 is not None:
+        o2 = float(prior_o2["value"])
+    else:
+        o2 = measure(jnp.bfloat16, 256, 224, deadline=deadline)
+        emit(out_path, {
+            "section": "headline_o2", "ok": True,
+            "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+            "value": round(o2, 2), "unit": "imgs/sec/chip",
+        })
     rec = {
         "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
         "value": round(o2, 2),
         "unit": "imgs/sec/chip",
     }
+    if prior_o2 is not None:
+        rec["o2_reused_from_ts"] = prior_o2.get("ts")
     # An O0 failure (budget, relay drop) must not discard the O2 result:
     # the 'headline' record stays ok=true with vs_baseline null.
-    if time.monotonic() < deadline:
+    prior_o0 = fresh_subrecord(out_path, "headline_o0")
+    if prior_o0 is not None:
+        rec["o0_value"] = float(prior_o0["value"])
+        rec["o0_reused_from_ts"] = prior_o0.get("ts")
+        rec["vs_baseline"] = round(o2 / float(prior_o0["value"]), 3)
+    elif time.monotonic() < deadline:
         try:
             o0 = measure(jnp.float32, 256, 224, deadline=deadline)
+            # emitted the moment it exists, like O2: a crash in a LATER
+            # section must not cost a completed measurement
+            emit(out_path, {
+                "section": "headline_o0", "ok": True,
+                "metric": "rn50_train_imgs_per_sec_per_chip_O0",
+                "value": round(o0, 2), "unit": "imgs/sec/chip",
+            })
             rec["o0_value"] = round(o0, 2)
             rec["vs_baseline"] = round(o2 / o0, 3)
         except Exception as e:

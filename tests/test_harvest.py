@@ -51,3 +51,31 @@ def test_failed_sections_retry_and_partials_count(tmp_path):
 
 def test_missing_file_is_empty(tmp_path):
     assert harvest.results_state(str(tmp_path / "none.jsonl")) == set()
+
+
+def test_headline_without_vs_baseline_retries(tmp_path):
+    # O2 landed but O0 didn't (hung relay fetch, 2026-07-31): the headline
+    # section must retry so a later window can capture the missing half —
+    # run_all_tpu reuses the fresh O2 sub-record, so the retry is cheap.
+    p = _write(tmp_path, [
+        {"section": "headline", "ok": True, "value": 2626.0,
+         "vs_baseline": None, "note": "O0 baseline failed"},
+    ])
+    assert "headline" not in harvest.results_state(p)
+    p = _write(tmp_path, [
+        {"section": "headline", "ok": True, "value": 2626.0,
+         "vs_baseline": 3.1, "o0_value": 847.0},
+    ])
+    assert "headline" in harvest.results_state(p)
+
+
+def test_null_headline_retry_is_capped(tmp_path):
+    # a deterministic O0 failure must not re-burn every remaining relay
+    # window: after MAX_NULL_HEADLINE_RETRIES null-vs_baseline records the
+    # failure counts as the captured answer (the smoke-rc=1 principle)
+    rec = {"section": "headline", "ok": True, "value": 2626.0,
+           "vs_baseline": None, "note": "O0 baseline failed: ValueError"}
+    p = _write(tmp_path, [rec] * harvest.MAX_NULL_HEADLINE_RETRIES)
+    assert "headline" not in harvest.results_state(p)
+    p = _write(tmp_path, [rec] * (harvest.MAX_NULL_HEADLINE_RETRIES + 1))
+    assert "headline" in harvest.results_state(p)
