@@ -135,23 +135,59 @@ def run_bench():
     from apex_tpu.ops._dispatch import on_tpu as _on_tpu
 
     jax.devices()  # force backend init (raises here on failure, not mid-bench)
-    if _on_tpu():  # recognizes both "tpu" and the axon relay platform
+    on_tpu = _on_tpu()  # recognizes both "tpu" and the axon relay platform
+    if on_tpu:
         batch, image_size, smoke_model = 256, 224, "resnet50"
     else:  # CPU smoke mode so the bench is runnable anywhere
         batch, image_size, smoke_model = 8, 32, "resnet18"
 
-    o2 = measure(jnp.bfloat16, batch, image_size, smoke_model)  # amp O2
-    o0 = measure(jnp.float32, batch, image_size, smoke_model)   # O0 baseline
-
-    # smoke_model is ALWAYS emitted: the metric key alone must never be
-    # read as comparable across platforms (the CPU fallback smokes RN18)
-    print(json.dumps({
+    rec = {
         "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
-        "value": round(o2, 2),
-        "unit": "imgs/sec/chip",
-        "vs_baseline": round(o2 / o0, 3),
+        # smoke_model is ALWAYS emitted: the metric key alone must never be
+        # read as comparable across platforms (the CPU fallback smokes RN18)
         "smoke_model": smoke_model,
-    }))
+        "unit": "imgs/sec/chip",
+    }
+
+    # On TPU the live run shares run_all_tpu's half-headline protocol:
+    # reuse any fresh half already captured this session (relay windows are
+    # too scarce to re-measure what already landed), append each live half
+    # the moment it lands, and keep the O2 record even if O0 then dies.
+    # The CPU smoke never reads or writes the results file — everything in
+    # it must have run on the real backend.
+    results = default_results_path() if on_tpu else None
+    prior_o2 = fresh_subrecord(results, "headline_o2") if on_tpu else None
+    if prior_o2 is not None:
+        o2 = float(prior_o2["value"])
+        rec["o2_reused_from_ts"] = prior_o2.get("ts")
+    else:
+        o2 = measure(jnp.bfloat16, batch, image_size, smoke_model)  # amp O2
+        if on_tpu:
+            append_subrecord(results, "headline_o2", o2, rec["metric"])
+    rec["value"] = round(o2, 2)
+
+    prior_o0 = fresh_subrecord(results, "headline_o0") if on_tpu else None
+    if prior_o0 is not None:
+        o0 = float(prior_o0["value"])
+        rec["o0_reused_from_ts"] = prior_o0.get("ts")
+        rec["o0_value"] = o0
+        rec["vs_baseline"] = round(o2 / o0, 3)
+    else:
+        try:
+            o0 = measure(jnp.float32, batch, image_size, smoke_model)  # O0
+            if on_tpu:
+                append_subrecord(
+                    results, "headline_o0", o0,
+                    "rn50_train_imgs_per_sec_per_chip_O0")
+            rec["o0_value"] = round(o0, 2)
+            rec["vs_baseline"] = round(o2 / o0, 3)
+        except Exception as e:
+            # an O2 measured live on the chip must still be emitted — the
+            # supervisor treats any record with "metric" as the answer
+            rec["vs_baseline"] = None
+            rec["note"] = f"O0 baseline failed: {e!r}"[:500]
+
+    print(json.dumps(rec))
     return 0
 
 
@@ -178,6 +214,52 @@ def measured_epoch(rec):
     return ts_epoch(rec)
 
 
+def default_results_path():
+    return os.environ.get("APEX_TPU_RESULTS") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "tpu_results.jsonl")
+
+
+def fresh_subrecord(out_path, section_name, max_age_h=None):
+    """Newest successful sub-record of ``section_name`` from an earlier
+    capture attempt, if measured recently enough to still describe the
+    current code (``APEX_TPU_REPLAY_MAX_AGE_H``, default 24 h: what is
+    fresh enough to REPLAY is exactly what is fresh enough to REUSE).
+
+    Relay windows are minutes long and a hung fetch can strand one attempt
+    mid-headline (2026-07-31: O2 landed at 01:04, the O0 fetch then hung),
+    so a retry must spend its window on the MISSING half, not re-measure
+    the half that already landed."""
+    if max_age_h is None:
+        max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
+    if not os.path.exists(out_path):
+        return None
+    best = None
+    with open(out_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("section") == section_name and rec.get("ok") and rec.get("value"):
+                best = rec  # append-ordered file: last one is newest
+    if best is None:
+        return None
+    age = time.time() - ts_epoch(best)
+    return best if 0 <= age <= max_age_h * 3600 else None
+
+
+def append_subrecord(out_path, section_name, value, metric):
+    """Append a half-headline measurement to the results file the moment it
+    lands (the run_all_tpu emit() contract, shared by the live --run path:
+    a crash later in the run must not cost a completed measurement)."""
+    rec = {"section": section_name, "ok": True, "metric": metric,
+           "value": round(value, 2), "unit": "imgs/sec/chip",
+           "ts": time.strftime("%Y-%m-%dT%H:%M:%S")}
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 def harvested_tpu_record(path=None, max_age_h=None):
     """Newest FRESH successful headline record in
     benchmarks/tpu_results.jsonl (written by run_all_tpu.py during relay
@@ -191,9 +273,7 @@ def harvested_tpu_record(path=None, max_age_h=None):
     Recency beats completeness: a newer partial 'headline_o2' wins over an
     older full 'headline' (the newer one measured the current code)."""
     if path is None:
-        path = os.environ.get("APEX_TPU_RESULTS") or os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            "benchmarks", "tpu_results.jsonl")
+        path = default_results_path()
     if max_age_h is None:
         max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
     if not os.path.exists(path):

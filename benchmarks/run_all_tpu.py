@@ -83,36 +83,10 @@ def section(out_path, name, fn):
         })
 
 
-def fresh_subrecord(out_path, section_name, max_age_h=None):
-    """Newest successful sub-record of ``section_name`` from an earlier
-    capture attempt, if measured recently enough to still describe the
-    current code.  The bound and the timestamp parsing are bench.py's
-    (``APEX_TPU_REPLAY_MAX_AGE_H``, default 24 h): what is fresh enough to
-    REPLAY is exactly what is fresh enough to REUSE.
-
-    Relay windows are minutes long and a hung fetch can strand one attempt
-    mid-headline (2026-07-31: O2 landed at 01:04, the O0 fetch then hung),
-    so a retry must spend its window on the MISSING half, not re-measure
-    the half that already landed."""
-    from bench import ts_epoch
-
-    if max_age_h is None:
-        max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
-    if not os.path.exists(out_path):
-        return None
-    best = None
-    with open(out_path) as f:
-        for line in f:
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue
-            if rec.get("section") == section_name and rec.get("ok") and rec.get("value"):
-                best = rec  # append-ordered file: last one is newest
-    if best is None:
-        return None
-    age = time.time() - ts_epoch(best)
-    return best if 0 <= age <= max_age_h * 3600 else None
+# re-exported for the tests and for symmetry with the other bench helpers;
+# the implementation lives in bench.py (shared with the live --run path,
+# which reuses fresh halves the same way a capture retry does)
+from bench import fresh_subrecord  # noqa: E402
 
 
 def run_headline(deadline, out_path):
