@@ -27,7 +27,10 @@ from apex_tpu.utils.pytree import tree_cast
 class AmpOptimizerState:
     master: Any  # fp32 master params (or None-like placeholder when disabled)
     inner: Any  # optax state over master params
-    scaler: LossScalerState
+    # one LossScalerState, or a tuple of num_losses of them: the reference
+    # creates one scaler per loss_id (_initialize.py:229-233) so e.g. the
+    # DCGAN example's D-real / D-fake / G losses back off independently
+    scaler: Any
 
 
 class AmpOptimizer:
@@ -48,35 +51,89 @@ class AmpOptimizer:
         self.tx = tx
         self.policy = policy
         # one scaler per loss (ref: _initialize.py:229-233 creates
-        # num_losses LossScalers); state holds the first; extra scalers can
-        # be created by callers via policy.make_scaler()
+        # num_losses LossScalers): num_losses == 1 keeps the state a single
+        # LossScalerState; > 1 makes it a tuple indexed by loss_id
         self.scaler = policy.make_scaler()
-        self.num_losses = num_losses
+        self.num_losses = int(num_losses)
 
     def init(self, params) -> AmpOptimizerState:
         if self.policy.master_weights:
             master = tree_cast(params, jnp.float32)
         else:
             master = params
+        if self.num_losses > 1:
+            scaler = tuple(self.scaler.init() for _ in range(self.num_losses))
+        else:
+            scaler = self.scaler.init()
         return AmpOptimizerState(
-            master=master, inner=self.tx.init(master), scaler=self.scaler.init()
+            master=master, inner=self.tx.init(master), scaler=scaler
         )
 
-    def scale_loss(self, loss, state: AmpOptimizerState):
-        return self.scaler.scale(state.scaler, loss)
+    def _scaler_state(self, state: AmpOptimizerState, loss_id: int):
+        if isinstance(state.scaler, tuple):
+            if not 0 <= loss_id < len(state.scaler):
+                raise ValueError(
+                    f"loss_id={loss_id} out of range for "
+                    f"num_losses={len(state.scaler)}"
+                )
+            return state.scaler[loss_id]
+        if loss_id != 0:
+            raise ValueError(
+                f"loss_id={loss_id} but this AmpOptimizer was initialized "
+                f"with num_losses={self.num_losses}"
+            )
+        return state.scaler
 
-    def step(self, grads, state: AmpOptimizerState, params, found_inf_extra=None):
+    def scale_loss(self, loss, state: AmpOptimizerState, loss_id: int = 0):
+        return self.scaler.scale(self._scaler_state(state, loss_id), loss)
+
+    def unscale_grads(self, grads, state: AmpOptimizerState, loss_id: int = 0):
+        """(grads / scale[loss_id] in fp32, found_inf).
+
+        The multi-backward building block: where the reference accumulates
+        several independently-scaled backwards into ``.grad`` and unscales
+        at context exit (amp/handle.py:113-154), the functional form takes
+        one ``jax.grad`` per loss, unscales each with its own scaler, and
+        sums — then hands the total to :meth:`step_unscaled` with the
+        per-loss overflow flags."""
+        grads_f32 = tree_cast(grads, jnp.float32)
+        return self.scaler.unscale(self._scaler_state(state, loss_id), grads_f32)
+
+    def step(self, grads, state: AmpOptimizerState, params, found_inf_extra=None,
+             loss_id: int = 0):
         """One optimizer step: unscale, overflow-gate, update, recast.
 
         Returns (new_params, new_state, info) where info has ``found_inf``
         and ``loss_scale`` for logging parity with the reference's
         "Gradient overflow, skipping step" messages (amp/handle.py:128-154).
         """
-        # grads arrive in model dtype, shaped like params; promote to master
-        grads_f32 = tree_cast(grads, jnp.float32)
-        grads_f32, found_inf = self.scaler.unscale(state.scaler, grads_f32)
+        grads_f32, found_inf = self.unscale_grads(grads, state, loss_id)
         if found_inf_extra is not None:
             found_inf = jnp.logical_or(found_inf, found_inf_extra)
+        return self.step_unscaled(grads_f32, state, params, {loss_id: found_inf})
+
+    def step_unscaled(self, grads_f32, state: AmpOptimizerState, params,
+                      found_infs):
+        """Apply already-unscaled fp32 grads (the sum of one
+        :meth:`unscale_grads` per contributing loss).
+
+        ``found_infs`` maps each contributing loss_id to its overflow flag:
+        the step is skipped if ANY contributing loss overflowed, while each
+        scaler's dynamic schedule advances with its OWN flag —
+        non-contributing scalers are left untouched (reference semantics:
+        every LossScaler adjusts only on its own backward,
+        scaler.py:197-217)."""
+        n = len(state.scaler) if isinstance(state.scaler, tuple) else 1
+        bad = [i for i in found_infs if not 0 <= i < n]
+        if bad or not found_infs:
+            raise ValueError(
+                f"found_infs keys {sorted(found_infs)} invalid for "
+                f"num_losses={n}"
+            )
+        flags = list(found_infs.values())
+        found_inf = flags[0]
+        for f in flags[1:]:
+            found_inf = jnp.logical_or(found_inf, f)
 
         def do_step(operand):
             master, inner = operand
@@ -90,7 +147,15 @@ class AmpOptimizer:
         new_master, new_inner = jax.lax.cond(
             found_inf, skip_step, do_step, (state.master, state.inner)
         )
-        new_scaler = self.scaler.update(state.scaler, found_inf)
+        if isinstance(state.scaler, tuple):
+            new_scaler = tuple(
+                self.scaler.update(s, found_infs[i]) if i in found_infs else s
+                for i, s in enumerate(state.scaler)
+            )
+            scale_now = new_scaler[min(found_infs)].scale
+        else:
+            new_scaler = self.scaler.update(state.scaler, found_inf)
+            scale_now = new_scaler.scale
         new_state = AmpOptimizerState(
             master=new_master, inner=new_inner, scaler=new_scaler
         )
@@ -101,15 +166,34 @@ class AmpOptimizer:
             )
         else:
             new_params = new_master
-        info = {"found_inf": found_inf, "loss_scale": new_scaler.scale}
+        info = {"found_inf": found_inf, "loss_scale": scale_now}
         return new_params, new_state, info
 
     # -- checkpointing parity (amp.state_dict, frontend.py:367-404) -------
 
     def state_dict(self, state: AmpOptimizerState) -> dict:
+        if isinstance(state.scaler, tuple):
+            return {"scalers": [self.scaler.state_dict(s) for s in state.scaler]}
         return {"scaler": self.scaler.state_dict(state.scaler)}
 
     def load_state_dict(self, state: AmpOptimizerState, d: dict) -> AmpOptimizerState:
+        """Restore scaler state; a checkpoint from a different num_losses
+        config fails fast — silently changing the scaler pytree structure
+        would break every jit traced over the old state."""
+        if "scalers" in d:
+            if len(d["scalers"]) != self.num_losses:
+                raise ValueError(
+                    f"checkpoint has {len(d['scalers'])} scalers but this "
+                    f"AmpOptimizer was initialized with "
+                    f"num_losses={self.num_losses}"
+                )
+            return state.replace(scaler=tuple(
+                self.scaler.load_state_dict(s) for s in d["scalers"]))
+        if self.num_losses > 1:
+            raise ValueError(
+                "single-scaler checkpoint but this AmpOptimizer was "
+                f"initialized with num_losses={self.num_losses}"
+            )
         return state.replace(scaler=self.scaler.load_state_dict(d["scaler"]))
 
 

@@ -1,0 +1,176 @@
+"""DCGAN training with amp — two models, two optimizers, three scaled losses.
+
+Reference parity: examples/dcgan/main_amp.py — the reference's hardest amp
+exercise: ``amp.initialize([netD, netG], [optD, optG], num_losses=3)`` with
+one backward per loss (``scale_loss(..., loss_id=0/1/2)`` at :230/:240/:253)
+so the D-real, D-fake, and G losses each own a dynamic scaler that backs
+off independently.
+
+TPU mapping: amp here is per-optimizer rather than global, so the three
+reference loss_ids become D's AmpOptimizer with ``num_losses=2`` (loss_id 0
+= real batch, loss_id 1 = fake batch) and G's with its own single scaler.
+Where the reference accumulates two backwards into ``.grad`` and unscales
+at context exit, the functional form takes one ``jax.grad`` per loss,
+``unscale_grads`` each with its own scaler, sums, and hands the total to
+``step_unscaled`` with the per-loss overflow flags — the step skips if any
+contributing loss overflowed while each scaler advances on its own flag.
+
+Data: synthetic random "real" images (house style — the reference trains on
+LSUN/CIFAR from disk; the adversarial dynamics that exercise amp are
+data-independent). Norm layers are GroupNorm rather than the 2015 paper's
+BatchNorm so the example has no mutable batch_stats collections.
+
+CPU smoke: python examples/dcgan/main_amp.py --steps 40 --half float16
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="TPU DCGAN amp training")
+    p.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
+    p.add_argument("--half", default="bfloat16", choices=["bfloat16", "float16"])
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--image-size", type=int, default=32)
+    p.add_argument("--nz", type=int, default=100, help="latent dim")
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--steps", type=int, default=200)
+    return p.parse_args()
+
+
+def build_models(image_size, nz):
+    import flax.linen as nn
+
+    class Generator(nn.Module):
+        """z -> (image_size, image_size, 3) in [-1, 1] via ConvTranspose."""
+
+        @nn.compact
+        def __call__(self, z):
+            feat, ch = image_size // 8, 256
+            x = nn.Dense(feat * feat * ch)(z)
+            x = x.reshape(z.shape[0], feat, feat, ch)
+            for out_ch in (128, 64):
+                x = nn.GroupNorm(num_groups=8)(x)
+                x = nn.relu(x)
+                x = nn.ConvTranspose(out_ch, (4, 4), strides=(2, 2))(x)
+            x = nn.GroupNorm(num_groups=8)(x)
+            x = nn.relu(x)
+            x = nn.ConvTranspose(3, (4, 4), strides=(2, 2))(x)
+            return jnp.tanh(x)
+
+    class Discriminator(nn.Module):
+        """(image_size, image_size, 3) -> logit."""
+
+        @nn.compact
+        def __call__(self, x):
+            for ch in (64, 128, 256):
+                x = nn.Conv(ch, (4, 4), strides=(2, 2))(x)
+                x = nn.leaky_relu(x, 0.2)
+            return nn.Dense(1)(x.reshape(x.shape[0], -1))[:, 0]
+
+    return Generator(), Discriminator()
+
+
+def main():
+    args = parse_args()
+    import optax
+
+    from apex_tpu import amp
+    from apex_tpu.optimizers import fused_adam
+
+    half = jnp.bfloat16 if args.half == "bfloat16" else jnp.float16
+    netG, netD = build_models(args.image_size, args.nz)
+
+    key = jax.random.PRNGKey(0)
+    kG, kD, key = jax.random.split(key, 3)
+    z0 = jnp.zeros((args.batch_size, args.nz), jnp.float32)
+    x0 = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3),
+                   jnp.float32)
+    g_params = netG.init(kG, z0)["params"]
+    d_params = netD.init(kD, x0)["params"]
+
+    # DCGAN betas (radford et al.): beta1=0.5
+    txG = fused_adam(lr=args.lr, betas=(0.5, 0.999))
+    txD = fused_adam(lr=args.lr, betas=(0.5, 0.999))
+    # ref :215: amp.initialize([netD, netG], [optD, optG], num_losses=3)
+    d_params, d_amp, policy = amp.initialize(
+        d_params, txD, opt_level=args.opt_level, half_dtype=half, num_losses=2)
+    g_params, g_amp, _ = amp.initialize(
+        g_params, txG, opt_level=args.opt_level, half_dtype=half)
+    d_state = d_amp.init(d_params)
+    g_state = g_amp.init(g_params)
+
+    d_apply = policy.wrap_apply(netD.apply)
+    g_apply = policy.wrap_apply(netG.apply)
+    bce = optax.sigmoid_binary_cross_entropy
+
+    @jax.jit
+    def train_step(d_params, d_state, g_params, g_state, real, z):
+        fake = g_apply({"params": g_params}, z)
+
+        # --- D update: one grad per loss, each with its own scaler --------
+        # each loss fn returns (scaled, unscaled) so the printed errD/errG
+        # come from the training forwards, like the reference's logging
+        def d_loss_real(p):
+            logits = d_apply({"params": p}, real)
+            loss = jnp.mean(bce(logits, jnp.ones_like(logits)))
+            return d_amp.scale_loss(loss, d_state, loss_id=0), loss
+
+        def d_loss_fake(p):
+            logits = d_apply({"params": p}, jax.lax.stop_gradient(fake))
+            loss = jnp.mean(bce(logits, jnp.zeros_like(logits)))
+            return d_amp.scale_loss(loss, d_state, loss_id=1), loss
+
+        dg_real, err_real = jax.grad(d_loss_real, has_aux=True)(d_params)
+        dg_fake, err_fake = jax.grad(d_loss_fake, has_aux=True)(d_params)
+        g_real, inf0 = d_amp.unscale_grads(dg_real, d_state, loss_id=0)
+        g_fake, inf1 = d_amp.unscale_grads(dg_fake, d_state, loss_id=1)
+        d_grads = jax.tree_util.tree_map(jnp.add, g_real, g_fake)
+        d_params, d_state, d_info = d_amp.step_unscaled(
+            d_grads, d_state, d_params, {0: inf0, 1: inf1})
+
+        # --- G update: its own optimizer, its own scaler ------------------
+        def g_loss(p):
+            logits = d_apply({"params": d_params}, g_apply({"params": p}, z))
+            loss = jnp.mean(bce(logits, jnp.ones_like(logits)))
+            return g_amp.scale_loss(loss, g_state), loss
+
+        g_grads, errG = jax.grad(g_loss, has_aux=True)(g_params)
+        g_params, g_state, g_info = g_amp.step(g_grads, g_state, g_params)
+
+        errD = err_real + err_fake
+        return d_params, d_state, g_params, g_state, {
+            "errD": errD, "errG": errG,
+            "scale_d0": d_state.scaler[0].scale,
+            "scale_d1": d_state.scaler[1].scale,
+            "scale_g": g_state.scaler.scale,
+            "d_skipped": d_info["found_inf"], "g_skipped": g_info["found_inf"],
+        }
+
+    t0 = time.time()
+    for step in range(args.steps):
+        key, kz, kx = jax.random.split(key, 3)
+        real = jax.random.uniform(
+            kx, (args.batch_size, args.image_size, args.image_size, 3),
+            jnp.float32, -1.0, 1.0)
+        z = jax.random.normal(kz, (args.batch_size, args.nz), jnp.float32)
+        d_params, d_state, g_params, g_state, info = train_step(
+            d_params, d_state, g_params, g_state, real, z)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step:4d} errD {float(info['errD']):8.4f} "
+                  f"errG {float(info['errG']):8.4f} "
+                  f"scales D0 {float(info['scale_d0']):8.1f} "
+                  f"D1 {float(info['scale_d1']):8.1f} "
+                  f"G {float(info['scale_g']):8.1f} "
+                  f"skipped D={bool(info['d_skipped'])} G={bool(info['g_skipped'])}")
+    dt = time.time() - t0
+    print(f"done: {args.steps} steps in {dt:.2f}s "
+          f"({args.steps / dt:.1f} steps/s) on {jax.devices()[0].platform}")
+
+
+if __name__ == "__main__":
+    main()

@@ -231,3 +231,103 @@ class TestHysteresis:
         st = s.update(s.init(), True)
         st2 = s.load_state_dict(s.state_dict(st))
         assert int(st2.hysteresis_tracker) == int(st.hysteresis_tracker) == 1
+
+
+class TestMultiLossAmpOptimizer:
+    """num_losses > 1: one scaler per loss_id (ref _initialize.py:229-233;
+    exercised by examples/dcgan/main_amp.py — D-real and D-fake losses back
+    off independently, the step skips if ANY contributing loss overflows)."""
+
+    def _setup(self, num_losses=2):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        tx = fused_adam(lr=0.1)
+        params, amp_opt, policy = amp.initialize(
+            params, tx, opt_level="O2", half_dtype=jnp.float16,
+            num_losses=num_losses,
+        )
+        return params, amp_opt
+
+    def test_state_holds_one_scaler_per_loss(self):
+        params, amp_opt = self._setup(3)
+        state = amp_opt.init(params)
+        assert isinstance(state.scaler, tuple) and len(state.scaler) == 3
+
+    def test_loss_id_out_of_range_on_single_loss_raises(self):
+        params, amp_opt = self._setup(1)
+        state = amp_opt.init(params)
+        with pytest.raises(ValueError, match="num_losses"):
+            amp_opt.scale_loss(jnp.float32(1.0), state, loss_id=1)
+
+    def test_overflow_in_one_loss_backs_off_only_its_scaler(self):
+        params, amp_opt = self._setup(2)
+        state = amp_opt.init(params)
+        s0 = float(state.scaler[0].scale)
+        clean = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1024.0, p.dtype), params)
+        bad = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.inf, p.dtype), params)
+        g0, inf0 = amp_opt.unscale_grads(clean, state, loss_id=0)
+        g1, inf1 = amp_opt.unscale_grads(bad, state, loss_id=1)
+        total = jax.tree_util.tree_map(jnp.add, g0, g1)
+        new_params, new_state, info = amp_opt.step_unscaled(
+            total, state, params, {0: inf0, 1: inf1})
+        # step skipped (loss 1 overflowed) ...
+        assert bool(info["found_inf"])
+        np.testing.assert_array_equal(
+            np.asarray(new_params["w"], np.float32),
+            np.asarray(params["w"], np.float32))
+        # ... scaler 1 backed off, scaler 0 advanced its clean streak
+        assert float(new_state.scaler[1].scale) == s0 / 2
+        assert float(new_state.scaler[0].scale) == s0
+        assert int(new_state.scaler[0].growth_tracker) == 1
+        assert int(new_state.scaler[1].skipped) == 1
+
+    def test_noncontributing_scaler_untouched(self):
+        params, amp_opt = self._setup(3)
+        state = amp_opt.init(params)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1024.0, p.dtype), params)
+        new_params, new_state, info = amp_opt.step(
+            grads, state, params, loss_id=1)
+        assert not bool(info["found_inf"])
+        assert float(new_params["w"][0]) < 1.0
+        # only scaler 1 saw a step
+        assert int(new_state.scaler[1].growth_tracker) == 1
+        assert int(new_state.scaler[0].growth_tracker) == 0
+        assert int(new_state.scaler[2].growth_tracker) == 0
+
+    def test_state_dict_roundtrip_tuple(self):
+        params, amp_opt = self._setup(2)
+        state = amp_opt.init(params)
+        bad = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, jnp.inf, p.dtype), params)
+        _, state, _ = amp_opt.step(bad, state, params, loss_id=1)
+        d = amp_opt.state_dict(state)
+        assert len(d["scalers"]) == 2
+        restored = amp_opt.load_state_dict(amp_opt.init(params), d)
+        assert float(restored.scaler[1].scale) == float(state.scaler[1].scale)
+        assert int(restored.scaler[1].skipped) == 1
+
+    def test_invalid_loss_ids_fail_fast(self):
+        params, amp_opt = self._setup(2)
+        state = amp_opt.init(params)
+        with pytest.raises(ValueError, match="out of range"):
+            amp_opt.scale_loss(jnp.float32(1.0), state, loss_id=-1)
+        grads = jax.tree_util.tree_map(
+            lambda p: jnp.full(p.shape, 1.0, p.dtype), params)
+        flag = jnp.asarray(False)
+        with pytest.raises(ValueError, match="invalid"):
+            amp_opt.step_unscaled(grads, state, params, {0: flag, 2: flag})
+        with pytest.raises(ValueError, match="invalid"):
+            amp_opt.step_unscaled(grads, state, params, {})
+
+    def test_load_state_dict_rejects_num_losses_mismatch(self):
+        params2, amp_opt2 = self._setup(2)
+        params3, amp_opt3 = self._setup(3)
+        d3 = amp_opt3.state_dict(amp_opt3.init(params3))
+        with pytest.raises(ValueError, match="3 scalers"):
+            amp_opt2.load_state_dict(amp_opt2.init(params2), d3)
+        params1, amp_opt1 = self._setup(1)
+        d1 = amp_opt1.state_dict(amp_opt1.init(params1))
+        with pytest.raises(ValueError, match="single-scaler"):
+            amp_opt2.load_state_dict(amp_opt2.init(params2), d1)

@@ -95,3 +95,17 @@ def test_long_context_ring_cp_example():
                 "--doc-len-min", "32", "--hidden", "32", "--heads", "4",
                 "--kv-heads", "2"])
     assert "done" in out and "step    3" in out
+
+
+def test_dcgan_example():
+    # fp16 + dynamic scalers: the D-real/D-fake/G losses each own a scaler
+    # (ref examples/dcgan/main_amp.py num_losses=3); trained losses finite
+    out = _run("examples/dcgan/main_amp.py",
+               ["--steps", "25", "--half", "float16",
+                "--batch-size", "8", "--image-size", "16"])
+    assert "done: 25 steps" in out
+    last = [l for l in out.splitlines() if l.startswith("step")][-1]
+    errd = float(last.split("errD")[1].split()[0])
+    errg = float(last.split("errG")[1].split()[0])
+    assert errd == errd and errg == errg  # not NaN
+    assert 0.0 < errd < 50.0 and 0.0 < errg < 50.0
