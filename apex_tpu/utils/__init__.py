@@ -6,6 +6,7 @@ from apex_tpu.utils.pytree import (
 )
 from apex_tpu.utils.timers import Timers, annotate, step_annotation
 from apex_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
     latest_step,
     load_checkpoint,
     save_checkpoint,
@@ -22,6 +23,7 @@ __all__ = [
     "step_annotation",
     "latest_step",
     "load_checkpoint",
+    "AsyncCheckpointWriter",
     "save_checkpoint",
     "AutoResume",
 ]
