@@ -13,8 +13,8 @@ This supervisor loops for ``--hours``:
    wedges the relay.  The wait is still bounded by the harvest window
    (``--hours``): if the child is hung past it, we log and exit, leaving
    the already-appended section records as the deliverable.
-3. Exit once ALL sections (headline, smoke, micro, configs) have a
-   successful record; the exit code reflects only whether the headline
+3. Exit once ALL sections (headline, smoke, micro, configs, sweep) have
+   a successful record; the exit code reflects only whether the headline
    landed.  A smoke record with rc=1 (deterministic kernel failure) counts
    as captured — the failure IS the evidence; rc=2 (budget skip) retries.
 
@@ -108,7 +108,7 @@ def main():
     attempt = 0
     while time.monotonic() < stop_at:
         done = results_state(args.out)
-        if {"headline", "smoke", "micro", "configs"} <= done:
+        if {"headline", "smoke", "micro", "configs", "sweep"} <= done:
             log(f"all sections captured: {sorted(done)}; exiting")
             break
         p = probe()

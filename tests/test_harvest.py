@@ -79,3 +79,14 @@ def test_null_headline_retry_is_capped(tmp_path):
     assert "headline" not in harvest.results_state(p)
     p = _write(tmp_path, [rec] * (harvest.MAX_NULL_HEADLINE_RETRIES + 1))
     assert "headline" in harvest.results_state(p)
+
+
+def test_sweep_budget_exhaustion_marks_incomplete(tmp_path):
+    # run_sweep with an already-expired deadline must skip every batch and
+    # flag the section incomplete (so harvest retries it next window)
+    # without touching the backend.
+    import run_all_tpu
+
+    rec = run_all_tpu.run_sweep(deadline=0.0)
+    assert rec["incomplete"] == ["rn50_ampO2_b384", "rn50_ampO2_b512"]
+    assert all("skipped" in rec[n] for n in rec["incomplete"])
