@@ -152,12 +152,23 @@ def run_smoke(deadline):
 
     import tpu_kernel_smoke
 
+    # stream each check to a sidecar as it lands: a relay hang mid-smoke
+    # (2026-07-31: one fetch blocked 45+ min, unkillable without wedging
+    # the relay) must not lose the kernels already validated compiled
+    if tpu_kernel_smoke.PROGRESS_PATH is None:
+        tpu_kernel_smoke.PROGRESS_PATH = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tpu_smoke_progress.log")
+    # run-start delimiter: attempts append to one file, and a reader
+    # recovering evidence after a hang must not attribute a prior
+    # attempt's passes to this run
+    tpu_kernel_smoke._emit(f"=== smoke attempt start (pid {os.getpid()}) ===")
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         rc = tpu_kernel_smoke.main(deadline=deadline)
     lines = [l for l in buf.getvalue().splitlines()
              if l.startswith(("ok", "FAIL", "SKIP", "ALL", "backend"))]
-    return {"rc": rc, "lines": lines}
+    return {"rc": rc, "lines": lines,
+            "progress_log": tpu_kernel_smoke.PROGRESS_PATH}
 
 
 def run_micro(deadline):
@@ -234,12 +245,17 @@ def run_configs(deadline):
     return rec
 
 
-def run_sweep(deadline):
+def run_sweep(deadline, out_path):
     """Headline operating-point sweep: RN50 amp-O2 imgs/sec/chip at larger
     batches.  The BASELINE metric is imgs/sec/chip with the batch our
     choice; if 384/512 beats batch 256's 2626, bench.py's TPU config
     adopts the winner (deeper per-step MXU occupancy vs HBM pressure —
-    measured, not guessed)."""
+    measured, not guessed).
+
+    Each batch is emitted as a ``sweep_b{N}`` sub-record the moment it
+    lands and reused on retries (the headline halves' protocol): a window
+    that measured b384 but lost b512 to the budget must not re-pay b384's
+    compiles next window."""
     import jax.numpy as jnp
 
     from bench import measure
@@ -249,6 +265,11 @@ def run_sweep(deadline):
     batches = (384, 512)
     for i, batch in enumerate(batches):
         name = f"rn50_ampO2_b{batch}"
+        prior = fresh_subrecord(out_path, f"sweep_b{batch}")
+        if prior is not None:
+            rec[name] = {"imgs_per_sec_per_chip": float(prior["value"]),
+                         "reused_from_ts": prior.get("ts")}
+            continue
         remaining = deadline - time.monotonic()
         if remaining <= 60:
             rec[name] = "skipped: section budget exhausted"
@@ -259,6 +280,10 @@ def run_sweep(deadline):
         item_deadline = time.monotonic() + remaining / (len(batches) - i)
         try:
             v = measure(jnp.bfloat16, batch, 224, deadline=item_deadline)
+            emit(out_path, {"section": f"sweep_b{batch}", "ok": True,
+                            "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+                            "value": round(v, 2), "unit": "imgs/sec/chip",
+                            "batch": batch})
             rec[name] = {"imgs_per_sec_per_chip": round(v, 2)}
         except Exception as e:
             rec[name] = f"error: {e}"[:400]
@@ -294,7 +319,10 @@ def main():
     if "configs" not in skip:
         section(args.out, "configs", run_configs)
     if "sweep" not in skip:
-        section(args.out, "sweep", run_sweep)
+        import functools
+
+        section(args.out, "sweep",
+                functools.partial(run_sweep, out_path=args.out))
     emit(args.out, {"section": "done", "ok": True})
 
 

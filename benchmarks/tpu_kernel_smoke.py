@@ -18,6 +18,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# When set (path string), every result line is ALSO appended + flushed here
+# the moment it exists: a relay hang mid-smoke (observed 2026-07-31: a fetch
+# blocked 45+ min and the process could not be killed without wedging the
+# relay) must not lose the evidence of kernels that already validated.
+PROGRESS_PATH = os.environ.get("APEX_TPU_SMOKE_PROGRESS")
+
+
+def _emit(line):
+    print(line, flush=True)
+    if PROGRESS_PATH:
+        try:
+            import time
+
+            with open(PROGRESS_PATH, "a") as f:
+                f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {line}\n")
+        except OSError:
+            pass
+
 
 def check(name, got, want, tol):
     got = jax.tree_util.tree_leaves(got)
@@ -28,9 +46,9 @@ def check(name, got, want, tol):
             jnp.max(jnp.abs(g.astype(jnp.float32) - w.astype(jnp.float32)))
         )
         if not np.isfinite(err) or err > tol:
-            print(f"FAIL {name}: max abs err {err} > {tol}")
+            _emit(f"FAIL {name}: max abs err {err} > {tol}")
             return False
-    print(f"ok   {name}")
+    _emit(f"ok   {name}")
     return True
 
 
@@ -46,12 +64,12 @@ def main(deadline=None):
 
     def out_of_time(where):
         if deadline is not None and time.monotonic() > deadline:
-            print(f"SKIP remaining (budget exhausted before {where})")
+            _emit(f"SKIP remaining (budget exhausted before {where})")
             return True
         return False
 
     dev = jax.devices()[0]
-    print(f"backend: {dev.platform} / {dev.device_kind}")
+    _emit(f"backend: {dev.platform} / {dev.device_kind}")
     ok = True
     key = jax.random.PRNGKey(0)
 
@@ -206,7 +224,7 @@ def main(deadline=None):
     n_x = jax.jit(lambda x: l2norm_flat(x, impl="xla"))(buf)
     ok &= check("l2norm_flat", n_p, n_x, 1e-2)
 
-    print("ALL OK" if ok else "FAILURES", flush=True)
+    _emit("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
 
 

@@ -87,6 +87,26 @@ def test_sweep_budget_exhaustion_marks_incomplete(tmp_path):
     # without touching the backend.
     import run_all_tpu
 
-    rec = run_all_tpu.run_sweep(deadline=0.0)
+    out = str(tmp_path / "r.jsonl")
+    rec = run_all_tpu.run_sweep(deadline=0.0, out_path=out)
     assert rec["incomplete"] == ["rn50_ampO2_b384", "rn50_ampO2_b512"]
     assert all("skipped" in rec[n] for n in rec["incomplete"])
+
+
+def test_sweep_reuses_fresh_subrecords(tmp_path):
+    # a batch measured by an earlier attempt is reused, not re-measured
+    # (the headline halves' protocol), and only the missing batch retries
+    import json
+    import time
+
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps({
+            "section": "sweep_b384", "ok": True, "value": 2700.5,
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }) + "\n")
+    rec = run_all_tpu.run_sweep(deadline=0.0, out_path=out)
+    assert rec["rn50_ampO2_b384"]["imgs_per_sec_per_chip"] == 2700.5
+    assert rec["incomplete"] == ["rn50_ampO2_b512"]
