@@ -4,7 +4,7 @@ from apex_tpu.utils.pytree import (
     tree_zeros_like,
     tree_map_with_path,
 )
-from apex_tpu.utils.timers import Timers, annotate, step_annotation
+from apex_tpu.utils.timers import Timers, annotate, step_annotation, trace
 from apex_tpu.utils.checkpoint import (
     AsyncCheckpointWriter,
     latest_step,
@@ -20,6 +20,7 @@ __all__ = [
     "tree_map_with_path",
     "Timers",
     "annotate",
+    "trace",
     "step_annotation",
     "latest_step",
     "load_checkpoint",

@@ -95,3 +95,28 @@ def annotate(name: str):
 def step_annotation(step: int):
     """Step marker for profiler traces (jax.profiler.StepTraceAnnotation)."""
     return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@contextmanager
+def trace(log_dir: str, **kwargs):
+    """Capture an XLA profiler trace of the enclosed block into
+    ``log_dir`` (view with TensorBoard's profile plugin / XProf) — the
+    TPU-native analogue of profiling the reference under nvprof/nsight
+    (its NVTX ranges, parallel/distributed.py:363, exist for exactly this
+    workflow). ``annotate``/``step_annotation`` ranges inside the block
+    appear as named spans in the capture.
+
+    Dispatch is async: ``jax.block_until_ready`` the block's outputs
+    BEFORE the block closes, or in-flight device work leaks past the
+    capture window::
+
+        with trace("/tmp/prof"):
+            out = train_step(state, batch)
+            jax.block_until_ready(out)
+
+    Thin delegation to ``jax.profiler.trace`` (``**kwargs`` forwarded:
+    ``create_perfetto_link`` etc.) so the library surface carries the
+    workflow docs without duplicating the mechanism.
+    """
+    with jax.profiler.trace(log_dir, **kwargs):
+        yield

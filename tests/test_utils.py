@@ -59,6 +59,16 @@ class TestTimers:
         with annotate("test-region"):
             jnp.ones(4).sum()
 
+    def test_trace_capture_writes_profile(self, tmp_path):
+        from apex_tpu.utils import trace
+
+        with trace(str(tmp_path)):
+            out = (jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()
+            jax.block_until_ready(out)
+        # the capture lands as plugins/profile/<run>/ under the log dir
+        runs = list((tmp_path / "plugins" / "profile").iterdir())
+        assert runs, "no profiler capture written"
+
 
 class TestCheckpoint:
     def test_round_trip_and_latest(self, tmp_path, rng):
