@@ -91,6 +91,24 @@ def section(out_path, name, fn):
 from bench import fresh_subrecord  # noqa: E402
 
 
+def transient_error(e) -> bool:
+    """Is this failure worth re-spending a relay window on?
+
+    Budget exhaustion and relay-infrastructure failures (transport down,
+    hung-fetch timeouts) say nothing about the code under test — retry.
+    Anything else is a deterministic answer; retrying re-burns a scarce
+    window on the same result (the smoke-rc=1 principle).  Observed
+    2026-07-31 04:10: the smoke's hung fetch died with
+    ``UNAVAILABLE: .../remote_compile: transport: ...`` — without this
+    classification a relay-down window would have marked micro/configs
+    permanently captured with all-error rows."""
+    s = str(e).lower()
+    return any(t in s for t in (
+        "budget exhausted", "unavailable", "transport",
+        "deadline_exceeded", "connection", "connect",
+    ))
+
+
 def run_headline(deadline, out_path):
     import jax.numpy as jnp
 
@@ -210,10 +228,10 @@ def run_micro(deadline):
             rec[name] = fn(item_deadline)
         except Exception as e:
             rec[name] = f"error: {e}"
-            # only BUDGET exhaustion is worth a retry in a later window; a
-            # raised measurement is a captured (deterministic) answer — the
-            # same reasoning as smoke's rc=1-counts-as-captured
-            if "budget exhausted" in str(e):
+            # budget/relay-infra failures retry in a later window; any
+            # other raised measurement is a captured (deterministic)
+            # answer — smoke's rc=1-counts-as-captured reasoning
+            if transient_error(e):
                 incomplete.append(name)
     if incomplete:
         # harvest.py retries sections whose record carries `incomplete`
@@ -236,7 +254,7 @@ def run_configs(deadline):
             out[name] = bc.CONFIGS[name](tpu=True)
         except Exception as e:
             out[name] = {"error": str(e)[-500:]}
-            if "budget exhausted" in str(e):  # see run_micro
+            if transient_error(e):  # see transient_error
                 incomplete.append(name)
         out[name]["elapsed_s"] = round(time.time() - t0, 1)
     rec = {"configs": out}
@@ -287,7 +305,7 @@ def run_sweep(deadline, out_path):
             rec[name] = {"imgs_per_sec_per_chip": round(v, 2)}
         except Exception as e:
             rec[name] = f"error: {e}"[:400]
-            if "budget exhausted" in str(e):
+            if transient_error(e):
                 incomplete.append(name)
     if incomplete:
         rec["incomplete"] = incomplete

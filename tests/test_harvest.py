@@ -110,3 +110,15 @@ def test_sweep_reuses_fresh_subrecords(tmp_path):
     rec = run_all_tpu.run_sweep(deadline=0.0, out_path=out)
     assert rec["rn50_ampO2_b384"]["imgs_per_sec_per_chip"] == 2700.5
     assert rec["incomplete"] == ["rn50_ampO2_b512"]
+
+
+def test_transient_error_classification():
+    # relay-infrastructure failures retry; deterministic answers don't
+    import run_all_tpu as r
+
+    assert r.transient_error(RuntimeError(
+        "UNAVAILABLE: http://127.0.0.1:8113/remote_compile: transport: ..."))
+    assert r.transient_error(RuntimeError("measurement budget exhausted"))
+    assert r.transient_error(RuntimeError("Connection reset by peer"))
+    assert not r.transient_error(AssertionError("max abs err 0.5 > 0.01"))
+    assert not r.transient_error(ValueError("non-positive slope"))
