@@ -39,6 +39,40 @@ def log(msg):
 
 MAX_NULL_HEADLINE_RETRIES = 3
 
+# relay-infrastructure failure signatures (matched lowercase) — the single
+# source of truth: run_all_tpu.transient_error delegates here (this module
+# is stdlib-only, so the import direction keeps results_state free of the
+# capture module's jax imports)
+_TRANSIENT_TOKENS = ("budget exhausted", "unavailable", "transport",
+                     "deadline_exceeded", "connect")
+
+
+def _transient_text(s):
+    s = s.lower()
+    return any(t in s for t in _TRANSIENT_TOKENS)
+
+
+def _poisoned(rec):
+    """A micro/configs record in which EVERY item failed and at least one
+    failure is relay infrastructure: a relay-down window's artifact, not a
+    measurement.  Treated as not-captured so the section retries — this
+    also heals records written by captures predating run_all_tpu's
+    transient_error classification (observed 2026-07-31)."""
+    if rec.get("section") == "micro":
+        items = [v for k, v in rec.items()
+                 if k not in ("section", "ok", "elapsed_s", "ts", "incomplete")]
+    elif rec.get("section") == "configs":
+        items = list(rec.get("configs", {}).values())
+    else:
+        return False
+    errors = []
+    for v in items:
+        if isinstance(v, dict) and "error" not in v and "skipped" not in v:
+            return False  # at least one real measurement: keep the record
+        errors.append(str(v))
+    # empty = nothing to judge (keep old semantics: captured)
+    return any(_transient_text(t) for t in errors)
+
 
 def results_state(out_path):
     """Which sections have a captured record already?
@@ -71,6 +105,8 @@ def results_state(out_path):
                 if rec.get("incomplete"):
                     # budget-skipped / transiently-errored items inside an
                     # otherwise-ok section: the section must be retried
+                    continue
+                if _poisoned(rec):
                     continue
                 if rec["section"] == "headline" and rec.get("vs_baseline") is None:
                     null_headlines += 1

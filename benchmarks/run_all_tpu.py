@@ -101,12 +101,13 @@ def transient_error(e) -> bool:
     2026-07-31 04:10: the smoke's hung fetch died with
     ``UNAVAILABLE: .../remote_compile: transport: ...`` — without this
     classification a relay-down window would have marked micro/configs
-    permanently captured with all-error rows."""
-    s = str(e).lower()
-    return any(t in s for t in (
-        "budget exhausted", "unavailable", "transport",
-        "deadline_exceeded", "connection", "connect",
-    ))
+    permanently captured with all-error rows.
+
+    The signature list lives in harvest._TRANSIENT_TOKENS (stdlib-only
+    module, also used to heal old records) — one list, no drift."""
+    from harvest import _transient_text
+
+    return _transient_text(str(e))
 
 
 def run_headline(deadline, out_path):

@@ -122,3 +122,40 @@ def test_transient_error_classification():
     assert r.transient_error(RuntimeError("Connection reset by peer"))
     assert not r.transient_error(AssertionError("max abs err 0.5 > 0.01"))
     assert not r.transient_error(ValueError("non-positive slope"))
+
+
+def test_poisoned_all_transient_sections_retry(tmp_path):
+    # a relay-down window's all-error micro/configs record (written by a
+    # capture predating transient classification) must not count as
+    # captured; one real measurement anywhere keeps the record
+    err = "error: UNAVAILABLE: http://127.0.0.1:8113/remote_compile: transport"
+    p = _write(tmp_path, [
+        {"section": "micro", "ok": True, "adam_step_s": err,
+         "l2norm_s": err},
+        {"section": "configs", "ok": True,
+         "configs": {"mlp": {"error": err, "elapsed_s": 3.0},
+                     "bert": {"error": err, "elapsed_s": 2.0}}},
+    ])
+    state = harvest.results_state(p)
+    assert "micro" not in state and "configs" not in state
+    p = _write(tmp_path, [
+        {"section": "micro", "ok": True,
+         "adam_step_s": {"flat": 1.0, "tree": 2.0}, "l2norm_s": err},
+        {"section": "configs", "ok": True,
+         "configs": {"mlp": {"config": "mlp", "value": 3.0,
+                             "elapsed_s": 1.0},
+                     "bert": {"error": err, "elapsed_s": 2.0}}},
+    ])
+    state = harvest.results_state(p)
+    assert "micro" in state and "configs" in state
+
+
+def test_deterministic_all_error_sections_count_as_captured(tmp_path):
+    # every item failed, but deterministically (numerics/shape bugs):
+    # retrying re-burns a window on the same answer — captured
+    p = _write(tmp_path, [
+        {"section": "micro", "ok": True,
+         "adam_step_s": "error: non-positive slope",
+         "l2norm_s": "error: max abs err 0.5"},
+    ])
+    assert "micro" in harvest.results_state(p)
