@@ -58,7 +58,7 @@ def _poisoned(rec):
     measurement.  Treated as not-captured so the section retries — this
     also heals records written by captures predating run_all_tpu's
     transient_error classification (observed 2026-07-31)."""
-    if rec.get("section") == "micro":
+    if rec.get("section") in ("micro", "sweep"):
         items = [v for k, v in rec.items()
                  if k not in ("section", "ok", "elapsed_s", "ts", "incomplete")]
     elif rec.get("section") == "configs":

@@ -1,0 +1,143 @@
+"""FP8 delayed-scaling training example.
+
+Trains a small MLP regression with the hidden matmuls running through
+``apex_tpu.amp.fp8.fp8_dense`` — the minimal delayed-scaling recipe
+(per-tensor amax history -> scale; THIS step quantizes with PREVIOUS
+steps' statistics, so the matmul never depends on its own amax). The
+reference exposes only the amax process groups this recipe consumes
+(apex/transformer/parallel_state.py:280-292); the recipe itself is the
+transformer-engine-style state machine implemented in apex_tpu/amp/fp8.py.
+
+The script shows the two facts that matter about delayed scaling:
+
+1. (one-shot demo) at scale 1 a large tensor SATURATES e4m3's ±448 and
+   the matmul is garbage; one state update later the scale has locked
+   onto the observed amax and the same matmul tracks fp32 closely;
+2. (training loop) the fp8 states thread through a jitted train step
+   exactly like optimizer state — pure pytrees — while the loss
+   decreases and the printed ``qerr`` column (relative error of the fp8
+   forward vs an fp32 forward on the same weights) stays small.
+
+Run: python examples/fp8/train_fp8_mlp.py --steps 60
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.amp.fp8 import fp8_dense, init_fp8_state
+
+
+def saturation_demo(key):
+    """Step t quantizes with step t-1's statistics (the test_fp8 scenario):
+    the first call saturates, the second recovers."""
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (8, 16)) * 1000.0  # amax >> 448
+    w = jax.random.normal(k2, (16, 4))
+    ref = x @ w
+    states = (init_fp8_state(4), init_fp8_state(4))
+    y1, states = fp8_dense(x, w, *states)
+    y2, _ = fp8_dense(x, w, *states)
+    rel = lambda y: float(jnp.max(jnp.abs(y - ref)) / jnp.max(jnp.abs(ref)))
+    print(f"[demo] rel err at scale 1 (saturated): {rel(y1):.3f}; "
+          f"after one amax update: {rel(y2):.4f}", flush=True)
+
+
+def make_params(key, dims):
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(dims[:-1], dims[1:])):
+        k = jax.random.fold_in(key, i)
+        params.append({
+            "w": jax.random.normal(k, (fan_in, fan_out)) / jnp.sqrt(fan_in),
+            "b": jnp.zeros((fan_out,)),
+        })
+    return params
+
+
+def forward(params, fp8_states, x, use_fp8=True):
+    """MLP forward; linears via fp8_dense (QDQ with delayed scales).
+    Returns (out, new_fp8_states)."""
+    new_states = []
+    h = x
+    for i, layer in enumerate(params):
+        if use_fp8:
+            sx, sw = fp8_states[i]
+            h, (sx, sw) = fp8_dense(h, layer["w"], sx, sw, bias=layer["b"])
+            new_states.append((sx, sw))
+        else:
+            h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            h = jax.nn.relu(h)
+    return h, new_states
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--history", type=int, default=8)
+    args = ap.parse_args()
+
+    key = jax.random.PRNGKey(0)
+    saturation_demo(jax.random.fold_in(key, 7))
+
+    dims = [32, args.hidden, args.hidden, 1]
+    params = make_params(key, dims)
+    # one (x, w) state pair per layer, threaded like optimizer state
+    fp8_states = [
+        (init_fp8_state(args.history), init_fp8_state(args.history))
+        for _ in range(len(params))
+    ]
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+
+    kx, _ = jax.random.split(jax.random.fold_in(key, 99))
+    x = jax.random.normal(kx, (args.batch, dims[0]))
+    y = jnp.sum(jnp.sin(x), axis=-1, keepdims=True)
+
+    @jax.jit
+    def step(params, fp8_states, opt_state, x, y):
+        def loss_fn(p):
+            out, new_states = forward(p, fp8_states, x)
+            return jnp.mean((out - y) ** 2), new_states
+
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # fp8 QDQ forward vs fp32 forward on the SAME (updated) weights:
+        # the recipe's accuracy once scales lock on
+        q_out, _ = forward(params, new_states, x)
+        f_out, _ = forward(params, None, x, use_fp8=False)
+        qerr = jnp.max(jnp.abs(q_out - f_out)) / (
+            jnp.max(jnp.abs(f_out)) + 1e-9
+        )
+        return params, new_states, opt_state, loss, qerr
+
+    first = last = None
+    for i in range(args.steps):
+        params, fp8_states, opt_state, loss, qerr = step(
+            params, fp8_states, opt_state, x, y
+        )
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+        if i % 10 == 0 or i == args.steps - 1:
+            s0 = float(fp8_states[0][0].scale)
+            print(
+                f"step {i:4d} loss {float(loss):10.4f} "
+                f"qerr {float(qerr):.4f} scale_x0 {s0:.4g}",
+                flush=True,
+            )
+    assert last < first, f"loss did not decrease: {first} -> {last}"
+    print(f"done: {args.steps} steps (loss {first:.3f} -> {last:.3f})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,10 @@ def test_dcgan_example():
     errg = float(last.split("errG")[1].split()[0])
     assert errd == errd and errg == errg  # not NaN
     assert 0.0 < errd < 50.0 and 0.0 < errg < 50.0
+
+
+def test_fp8_example():
+    out = _run("examples/fp8/train_fp8_mlp.py", ["--steps", "25"])
+    assert "done: 25 steps" in out
+    # the delayed-scaling demo must show recovery after one amax update
+    assert "[demo]" in out

@@ -138,6 +138,11 @@ def test_poisoned_all_transient_sections_retry(tmp_path):
     ])
     state = harvest.results_state(p)
     assert "micro" not in state and "configs" not in state
+    p2 = _write(tmp_path, [
+        {"section": "sweep", "ok": True, "rn50_ampO2_b384": err,
+         "rn50_ampO2_b512": err},
+    ])
+    assert "sweep" not in harvest.results_state(p2)
     p = _write(tmp_path, [
         {"section": "micro", "ok": True,
          "adam_step_s": {"flat": 1.0, "tree": 2.0}, "l2norm_s": err},
