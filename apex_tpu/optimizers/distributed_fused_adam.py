@@ -16,9 +16,13 @@ Overlap of the reduce-scatter with backward is XLA's latency-hiding
 scheduler's job (the reference does it manually with backward hooks and
 side streams); correctness here needs none of that machinery.
 
-Must be used inside shard_map over ``axis_name`` (grads replicated or
-per-device partial — pass ``average_grads=True`` when grads are per-shard
-partials that still need the mean, i.e. the usual DDP case).
+Must be used inside shard_map over ``axis_name``. ``average_grads=True``
+(default) means the incoming grads still need dividing by N for the DP
+mean: per-rank partials under ``check_vma=False``, or the cross-rank SUMS
+that checked shard_map's grad-transpose produces for a per-rank local
+loss. Pass ``average_grads=False`` when the grads are already final —
+e.g. you differentiated a pmean'd GLOBAL loss (see
+``zero_scatter_grads``).
 """
 
 import dataclasses
@@ -97,9 +101,58 @@ def zero_init_master_shard(params, axis_name: str, axis_size: int):
 
 
 def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
-    """Shared ZeRO grad reduce-scatter. Returns (grad_shard, spec)."""
-    gflat, spec = _padded_flatten(grads, axis_size)
-    gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
+    """Shared ZeRO grad reduce-scatter. Returns (grad_shard, spec).
+
+    Two regimes, dispatched on the varying-manual-axes type (the same
+    dispatch as ``parallel.ddp.all_reduce_gradients``):
+
+    - grads VARYING over ``axis_name`` (true per-rank partials): the
+      classic ``psum_scatter``; ``average`` divides by N for the mean.
+    - grads UNVARYING under live vma tracking (jax's checked shard_map:
+      ``jax.grad`` w.r.t. dp-replicated params already psums in the
+      transpose, so each leaf is the cross-rank SUM): the collective
+      collapses to slicing the local shard; ``average`` still divides by
+      N (sum -> mean). A ``psum_scatter`` here would hand every rank
+      N x the sum. Under ``check_vma=False`` everything reads unvarying
+      while grads stay per-rank local, so detection defers to
+      ``parallel.ddp.grads_already_reduced``'s probe.
+
+    The dispatch is PER LEAF, before flattening: jax auto-pvarys the
+    unvarying operands of a concatenate that mixes vma types, so a tree
+    with one varying leaf would otherwise read fully varying and the
+    already-summed leaves would be psummed AGAIN. Varying leaves are
+    psummed individually first; after that every leaf is a cross-rank
+    sum and the flat buffer slices locally. (The all-varying tree skips
+    that and keeps the single fused ``psum_scatter`` — reduce-scatter
+    moves 1/N the bytes of a psum.)
+
+    ``average`` semantics when grads are already reduced: True means
+    "these are un-normalized SUMS, divide by N" (grads of a per-rank
+    LOCAL mean loss — the usual case). If you differentiated a pmean'd
+    GLOBAL loss (the SyncBatchNorm pattern), the grads are already the
+    mean: pass ``average_grads=False`` and the shard is sliced
+    unchanged.
+    """
+    from apex_tpu.parallel.ddp import grads_already_reduced
+
+    leaves = jax.tree_util.tree_leaves(grads)
+    reduced = [grads_already_reduced(l, axis_name) for l in leaves]
+    if all(not r for r in reduced):
+        # classic regime: one fused reduce-scatter over the flat buffer
+        gflat, spec = _padded_flatten(grads, axis_size)
+        gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
+    else:
+        # normalize every leaf to "cross-rank sum" BEFORE flattening
+        # (psum the stragglers), then the collective is a local slice
+        grads = jax.tree_util.tree_map(
+            lambda l: l if grads_already_reduced(l, axis_name)
+            else jax.lax.psum(l, axis_name),
+            grads,
+        )
+        gflat, spec = _padded_flatten(grads, axis_size)
+        shard = gflat.shape[0] // axis_size
+        idx = jax.lax.axis_index(axis_name)
+        gshard = jax.lax.dynamic_slice(gflat, (idx * shard,), (shard,))
     if average:
         gshard = gshard / axis_size
     return gshard, spec

@@ -21,6 +21,28 @@ import jax
 import jax.numpy as jnp
 
 
+def grads_already_reduced(x, axis_name: str) -> bool:
+    """Trace-time: is ``x`` ALREADY the cross-rank sum over ``axis_name``?
+
+    Under jax's checked shard_map (``check_vma=True``, the default),
+    ``jax.grad`` of an axis-varying loss w.r.t. axis-replicated params
+    inserts the cross-rank psum in the transpose, so the grad leaf comes
+    back UNVARYING — summed. Detection must be two-step because under
+    ``check_vma=False`` every aval reads as unvarying while the auto-psum
+    does NOT happen (grads stay per-rank local, measured in
+    tests/test_ddp.py's harness): a probe ``pcast`` tells whether vma
+    tracking is live at all; only then does unvarying prove reduced.
+    """
+    try:
+        vma = jax.typeof(x).vma
+    except AttributeError:  # older tracer/no vma support: classic path
+        return False
+    if axis_name in vma:
+        return False  # genuinely per-rank varying
+    probe = jax.lax.pcast(jnp.zeros(()), axis_name, to="varying")
+    return axis_name in jax.typeof(probe).vma
+
+
 def all_reduce_gradients(
     grads: Any,
     axis_name: str = "dp",
@@ -32,15 +54,27 @@ def all_reduce_gradients(
 
     Call inside shard_map/pmap over ``axis_name`` after ``jax.grad``.
 
-    CAVEAT (differs from torch DDP): this grad-then-allreduce pattern is
-    only correct when the differentiated loss contains NO collectives over
-    ``axis_name``. torch's SyncBatchNorm injects its own all_reduce in its
-    custom backward, so torch DDP composes with it; JAX AD transposes the
-    forward psum instead, and reducing local-loss grads afterwards loses
-    the cross-shard terms. With SyncBatchNorm (or any forward psum over
-    the dp axis), differentiate the GLOBAL loss —
-    ``jax.grad(lambda p: lax.pmean(loss_fn(p), axis_name))`` — and skip
-    this function (tests/test_amp_convergence.py pins both patterns).
+    TWO REGIMES, dispatched per-leaf on the varying-manual-axes type
+    (``jax.typeof(g).vma``):
+
+    - **already-reduced grads** (``axis_name`` NOT in the leaf's vma):
+      under jax's checked shard_map semantics, ``jax.grad`` of a
+      dp-varying loss w.r.t. dp-REPLICATED params inserts the cross-rank
+      psum in the transpose automatically — the "bucketed overlapped
+      allreduce" arrives for free, scheduled by XLA. The leaf is already
+      the SUM over ranks, so averaging is a division by N and another
+      psum would double-count (each rank would get N x the sum — the bug
+      this dispatch fixes, caught by tests/test_ddp.py).
+    - **per-rank local grads** (``axis_name`` in the leaf's vma — e.g.
+      produced under a loss that never mixed ranks, or hand-built): the
+      classic psum path, with the reference's predivide/postdivide
+      ordering (distributed.py:439-455) trading fp16 overflow headroom.
+
+    CAVEAT (differs from torch DDP): with a forward collective over
+    ``axis_name`` in the loss (e.g. SyncBatchNorm), differentiate the
+    GLOBAL loss — ``jax.grad(lambda p: lax.pmean(loss_fn(p), axis_name))``
+    — so the cross-shard terms transpose correctly
+    (tests/test_amp_convergence.py pins the patterns).
     """
     n = jax.lax.psum(1, axis_name)
 
@@ -48,6 +82,16 @@ def all_reduce_gradients(
         orig = g.dtype
         if allreduce_always_fp32:
             g = g.astype(jnp.float32)
+        if grads_already_reduced(g, axis_name):
+            # transpose already psummed over axis_name: sum -> mean.
+            # With average the predivide factor cancels exactly as in the
+            # classic path ((sum/f)*(f/N) = sum/N); without it the classic
+            # path returns sum/f, so divide here too for regime parity.
+            if gradient_average:
+                g = g / n
+            elif gradient_predivide_factor != 1.0:
+                g = g / gradient_predivide_factor
+            return g.astype(orig)
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
         g = jax.lax.psum(g, axis_name)
@@ -119,13 +163,23 @@ class DistributedDataParallel:
 
 class Reducer:
     """Manual-sync helper (ref: parallel/distributed.py:91): user calls
-    ``reduce`` explicitly, no implicit hooks."""
+    ``reduce`` explicitly, no implicit hooks. Contract: the cross-rank
+    MEAN of per-rank values — a leaf already replicated over the axis
+    (unvarying vma) is its own mean and passes through unchanged (a psum
+    there would multiply by N)."""
 
     def __init__(self, axis_name: str = "dp"):
         self.axis_name = axis_name
 
     def reduce(self, tree: Any) -> Any:
         n = jax.lax.psum(1, self.axis_name)
-        return jax.tree_util.tree_map(
-            lambda x: jax.lax.psum(x, self.axis_name) / n, tree
-        )
+
+        def _one(x):
+            if grads_already_reduced(x, self.axis_name):
+                # replicated leaf: it IS the value on every rank; but
+                # Reducer's contract is a MEAN of per-rank values, and a
+                # replicated leaf's mean is itself
+                return x
+            return jax.lax.psum(x, self.axis_name) / n
+
+        return jax.tree_util.tree_map(_one, tree)

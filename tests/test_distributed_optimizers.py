@@ -423,3 +423,123 @@ class TestZeROInPipelineTopology:
         # ZeRO property inside the pp mesh: a real (nonzero) per-device
         # shard exists and dp of them cover this rank's padded params
         assert int(shard) > 0
+
+
+class TestCheckedShardMapGrads:
+    """Under jax's CHECKED shard_map (check_vma=True, the default),
+    jax.grad w.r.t. dp-replicated params already returns the cross-rank
+    SUM (auto-psum in the transpose). zero_scatter_grads must not psum
+    again — with average_grads=True the scattered shard must be exactly
+    the full-batch MEAN gradient slice. Scale-sensitive on the raw
+    shards (Adam's m/sqrt(v) ratio is scale-invariant and would mask a
+    uniform factor-of-N error)."""
+
+    def test_scatter_of_autosummed_grads_is_exact_mean(self, rng):
+        from apex_tpu.optimizers.distributed_fused_adam import (
+            _padded_flatten,
+            zero_scatter_grads,
+        )
+
+        mesh = parallel_state.initialize_model_parallel(
+            devices=jax.devices()[:DP]
+        )
+        params = make_params(rng)
+        x = jax.random.normal(jax.random.fold_in(rng, 5), (32, 5))
+
+        def loss(p, x):
+            h = x @ p["a"]["kernel"] + p["a"]["bias"]  # (n, 3)
+            # touch every leaf incl. the unrelated-size b.kernel
+            return jnp.mean(h ** 2) + jnp.sum(p["b"]["kernel"] ** 2)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P("dp")
+        )
+        def scattered(p, x):
+            g = jax.grad(loss)(p, x)
+            shard, _ = zero_scatter_grads(g, "dp", DP, True)
+            return shard[None]
+
+        got = np.asarray(scattered(params, x)).reshape(-1)
+        want_flat, _ = _padded_flatten(
+            jax.grad(loss)(params, x), DP
+        )  # full-batch mean-loss grads, the DDP ground truth
+        np.testing.assert_allclose(got, np.asarray(want_flat),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pmean_global_loss_grads_with_average_off(self, rng):
+        """The SyncBatchNorm doc pattern: jax.grad of a pmean'd GLOBAL
+        loss returns the MEAN already — average_grads=False must slice it
+        through unchanged (the documented contract)."""
+        from apex_tpu.optimizers.distributed_fused_adam import (
+            _padded_flatten,
+            zero_scatter_grads,
+        )
+
+        mesh = parallel_state.initialize_model_parallel(
+            devices=jax.devices()[:DP]
+        )
+        params = make_params(rng)
+        x = jax.random.normal(jax.random.fold_in(rng, 5), (32, 5))
+
+        def local_loss(p, x):
+            h = x @ p["a"]["kernel"] + p["a"]["bias"]
+            return jnp.mean(h ** 2) + jnp.sum(p["b"]["kernel"] ** 2)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P("dp")
+        )
+        def scattered(p, x):
+            g = jax.grad(
+                lambda p: jax.lax.pmean(local_loss(p, x), "dp")
+            )(p)
+            shard, _ = zero_scatter_grads(g, "dp", DP, average=False)
+            return shard[None]
+
+        got = np.asarray(scattered(params, x)).reshape(-1)
+        want_flat, _ = _padded_flatten(
+            jax.grad(lambda p: local_loss(p, x))(params, ), DP
+        )
+        np.testing.assert_allclose(got, np.asarray(want_flat),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mixed_vma_tree_per_leaf_dispatch(self, rng):
+        """One varying leaf must not drag already-summed leaves through a
+        second psum (concatenate auto-pvarys mixed operands): each leaf
+        lands as the exact mean regardless of its regime."""
+        from apex_tpu.optimizers.distributed_fused_adam import (
+            _padded_flatten,
+            zero_scatter_grads,
+        )
+
+        mesh = parallel_state.initialize_model_parallel(
+            devices=jax.devices()[:DP]
+        )
+        params = make_params(rng)
+        x = jax.random.normal(jax.random.fold_in(rng, 5), (32, 5))
+
+        def local_loss(p, x):
+            h = x @ p["a"]["kernel"] + p["a"]["bias"]
+            return jnp.mean(h ** 2)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P("dp")
+        )
+        def scattered(p, x):
+            g = jax.grad(lambda p: local_loss(p, x))(p)  # auto-summed, b=0
+            # replace the b leaf with a hand-built VARYING per-rank grad
+            # whose mean is exactly ones
+            g["b"]["kernel"] = jax.lax.pcast(
+                jnp.ones_like(p["b"]["kernel"]), "dp", to="varying"
+            )
+            shard, _ = zero_scatter_grads(g, "dp", DP, average=True)
+            return shard[None]
+
+        got = np.asarray(scattered(params, x)).reshape(-1)
+        want = jax.grad(lambda p: local_loss(p, x))(params)
+        want["b"]["kernel"] = jnp.ones_like(params["b"]["kernel"])
+        want_flat, _ = _padded_flatten(want, DP)
+        np.testing.assert_allclose(got, np.asarray(want_flat),
+                                   rtol=1e-5, atol=1e-6)
