@@ -126,28 +126,37 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
     that and keeps the single fused ``psum_scatter`` — reduce-scatter
     moves 1/N the bytes of a psum.)
 
-    ``average`` semantics when grads are already reduced: True means
-    "these are un-normalized SUMS, divide by N" (grads of a per-rank
-    LOCAL mean loss — the usual case). If you differentiated a pmean'd
-    GLOBAL loss (the SyncBatchNorm pattern), the grads are already the
-    mean: pass ``average_grads=False`` and the shard is sliced
-    unchanged.
+    ``average`` semantics by regime:
+
+    - ``check_vma=False`` (vma tracking off): pass ``average=True``
+      ALWAYS — it is correct both for per-rank partials (psum/N = mean)
+      and for replicated already-averaged grads from a pmean'd loss
+      (psum_scatter sums the N identical replicas, /N restores the
+      mean). ``average=False`` on replicated means yields N x the mean.
+    - checked shard_map (default): ``average=True`` for the un-normalized
+      SUMS that grads of a per-rank LOCAL mean loss arrive as (the usual
+      case); ``average=False`` if you differentiated a pmean'd GLOBAL
+      loss (SyncBatchNorm pattern) — those grads are already the mean
+      and slice through unchanged.
     """
-    from apex_tpu.parallel.ddp import grads_already_reduced
+    from apex_tpu.parallel.ddp import grads_already_reduced, vma_tracking_live
 
     leaves = jax.tree_util.tree_leaves(grads)
-    reduced = [grads_already_reduced(l, axis_name) for l in leaves]
-    if all(not r for r in reduced):
+    tracking = vma_tracking_live(axis_name)
+    reduced = [grads_already_reduced(l, axis_name, tracking) for l in leaves]
+    if not any(reduced):
         # classic regime: one fused reduce-scatter over the flat buffer
         gflat, spec = _padded_flatten(grads, axis_size)
         gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
     else:
         # normalize every leaf to "cross-rank sum" BEFORE flattening
         # (psum the stragglers), then the collective is a local slice
-        grads = jax.tree_util.tree_map(
-            lambda l: l if grads_already_reduced(l, axis_name)
-            else jax.lax.psum(l, axis_name),
-            grads,
+        flat_leaves = [
+            l if r else jax.lax.psum(l, axis_name)
+            for l, r in zip(leaves, reduced)
+        ]
+        grads = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(grads), flat_leaves
         )
         gflat, spec = _padded_flatten(grads, axis_size)
         shard = gflat.shape[0] // axis_size

@@ -21,7 +21,19 @@ import jax
 import jax.numpy as jnp
 
 
-def grads_already_reduced(x, axis_name: str) -> bool:
+def vma_tracking_live(axis_name: str) -> bool:
+    """Trace-time: is varying-manual-axes tracking active for this axis?
+    (``check_vma=False`` turns ``pcast`` into a no-op, so the probe's
+    type stays unvarying there.) Per-trace-context constant — hoist out
+    of per-leaf loops."""
+    probe = jax.lax.pcast(jnp.zeros(()), axis_name, to="varying")
+    try:
+        return axis_name in jax.typeof(probe).vma
+    except AttributeError:
+        return False
+
+
+def grads_already_reduced(x, axis_name: str, tracking: bool = None) -> bool:
     """Trace-time: is ``x`` ALREADY the cross-rank sum over ``axis_name``?
 
     Under jax's checked shard_map (``check_vma=True``, the default),
@@ -30,8 +42,8 @@ def grads_already_reduced(x, axis_name: str) -> bool:
     back UNVARYING — summed. Detection must be two-step because under
     ``check_vma=False`` every aval reads as unvarying while the auto-psum
     does NOT happen (grads stay per-rank local, measured in
-    tests/test_ddp.py's harness): a probe ``pcast`` tells whether vma
-    tracking is live at all; only then does unvarying prove reduced.
+    tests/test_ddp.py's harness): the ``vma_tracking_live`` probe tells
+    whether unvarying proves anything (pass it in when calling per leaf).
     """
     try:
         vma = jax.typeof(x).vma
@@ -39,8 +51,9 @@ def grads_already_reduced(x, axis_name: str) -> bool:
         return False
     if axis_name in vma:
         return False  # genuinely per-rank varying
-    probe = jax.lax.pcast(jnp.zeros(()), axis_name, to="varying")
-    return axis_name in jax.typeof(probe).vma
+    if tracking is None:
+        tracking = vma_tracking_live(axis_name)
+    return tracking
 
 
 def all_reduce_gradients(
@@ -77,12 +90,13 @@ def all_reduce_gradients(
     (tests/test_amp_convergence.py pins the patterns).
     """
     n = jax.lax.psum(1, axis_name)
+    tracking = vma_tracking_live(axis_name)
 
     def _one(g):
         orig = g.dtype
         if allreduce_always_fp32:
             g = g.astype(jnp.float32)
-        if grads_already_reduced(g, axis_name):
+        if grads_already_reduced(g, axis_name, tracking):
             # transpose already psummed over axis_name: sum -> mean.
             # With average the predivide factor cancels exactly as in the
             # classic path ((sum/f)*(f/N) = sum/N); without it the classic
@@ -173,9 +187,10 @@ class Reducer:
 
     def reduce(self, tree: Any) -> Any:
         n = jax.lax.psum(1, self.axis_name)
+        tracking = vma_tracking_live(self.axis_name)
 
         def _one(x):
-            if grads_already_reduced(x, self.axis_name):
+            if grads_already_reduced(x, self.axis_name, tracking):
                 # replicated leaf: it IS the value on every rank; but
                 # Reducer's contract is a MEAN of per-rank values, and a
                 # replicated leaf's mean is itself

@@ -15,6 +15,12 @@ only hardware window before the headline ran):
 4. BASELINE configs 2-5 (full TPU shapes)
 5. headline operating-point sweep (RN50 amp-O2 at batch 384/512)
 
+Record semantics: ``ok: true`` means the section RAN TO COMPLETION, not
+that its measurements are valid — a relay-down window produces ok:true
+records whose every item is an embedded error (harvest.py's
+``_poisoned``/``incomplete`` logic decides what retries; BENCH.md only
+ever cites successful item payloads).
+
 Every section runs under a hard per-section wall-clock budget enforced
 INTERNALLY (deadline checks between items / span escalations — an in-flight
 relay fetch is never killed, because a SIGTERM mid-claim has wedged the
