@@ -33,6 +33,7 @@ from apex_tpu.parallel.ring_attention import (
 from apex_tpu.parallel.utils import (
     VocabUtility,
     broadcast_data,
+    pvary_params,
     split_tensor_along_last_dim,
 )
 
@@ -59,5 +60,6 @@ __all__ = [
     "zigzag_unshard",
     "VocabUtility",
     "broadcast_data",
+    "pvary_params",
     "split_tensor_along_last_dim",
 ]

@@ -26,7 +26,10 @@ def vma_tracking_live(axis_name: str) -> bool:
     (``check_vma=False`` turns ``pcast`` into a no-op, so the probe's
     type stays unvarying there.) Per-trace-context constant — hoist out
     of per-leaf loops."""
-    probe = jax.lax.pcast(jnp.zeros(()), axis_name, to="varying")
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:  # pre-vma jax: nothing is tracked
+        return False
+    probe = pcast(jnp.zeros(()), axis_name, to="varying")
     try:
         return axis_name in jax.typeof(probe).vma
     except AttributeError:

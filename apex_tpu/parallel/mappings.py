@@ -24,6 +24,8 @@ import functools
 
 import jax
 
+from apex_tpu.parallel.utils import pcast_varying
+
 # -- raw collectives (axis-name-parameterized) ------------------------------
 
 
@@ -39,8 +41,43 @@ def _all_gather_dim(x, axis_name: str, dim: int):
     return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
+def _all_gather_invariant_dim(x, axis_name: str, dim: int):
+    """all_gather typed INVARIANT over ``axis_name``: every rank provably
+    receives the same gathered array. Under checked shard_map the scatter
+    ops' bwd rules owe a cotangent with the PRIMAL input's vma — a
+    replicated activation — and the plain ``all_gather`` stays typed
+    axis-varying, failing the custom_vjp typecheck (caught by the GPT
+    pp x tp x sp integration under default shard_map). Same collective,
+    different type; identical under ``check_vma=False``."""
+    try:
+        from jax._src.lax.parallel import all_gather_invariant
+    except ImportError:  # older jax: unchecked semantics, plain gather
+        return _all_gather_dim(x, axis_name, dim)
+    return all_gather_invariant(x, axis_name, axis=dim, tiled=True)
+
+
 def _reduce_scatter_dim(x, axis_name: str, dim: int):
     return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+def _typed_gather(g, primal_probe, axis_name: str, dim: int):
+    """all_gather for a scatter op's bwd, typed to match the PRIMAL:
+    the usual replicated primal needs the invariant gather (checked
+    shard_map owes an invarying cotangent), but a genuinely axis-varying
+    primal — recorded as a zero-size residual slice carrying its vma —
+    needs the plain varying gather. Pre-vma jax / check_vma=False reads
+    everything unvarying AND accepts either, so plain gather is used."""
+    try:
+        varying = axis_name in jax.typeof(primal_probe).vma
+    except AttributeError:
+        varying = True
+    if varying:
+        return _all_gather_dim(g, axis_name, dim)
+    from apex_tpu.parallel.ddp import vma_tracking_live
+
+    if not vma_tracking_live(axis_name):
+        return _all_gather_dim(g, axis_name, dim)
+    return _all_gather_invariant_dim(g, axis_name, dim)
 
 
 # -- custom_vjp pairs -------------------------------------------------------
@@ -72,7 +109,10 @@ def _reduce_fwd(x, axis_name):
 
 
 def _reduce_bwd(axis_name, _, g):
-    return (g,)
+    # the primal input was axis-VARYING (per-rank partial sums); the
+    # cotangent of the psum'd output arrives invarying, so re-type it
+    # (identity under check_vma=False / pre-vma jax, and on numerics)
+    return (pcast_varying(g, axis_name),)
 
 
 reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
@@ -84,11 +124,12 @@ def scatter_to_tensor_model_parallel_region(x, axis_name="tp"):
 
 
 def _scatter_fwd(x, axis_name):
-    return _split_along_axis(x, axis_name, -1), None
+    # zero-size slice: carries the primal's vma TYPE into bwd for free
+    return _split_along_axis(x, axis_name, -1), x[..., :0]
 
 
-def _scatter_bwd(axis_name, _, g):
-    return (_all_gather_dim(g, axis_name, g.ndim - 1),)
+def _scatter_bwd(axis_name, res, g):
+    return (_typed_gather(g, res, axis_name, g.ndim - 1),)
 
 
 scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
@@ -116,11 +157,11 @@ def scatter_to_sequence_parallel_region(x, axis_name="tp"):
 
 
 def _scatter_seq_fwd(x, axis_name):
-    return _split_along_axis(x, axis_name, 0), None
+    return _split_along_axis(x, axis_name, 0), x[:0]
 
 
-def _scatter_seq_bwd(axis_name, _, g):
-    return (_all_gather_dim(g, axis_name, 0),)
+def _scatter_seq_bwd(axis_name, res, g):
+    return (_typed_gather(g, res, axis_name, 0),)
 
 
 scatter_to_sequence_parallel_region.defvjp(_scatter_seq_fwd, _scatter_seq_bwd)

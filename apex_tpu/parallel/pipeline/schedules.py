@@ -60,6 +60,25 @@ def _index(tree: Any, i) -> Any:
     )
 
 
+def _varying_zeros(out_shape, axis_name: str):
+    """Zero boundary-activation carry whose varying-manual-axes type is a
+    FIXED POINT of the tick body: the stage output's vma (carried by the
+    ``jax.eval_shape`` avals under checked shard_map — dp-varying data,
+    tp-varying params, ...) plus ``axis_name`` (the in-scan ppermute makes
+    the received edge pp-varying even when nothing else is). An unvarying
+    zeros carry fails the scan typecheck the first time the body returns
+    a varying value. ``pcast`` is a no-op under ``check_vma=False``."""
+
+    from apex_tpu.parallel.utils import pcast_varying
+
+    def one(s):
+        z = jnp.zeros(s.shape, s.dtype)
+        axes = set(getattr(s, "vma", ()) or ()) | {axis_name}
+        return pcast_varying(z, tuple(sorted(axes)))
+
+    return jax.tree_util.tree_map(one, out_shape)
+
+
 def _scan_ticks(tick, state0, num_ticks: int, tick_block_remat: int):
     """Scan ``tick`` over ``num_ticks`` ticks, optionally rematerializing in
     blocks: with ``tick_block_remat = B > 0`` the scan nests — an outer scan
@@ -126,7 +145,7 @@ def pipeline_forward(
 
     mb0 = _index(microbatches, 0)
     out_shape = jax.eval_shape(stage_fn, params, mb0)
-    state0 = jax.tree_util.tree_map(lambda s: jnp.zeros(s.shape, s.dtype), out_shape)
+    state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
         recv = p2p.send_forward_recv_forward(state, axis_name)
@@ -208,9 +227,7 @@ def pipeline_forward_interleaved(
 
     mb0 = _index(microbatches, 0)
     out_shape = jax.eval_shape(body, params_chunks, 0, mb0)
-    state0 = jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), out_shape
-    )
+    state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
         recv = p2p.ring_forward(state, axis_name)

@@ -208,6 +208,15 @@ def _ring(q, k, v, kbias, axis_name, causal, scale, block_size, window, zigzag):
     return o
 
 
+def _bias_placeholder(b: int, axis_name: str):
+    """Rotatable stand-in for a None key-padding bias in the ring scan
+    carry — typed varying so it survives the in-scan ppermute's vma under
+    checked shard_map (identity under check_vma=False / pre-vma jax)."""
+    from apex_tpu.parallel.utils import pcast_varying
+
+    return pcast_varying(jnp.zeros((b, 0)), axis_name)
+
+
 def _keep_from_bias(kbias):
     """(b, s) float bias (0 valid / _NEG_INF padded) -> bool validity mask.
     The bias is float (not bool) only so it can ride the custom_vjp as a
@@ -262,8 +271,8 @@ def _ring_fwd_res(q, k, v, kbias, axis_name, causal, scale, block_size,
         return ((kc, vc, biasc), state), None
 
     if num_ranks > 1:
-        # a None bias still needs a rotatable placeholder in the carry
-        bias_carry = kbias if kbias is not None else jnp.zeros((b, 0))
+        bias_carry = (kbias if kbias is not None
+                      else _bias_placeholder(b, axis_name))
         ((_, _, _), state), _ = jax.lax.scan(
             step, ((k, v, bias_carry), state), jnp.arange(1, num_ranks)
         )
@@ -387,7 +396,8 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
         )
         return ((kc, vc, biasc, dkc, dvc), dq), None
 
-    bias_carry = kbias if kbias is not None else jnp.zeros((b, 0))
+    bias_carry = (kbias if kbias is not None
+                  else _bias_placeholder(b, axis_name))
     carry = ((k, v, bias_carry, dk0, dv0), dq)
     if num_ranks > 1:
         carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))

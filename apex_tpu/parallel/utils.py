@@ -46,3 +46,49 @@ def broadcast_data(keys, data, dtype=None):
     """
     del dtype
     return {k: data[k] for k in keys}
+
+
+def pcast_varying(x, axis_names):
+    """``jax.lax.pcast(x, axis_names, to='varying')`` with an identity
+    fallback on jax versions predating the vma type system (pcast absent
+    there, and with no typing the cast is meaningless — exactly the
+    unchecked semantics every pre-vma path assumed)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axis_names, to="varying")
+
+
+def pvary_params(tree, axis_name: str = "tp"):
+    """Type every leaf of a param pytree VARYING over ``axis_name``
+    (leaves already varying pass through; numerics unchanged; no-op under
+    ``check_vma=False``).
+
+    Why this exists: under jax's checked shard_map, a tensor-parallel
+    param created IN-BODY with a rank-independent initializer (the
+    canonical zeros bias of ColumnParallelLinear) is typed replicated
+    even though each rank's slice is a distinct coordinate of the global
+    parameter — and ``jax.grad`` then auto-psums its gradient over
+    ``axis_name``, silently summing what should stay per-rank
+    (tests/test_checked_vma.py pins the 7.5% grad error this produced).
+    Params that enter the shard_map through tp-sharded ``in_specs``, or
+    whose init folds in the tp rank, are already varying and unaffected.
+    Call this on stage/layer param trees built inside shard_map before
+    differentiating.
+
+    ONLY for sharded params: a genuinely REPLICATED parameter must stay
+    invarying — e.g. ``RowParallelLinear``'s bias, which is added once
+    AFTER the tp reduction; pvarying it types the layer output spuriously
+    varying and shifts every downstream gradient. Apply per-subtree when
+    a tree mixes both (tests/test_checked_vma.py shows the pattern).
+    """
+
+    def one(x):
+        try:
+            if axis_name in jax.typeof(x).vma:
+                return x
+        except AttributeError:
+            return x
+        return pcast_varying(x, axis_name)
+
+    return jax.tree_util.tree_map(one, tree)

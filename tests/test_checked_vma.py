@@ -1,0 +1,224 @@
+"""Core parallel primitives under jax's CHECKED shard_map (check_vma=True,
+the default) — the mode every fresh user hits.
+
+The package's own tests historically ran check_vma=False; probing under
+checked mode (2026-07-31) found three latent type failures, all fixed and
+pinned here with checked-vs-unchecked numeric parity:
+
+- ring attention's (b, 0) bias placeholder entered the ring scan carry
+  unvarying and left varying after ppermute (scan typecheck);
+- the pipeline schedules' zero boundary-activation carry had the same
+  mismatch (fixed-point vma derived from eval_shape in _varying_zeros);
+- the TP mappings' bwd rules produced wrongly-typed cotangents
+  (scatter bwds need the invariant all_gather; reduce_from's bwd must
+  pvary the invarying cotangent).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.parallel.ring_attention import ring_attention
+
+
+@pytest.fixture
+def cp_mesh():
+    return Mesh(np.asarray(jax.devices()), ("cp",))
+
+
+def _ring_loss_grads(mesh, check_vma, **ring_kw):
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 32, 8))
+    k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 32, 8))
+    v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 32, 8))
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, None, "cp"),) * 3,
+        out_specs=P(None, None, "cp"),
+        check_vma=check_vma,
+    )
+    def grads(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(jnp.sin(ring_attention(
+                q, k, v, axis_name="cp", **ring_kw)))
+
+        return jax.grad(loss)(q, k, v)
+
+    return np.asarray(grads(q, k, v))
+
+
+@pytest.mark.parametrize("ring_kw", [
+    dict(causal=True),
+    dict(causal=True, window=8),
+    dict(causal=True, zigzag=True),
+])
+def test_ring_attention_checked_matches_unchecked(cp_mesh, ring_kw):
+    got = _ring_loss_grads(cp_mesh, True, **ring_kw)
+    want = _ring_loss_grads(cp_mesh, False, **ring_kw)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_pipeline_1f1b_checked_matches_unchecked():
+    from apex_tpu.parallel.pipeline.schedules import (
+        forward_backward_pipelining_without_interleaving,
+    )
+
+    mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+    hid, mb, M = 8, 2, 8
+    xs = jax.random.normal(jax.random.PRNGKey(0), (M, mb, hid))
+    ts = jax.random.normal(jax.random.PRNGKey(3), (M, mb, hid))
+
+    def stage_fn(params, x):
+        return jnp.tanh(x @ params)
+
+    def loss_fn(x, t):
+        return jnp.mean((x - t) ** 2)
+
+    def run(check_vma):
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+            out_specs=(P(), P("pp")), check_vma=check_vma,
+        )
+        def go(xs, ts):
+            params = jax.random.normal(
+                jax.random.fold_in(jax.random.PRNGKey(1),
+                                   jax.lax.axis_index("pp")),
+                (hid, hid),
+            ) * 0.3
+            loss, _, grads = forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, params, xs, ts, axis_name="pp"
+            )
+            return jax.lax.pmean(loss, "pp"), grads[None]
+
+        return go(xs, ts)
+
+    l1, g1 = run(True)
+    l0, g0 = run(False)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g0), rtol=1e-6,
+                               atol=1e-7)
+
+
+def test_gpt_pp_tp_sp_full_step_checked():
+    """The dryrun-class integration (pipelined parallel transformer with
+    SP) must compile AND produce finite loss/grads under default checked
+    shard_map — the three latent fixes compose here."""
+    from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.parallel.pipeline import forward_backward_with_pre_post
+    from apex_tpu.transformer import TransformerConfig
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2, pipeline_model_parallel_size=2,
+    )
+    vocab, seq, hidden, mb, num_micro = 64, 16, 32, 2, 2
+    cfg = TransformerConfig(
+        num_layers=4, hidden_size=hidden, num_attention_heads=4,
+        vocab_size=vocab, max_position_embeddings=seq,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        sequence_parallel=True, compute_dtype=jnp.float32,
+    )
+    parts = build_gpt_pipeline(cfg, 2)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (num_micro, mb * 2, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, axis=2)
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, "dp"), P(None, "dp")), out_specs=(P(), P()),
+    )
+    def step(tokens, labels):
+        init_key = jax.random.PRNGKey(0)
+        pre = parts.embed.init(init_key, tokens[0])["params"]
+        h0 = parts.pre_fn(pre, tokens[0])
+        r = jax.lax.axis_index("pp")
+        stage = parts.chunk.init(
+            jax.random.fold_in(jax.random.fold_in(init_key, 7), r), h0
+        )["params"]
+        params = {"pre": pre, "stages": stage,
+                  "post": parts.init_post(jax.random.fold_in(init_key, 9))}
+        loss, _, grads = forward_backward_with_pre_post(
+            parts.pre_fn, parts.stage_fn, parts.post_loss_fn, params,
+            tokens, labels, axis_name="pp",
+        )
+        gnorm = sum(
+            jnp.sum(jnp.square(g))
+            for g in jax.tree_util.tree_leaves(grads)
+        )
+        for ax in ("tp", "cp", "dp", "pp"):
+            loss = jax.lax.pmean(loss, ax)
+            gnorm = jax.lax.pmean(gnorm, ax)
+        return loss, gnorm
+
+    loss, gnorm = step(tokens, labels)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(float(gnorm)) and float(gnorm) > 0.0
+    parallel_state.destroy_model_parallel()
+
+
+def test_tp_linears_checked_match_unchecked():
+    """Column+Row parallel linears (the mappings' bwd rules) produce the
+    same grads in both modes."""
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.parallel.layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+    )
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=8,
+    )
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 16))
+
+    def run(check_vma):
+        col = ColumnParallelLinear(output_size=32, gather_output=False)
+        row = RowParallelLinear(output_size=16, input_is_parallel=True)
+
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=check_vma,
+        )
+        def grads(x):
+            from apex_tpu.parallel import pvary_params
+
+            kc = jax.random.fold_in(jax.random.PRNGKey(1),
+                                    jax.lax.axis_index("tp"))
+            # zeros-init SHARDED params read as replicated under checked
+            # vma even though each rank holds a distinct slice: mark them
+            # varying or grads auto-psum over tp (the failure pinned
+            # here). Column kernel+bias both shard the output dim; row
+            # kernel shards the input dim but its bias is applied AFTER
+            # the reduction — genuinely replicated, so it must stay
+            # invarying (pvarying it makes the output spuriously varying)
+            pc = pvary_params(col.init(kc, x), "tp")
+            h = col.apply(pc, x)
+            pr = row.init(jax.random.fold_in(kc, 2), h)
+            pr = {"params": {
+                "kernel": pvary_params(pr["params"]["kernel"], "tp"),
+                "bias": pr["params"]["bias"],
+            }}
+
+            def loss(pc, pr):
+                out = row.apply(pr, col.apply(pc, x))
+                return jnp.sum(jnp.sin(out))
+
+            gc, gr = jax.grad(loss, argnums=(0, 1))(pc, pr)
+            total = sum(
+                jnp.sum(jnp.abs(l))
+                for l in jax.tree_util.tree_leaves((gc, gr))
+            )
+            return jax.lax.pmean(total, "tp")
+
+        return float(grads(x))
+
+    got, want = run(True), run(False)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    parallel_state.destroy_model_parallel()
