@@ -3,19 +3,36 @@
 Reference parity: apex/transformer/tensor_parallel/cross_entropy.py
 (_VocabParallelCrossEntropy, :23-131): logits are sharded along vocab over
 TP; the softmax-CE is computed with three TP collectives — max (pmax),
-sum-exp (psum), and the target-logit partial (psum) — plus label smoothing.
+sum-exp (psum), and the target-logit partial (psum) — and the BACKWARD is
+hand-written (softmax - onehot, :105-130), exactly like the reference's
+autograd Function.
 
-TPU design: straight jnp over ``lax`` collectives; autodiff produces the
-same (softmax - onehot) backward the reference hand-writes, with the psum
-transposes handled by JAX.
+The backward is a ``custom_vjp``, not autodiff: differentiating through
+the forward's psums under ``check_vma=False`` double-counts (the psum
+transposes to another psum, so each rank's redundant loss copy
+contributes — measured tp x the dense gradient on an 8-way mesh;
+tests/test_checked_vma.py::test_vocab_parallel_ce_grads_match_dense
+pins the fix against dense grads in BOTH shard_map modes). The hand-written rule is shard-local — no collective
+in the backward at all — and its cotangent is typed correctly under
+checked vma for free (invarying ct x varying softmax = varying).
 """
+
+import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.parallel import parallel_state
 
 
+def _tp_size() -> int:
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_tensor_model_parallel_world_size()
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def vocab_parallel_cross_entropy(
     logits_local, target, label_smoothing: float = 0.0, axis_name: str = "tp"
 ):
@@ -24,9 +41,12 @@ def vocab_parallel_cross_entropy(
     ``logits_local``: (..., vocab/tp) this rank's shard; ``target``: (...)
     global token ids. Returns fp32 losses shaped like ``target``.
     """
-    tp = 1
-    if parallel_state.model_parallel_is_initialized():
-        tp = parallel_state.get_tensor_model_parallel_world_size()
+    loss, _ = _vp_ce_fwd(logits_local, target, label_smoothing, axis_name)
+    return loss
+
+
+def _vp_ce_fwd(logits_local, target, label_smoothing, axis_name):
+    tp = _tp_size()
     lf = logits_local.astype(jnp.float32)
     vocab_local = lf.shape[-1]
 
@@ -34,15 +54,13 @@ def vocab_parallel_cross_entropy(
         lse = jax.scipy.special.logsumexp(lf, axis=-1)
         tlogit = jnp.take_along_axis(lf, target[..., None], axis=-1)[..., 0]
         mean_logit = jnp.mean(lf, axis=-1)
+        in_range = jnp.ones(target.shape, bool)
+        local_ids = target
     else:
         rank = jax.lax.axis_index(axis_name)
         start = rank * vocab_local
-        # global max for stability (ref: allreduce MAX, cross_entropy.py:38);
-        # the shift cancels analytically, so keep it out of the grad graph
-        # (pmax has no differentiation rule).
-        gmax = jax.lax.pmax(
-            jax.lax.stop_gradient(jnp.max(lf, axis=-1)), axis_name
-        )
+        # global max for stability (ref: allreduce MAX, cross_entropy.py:38)
+        gmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
         shifted = lf - gmax[..., None]
         sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
         lse = jnp.log(sum_exp) + gmax
@@ -61,4 +79,29 @@ def vocab_parallel_cross_entropy(
         # (ref: cross_entropy.py:86-103 label smoothing term)
         smooth_loss = lse - mean_logit
         loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth_loss
-    return loss
+    # softmax of THIS rank's shard (ref: exp_logits saved for backward)
+    softmax_local = jnp.exp(lf - lse[..., None])
+    # zero-size slice: carries the primal's dtype AND vma type into bwd
+    res = (softmax_local, in_range, local_ids, logits_local[..., :0])
+    return loss, res
+
+
+def _vp_ce_bwd(label_smoothing, axis_name, res, ct):
+    """d loss / d logit_j = softmax_j - (1-ls) * onehot_j - ls / V
+    (ref: cross_entropy.py:105-130) — shard-local, no collectives."""
+    softmax_local, in_range, local_ids, probe = res
+    vocab_local = softmax_local.shape[-1]
+    vocab_global = vocab_local * _tp_size()
+    onehot = (
+        jax.nn.one_hot(local_ids, vocab_local, dtype=jnp.float32)
+        * in_range[..., None]
+    )
+    g = softmax_local - (1.0 - label_smoothing) * onehot
+    if label_smoothing > 0.0:
+        g = g - label_smoothing / vocab_global
+    g = (g * ct[..., None].astype(jnp.float32)).astype(probe.dtype)
+    # integer target takes a float0 cotangent
+    return g, np.zeros(local_ids.shape, dtype=jax.dtypes.float0)
+
+
+vocab_parallel_cross_entropy.defvjp(_vp_ce_fwd, _vp_ce_bwd)
