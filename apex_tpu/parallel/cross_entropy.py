@@ -40,6 +40,9 @@ def vocab_parallel_cross_entropy(
 
     ``logits_local``: (..., vocab/tp) this rank's shard; ``target``: (...)
     global token ids. Returns fp32 losses shaped like ``target``.
+    Reverse-mode only (custom_vjp — same contract as the reference's
+    autograd Function); forward-mode transforms (jvp/jacfwd) are not
+    supported through this loss.
     """
     loss, _ = _vp_ce_fwd(logits_local, target, label_smoothing, axis_name)
     return loss

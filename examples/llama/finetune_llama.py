@@ -73,10 +73,19 @@ def main():
     mesh = parallel_state.initialize_model_parallel(devices=jax.devices())
     print(f"devices={n_dev} vocab={cfg.vocab_size} layers={cfg.num_layers}")
 
-    from apex_tpu.optimizers import clip_grad_norm, distributed_fused_adam
+    from apex_tpu.optimizers import distributed_fused_adam
 
-    # ZeRO-2: optimizer state sharded 1/n_dev over the dp axis
-    opt = distributed_fused_adam(lr=args.lr, axis_name="dp", average_grads=False)
+    # ZeRO-2: optimizer state sharded 1/n_dev over the dp axis. The
+    # optimizer's psum_scatter IS the gradient sync (each rank feeds its
+    # LOCAL grads; average_grads=True completes the dp mean) and the
+    # global-norm clip runs on the sharded flat buffer — a separate
+    # pmean + clip_grad_norm before it would both waste a collective and,
+    # with average_grads=False, leave the reduce-scatter summing N
+    # already-averaged replicas (N x the intended gradient).
+    opt = distributed_fused_adam(
+        lr=args.lr, axis_name="dp", average_grads=True,
+        max_grad_norm=args.clip,
+    )
 
     key = jax.random.PRNGKey(0)
     global_batch = args.batch * n_dev
@@ -110,8 +119,6 @@ def main():
                 return jnp.mean(model.apply(p, tokens, labels=labels))
 
             loss, grads = jax.value_and_grad(loss_fn)(params)
-            grads = jax.lax.pmean(grads, "dp")
-            grads, _ = clip_grad_norm(grads, args.clip)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), jax.lax.pmean(loss, "dp")
