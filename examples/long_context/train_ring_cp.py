@@ -32,6 +32,8 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.parallel.ddp import all_reduce_gradients
+
 
 def parse_args():
     p = argparse.ArgumentParser(description="ring-CP long-context training")
@@ -118,17 +120,27 @@ def main():
         check_vma=False,
     )
     def train_step(params, opt_state, tokens, labels, kpm, loss_mask):
+        # global real-token count OUTSIDE the grad path (no grad flows
+        # through loss_mask, and a psum inside the differentiated loss
+        # would transpose into ANOTHER psum under check_vma=False —
+        # measured: each rank then gets cp x its own PARTIAL gradient,
+        # desyncing params across ranks)
+        n = jax.lax.psum(jnp.sum(loss_mask), "cp")
+
         def loss_fn(p):
             losses = model.apply(
                 p, tokens, labels=labels, key_padding_mask=kpm,
                 loss_mask=loss_mask,
             )
-            # mean over REAL tokens, globally: sum over cp shards
-            s = jax.lax.psum(jnp.sum(losses), "cp")
-            n = jax.lax.psum(jnp.sum(loss_mask), "cp")
-            return s / jnp.maximum(n, 1.0)
+            # LOCAL shard's contribution to the global token mean
+            return jnp.sum(losses) / jnp.maximum(n, 1.0)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
+        loss_local, grads = jax.value_and_grad(loss_fn)(params)
+        # the global gradient is the SUM of per-shard partials (each is
+        # d(global mean)/d(params) restricted to this rank's tokens), and
+        # summing keeps params bit-identical on every rank
+        grads = all_reduce_gradients(grads, "cp", gradient_average=False)
+        loss = jax.lax.psum(loss_local, "cp")
         updates, opt_state = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
