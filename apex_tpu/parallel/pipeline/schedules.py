@@ -455,14 +455,22 @@ def forward_backward_with_pre_post(
 
     (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
     # replicated pre/post params: combine the single contributing rank's
-    # grads onto every rank (tied-embedding allreduce semantics)
+    # grads onto every rank (tied-embedding allreduce semantics). Under
+    # CHECKED shard_map the grad-transpose already psummed these over
+    # axis_name (they type replicated), so another psum would multiply by
+    # P — same vma dispatch as parallel.ddp.all_reduce_gradients
+    from apex_tpu.parallel.ddp import grads_already_reduced, vma_tracking_live
+
+    tracking = vma_tracking_live(axis_name)
+
+    def _combine(g):
+        if grads_already_reduced(g, axis_name, tracking):
+            return g
+        return jax.lax.psum(g, axis_name)
+
     grads = dict(grads)
-    grads["pre"] = jax.tree_util.tree_map(
-        lambda g: jax.lax.psum(g, axis_name), grads["pre"]
-    )
-    grads["post"] = jax.tree_util.tree_map(
-        lambda g: jax.lax.psum(g, axis_name), grads["post"]
-    )
+    grads["pre"] = jax.tree_util.tree_map(_combine, grads["pre"])
+    grads["post"] = jax.tree_util.tree_map(_combine, grads["post"])
     if grad_sync_fn is not None:
         grads = grad_sync_fn(grads)
     return loss, losses, grads

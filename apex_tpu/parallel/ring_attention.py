@@ -162,6 +162,9 @@ def _online_chunk_update(state, q, kc, vc, scale, rows, cols, causal,
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
+    from apex_tpu.parallel.utils import promote_to_vma
+
+    state = promote_to_vma(state, rows)
 
     def block_step(carry, j):
         acc, m, l = carry
@@ -273,8 +276,15 @@ def _ring_fwd_res(q, k, v, kbias, axis_name, causal, scale, block_size,
     if num_ranks > 1:
         bias_carry = (kbias if kbias is not None
                       else _bias_placeholder(b, axis_name))
+        # in-scan ppermutes make every carried leaf axis-varying; promote
+        # the initial carry so its type is already the fixed point even
+        # when the caller's q/k/v arrive axis-replicated (per-leaf no-op
+        # when already varying / under check_vma=False)
+        from apex_tpu.parallel.utils import pvary_params
+
+        carry0 = pvary_params(((k, v, bias_carry), state), axis_name)
         ((_, _, _), state), _ = jax.lax.scan(
-            step, ((k, v, bias_carry), state), jnp.arange(1, num_ranks)
+            step, carry0, jnp.arange(1, num_ranks)
         )
     acc, m, l = state
     l = jnp.maximum(l, 1e-30)
@@ -293,6 +303,9 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
     s_kv = kc.shape[-2]
     bk = _chunk_block_size(s_kv, block_size)
     num_blocks = s_kv // bk
+    from apex_tpu.parallel.utils import promote_to_vma
+
+    dkc, dvc, dq = promote_to_vma((dkc, dvc, dq), rows)
 
     def block_step(carry, j):
         dkc, dvc, dq = carry
@@ -400,6 +413,9 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
                   else _bias_placeholder(b, axis_name))
     carry = ((k, v, bias_carry, dk0, dv0), dq)
     if num_ranks > 1:
+        from apex_tpu.parallel.utils import pvary_params
+
+        carry = pvary_params(carry, axis_name)  # see fwd: carry fixed point
         carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))
     (kc, vc, _, dk, dv), dq = carry
     # one homing rotation: after P-1 rotations the accumulators sit one rank

@@ -59,6 +59,27 @@ def pcast_varying(x, axis_names):
     return pcast(x, axis_names, to="varying")
 
 
+def promote_to_vma(tree, like):
+    """pcast each leaf of ``tree`` to ALSO vary over ``like``'s varying
+    axes — the scan-carry fixed-point helper: accumulators must start
+    with the vma their loop bodies will produce (ring attention's block
+    scans derive masks from rank positions, so outputs vary even when
+    inputs are replicated). No-op when already varying, under
+    ``check_vma=False``, or on pre-vma jax."""
+    try:
+        want = jax.typeof(like).vma
+    except AttributeError:
+        return tree
+    if not want:
+        return tree
+
+    def one(x):
+        missing = tuple(sorted(set(want) - set(jax.typeof(x).vma)))
+        return pcast_varying(x, missing) if missing else x
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def pvary_params(tree, axis_name: str = "tp"):
     """Type every leaf of a param pytree VARYING over ``axis_name``
     (leaves already varying pass through; numerics unchanged; no-op under

@@ -272,3 +272,74 @@ def test_vocab_parallel_ce_grads_match_dense(check_vma, smoothing):
     np.testing.assert_allclose(np.asarray(g), np.asarray(dg),
                                rtol=1e-5, atol=1e-6)
     parallel_state.destroy_model_parallel()
+
+
+def test_fwd_bwd_pre_post_checked_matches_unchecked():
+    """forward_backward_with_pre_post's replicated pre/post grad combine
+    must not double-psum under checked vma (the grad transpose already
+    summed them over pp; the explicit tied-embedding psum now dispatches
+    on the vma type). Loss AND grads must match the unchecked run."""
+    from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.parallel.pipeline import forward_backward_with_pre_post
+    from apex_tpu.transformer import TransformerConfig
+
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size=2,
+    )
+    vocab, seq, hidden, mb, num_micro = 64, 16, 32, 2, 2
+    cfg = TransformerConfig(
+        num_layers=2, hidden_size=hidden, num_attention_heads=4,
+        vocab_size=vocab, max_position_embeddings=seq,
+        hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.float32,
+    )
+    parts = build_gpt_pipeline(cfg, 2)
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (num_micro, mb, seq), 0, vocab)
+    labels = jnp.roll(tokens, -1, axis=2)
+
+    def run(check_vma):
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=(P(), P()),
+            out_specs=(P(), P(), P()), check_vma=check_vma,
+        )
+        def step(tokens, labels):
+            init_key = jax.random.PRNGKey(0)
+            pre = parts.embed.init(init_key, tokens[0])["params"]
+            h0 = parts.pre_fn(pre, tokens[0])
+            r = jax.lax.axis_index("pp")
+            stage = parts.chunk.init(
+                jax.random.fold_in(jax.random.fold_in(init_key, 7), r), h0
+            )["params"]
+            params = {"pre": pre, "stages": stage,
+                      "post": parts.init_post(jax.random.fold_in(init_key, 9))}
+            loss, _, grads = forward_backward_with_pre_post(
+                parts.pre_fn, parts.stage_fn, parts.post_loss_fn, params,
+                tokens, labels, axis_name="pp",
+            )
+            pre_norm = sum(
+                jnp.sum(jnp.abs(g))
+                for g in jax.tree_util.tree_leaves(grads["pre"])
+            )
+            post_norm = sum(
+                jnp.sum(jnp.abs(g))
+                for g in jax.tree_util.tree_leaves(grads["post"])
+            )
+            def rep(x):
+                for ax in ("dp", "pp", "cp", "tp"):
+                    try:
+                        if ax in jax.typeof(x).vma:
+                            x = jax.lax.pmean(x, ax)
+                    except AttributeError:
+                        break
+                return x
+            return rep(loss), rep(pre_norm), rep(post_norm)
+
+        return [float(v) for v in step(tokens, labels)]
+
+    got = run(True)
+    want = run(False)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    parallel_state.destroy_model_parallel()
