@@ -34,6 +34,8 @@ import pytest  # noqa: E402
 #   fast:  python -m pytest tests/ -q -m "not slow" -n 4
 #   full:  python -m pytest tests/ -q
 _SLOW_TESTS = {
+    "test_fwd_bwd_pre_post_checked_matches_unchecked",
+    "test_gpt_pp_tp_sp_full_step_checked",
     "test_amp_mlp_example",
     "test_imagenet_example",
     "test_long_context_ring_cp_example",
