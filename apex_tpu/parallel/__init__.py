@@ -33,7 +33,9 @@ from apex_tpu.parallel.ring_attention import (
 from apex_tpu.parallel.utils import (
     VocabUtility,
     broadcast_data,
+    promote_to_vma,
     pvary_params,
+    scan_carry_fixed_point,
     split_tensor_along_last_dim,
 )
 
@@ -60,6 +62,8 @@ __all__ = [
     "zigzag_unshard",
     "VocabUtility",
     "broadcast_data",
+    "promote_to_vma",
     "pvary_params",
+    "scan_carry_fixed_point",
     "split_tensor_along_last_dim",
 ]

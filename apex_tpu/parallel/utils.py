@@ -59,6 +59,12 @@ def pcast_varying(x, axis_names):
     return pcast(x, axis_names, to="varying")
 
 
+def _widen_leaf(x, want):
+    """pcast ``x`` to also vary over the axes in ``want`` it lacks."""
+    missing = tuple(sorted(set(want) - set(jax.typeof(x).vma)))
+    return pcast_varying(x, missing) if missing else x
+
+
 def promote_to_vma(tree, like):
     """pcast each leaf of ``tree`` to ALSO vary over ``like``'s varying
     axes — the scan-carry fixed-point helper: accumulators must start
@@ -73,11 +79,7 @@ def promote_to_vma(tree, like):
     if not want:
         return tree
 
-    def one(x):
-        missing = tuple(sorted(set(want) - set(jax.typeof(x).vma)))
-        return pcast_varying(x, missing) if missing else x
-
-    return jax.tree_util.tree_map(one, tree)
+    return jax.tree_util.tree_map(lambda x: _widen_leaf(x, want), tree)
 
 
 def pvary_params(tree, axis_name: str = "tp"):
@@ -113,3 +115,52 @@ def pvary_params(tree, axis_name: str = "tp"):
         return pcast_varying(x, axis_name)
 
     return jax.tree_util.tree_map(one, tree)
+
+
+def scan_carry_fixed_point(body, carry, x0, max_iters: int = 3):
+    """Promote ``carry``'s leaves to the vma fixed point of ``body`` so
+    ``jax.lax.scan(body, carry, xs)`` typechecks under checked shard_map.
+
+    A training-loop carry routinely starts with narrower varying axes
+    than the body produces (optimizer moments init as replicated zeros
+    while their updates inherit the grads' varying axes), and checked
+    scan requires carry-in type == carry-out type. This evaluates the
+    body's output carry type via ``jax.eval_shape`` (trace only — no
+    compute), widens the carry with ``pcast`` where needed, and repeats
+    until stable (vma sets only grow toward the mesh's axis set, so this
+    terminates; one round suffices in practice).
+
+    ``x0``: one slice of the scan xs (e.g. ``tree_map(lambda a: a[0],
+    xs)``); pass ``None`` for a None-xs scan. No-op under
+    ``check_vma=False`` / pre-vma jax. Returns the promoted carry.
+    """
+
+    def _vma(x):
+        try:
+            return jax.typeof(x).vma
+        except AttributeError:
+            return None
+
+    changed = False
+    for _ in range(max_iters):
+        out_carry = jax.eval_shape(lambda c: body(c, x0)[0], carry)
+        changed = False
+
+        def widen(c, o):
+            nonlocal changed
+            have, want = _vma(c), getattr(o, "vma", None)
+            if have is None or not want or not (set(want) - set(have)):
+                return c
+            changed = True
+            return _widen_leaf(c, want)
+
+        carry = jax.tree_util.tree_map(widen, carry, out_carry)
+        if not changed:
+            break
+    if changed:
+        raise ValueError(
+            "scan_carry_fixed_point did not converge within "
+            f"max_iters={max_iters} widening rounds; raise max_iters "
+            "(vma sets only grow toward the mesh axis count)"
+        )
+    return carry

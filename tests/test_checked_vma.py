@@ -343,3 +343,36 @@ def test_fwd_bwd_pre_post_checked_matches_unchecked():
     want = run(False)
     np.testing.assert_allclose(got, want, rtol=1e-6)
     parallel_state.destroy_model_parallel()
+
+
+def test_scan_carry_fixed_point_promotes_to_body_type():
+    """A scan whose body widens the carry's varying axes (adding an
+    axis-varying term to a replicated-zeros accumulator) fails checked
+    scan's carry typecheck; scan_carry_fixed_point promotes the initial
+    carry to the body's vma fixed point and the result matches the
+    direct computation."""
+    from apex_tpu.parallel import scan_carry_fixed_point
+
+    mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+    x = jnp.arange(8.0)
+
+    def run(warm):
+        @jax.jit
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P()
+        )
+        def f(x):
+            def body(c, _):
+                return c + jnp.sum(x), None  # x is dp-varying; c starts not
+
+            c0 = jnp.zeros(())
+            if warm:
+                c0 = scan_carry_fixed_point(body, c0, None)
+            out, _ = jax.lax.scan(body, c0, None, length=3)
+            return jax.lax.pmean(out, "dp")
+
+        return float(f(x))
+
+    with pytest.raises(TypeError, match="carry"):
+        run(warm=False)
+    np.testing.assert_allclose(run(warm=True), 3 * float(jnp.mean(x)))
