@@ -10,16 +10,19 @@ only hardware window before the headline ran):
 1. headline RN50 amp-O2 imgs/sec (bench.py's measurement, in-process) —
    the BASELINE metric; the O2 record is emitted the moment it exists,
    before the O0 baseline is attempted.
-2. compiled Pallas kernel smoke (numerics on hardware, fwd+bwd)
+2. compiled Pallas kernel smoke (numerics on hardware, fwd+bwd; resumes
+   from the sidecar across windows)
 3. fused-engine micro-benchmarks (flat-vs-tree Adam, Pallas-vs-XLA LN/attn)
-4. BASELINE configs 2-5 (full TPU shapes)
-5. headline operating-point sweep (RN50 amp-O2 at batch 384/512)
+4. headline step-time decomposition (profile) + same-window O2/O0 pair
+5. BASELINE configs 2-5 (full TPU shapes)
+6. headline operating-point sweep (RN50 amp-O2 at batch 384/512)
 
-Record semantics: ``ok: true`` means the section RAN TO COMPLETION, not
-that its measurements are valid — a relay-down window produces ok:true
-records whose every item is an embedded error (harvest.py's
-``_poisoned``/``incomplete`` logic decides what retries; BENCH.md only
-ever cites successful item payloads).
+Record semantics (round 5, VERDICT r4 weak #2): ``ok: true`` means the
+section PRODUCED AT LEAST ONE MEASUREMENT (``measured_n``); the separate
+``completed`` flag means the harness ran to the end without crashing.  A
+dead relay is detected by a seconds-cheap liveness probe (``relay_alive``)
+before every section and between items, so a relay-down window costs ~0
+instead of the 3.4 h it burned on 2026-07-31.
 
 Every section runs under a hard per-section wall-clock budget enforced
 INTERNALLY (deadline checks between items / span escalations — an in-flight
@@ -54,8 +57,41 @@ BUDGETS = {
     "smoke": int(os.environ.get("APEX_TPU_SMOKE_BUDGET", "1500")),
     "micro": int(os.environ.get("APEX_TPU_MICRO_BUDGET", "2400")),
     "configs": int(os.environ.get("APEX_TPU_CONFIGS_BUDGET", "3600")),
+    "pair": int(os.environ.get("APEX_TPU_PAIR_BUDGET", "1500")),
+    "profile": int(os.environ.get("APEX_TPU_PROFILE_BUDGET", "2000")),
     "sweep": int(os.environ.get("APEX_TPU_SWEEP_BUDGET", "900")),
 }
+
+# Sticky relay-liveness verdict for this capture attempt.  A dead relay
+# stays dead on the minutes scale of one attempt; harvest.py re-probes
+# before launching the next one.
+_RELAY_STATE = {"dead": False}
+
+
+def relay_alive(recheck=False):
+    """Seconds-cheap relay liveness probe (VERDICT r4 weak #1): one tiny
+    jitted add + fetch.  On 2026-07-31 the smoke/micro/configs sections
+    burned ~3.4 h retrying ``Connection refused`` at full budget; this
+    probe converts a dead relay into an instant skip.  Only
+    relay-INFRASTRUCTURE failures flip the verdict — any other exception
+    (or a healthy fetch) reports alive.  A relay that HANGS (rather than
+    refuses) hangs this probe too; that mode is unkillable mid-claim and
+    no cheap check can help it."""
+    if _RELAY_STATE["dead"] and not recheck:
+        return False
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        v = jax.jit(lambda x: x + 1.0)(jnp.zeros((8,), jnp.float32))
+        float(v[0])  # force the fetch through the relay
+        _RELAY_STATE["dead"] = False
+        return True
+    except Exception as e:
+        if transient_error(e):
+            _RELAY_STATE["dead"] = True
+            return False
+        return True
 
 
 def enable_compilation_cache():
@@ -77,15 +113,39 @@ def emit(out_path, record):
 
 
 def section(out_path, name, fn):
+    """Run one section under its budget and emit its record.
+
+    Record semantics (VERDICT r4 weak #2 — the 06:40:14 configs record
+    said ``ok: true`` with zero configs measured): ``ok`` now strictly
+    means "produced at least one measurement" (sections report
+    ``measured_n``), and the NEW ``completed`` flag carries the old
+    meaning ("the harness ran to the end without crashing").
+    harvest.results_state retries on ``completed: false`` / ``incomplete``
+    and treats a completed all-deterministic-failure section as a
+    captured answer even when ``ok`` is false."""
     t0 = time.time()
     deadline = time.monotonic() + BUDGETS.get(name, 1800)
+    if not relay_alive():
+        emit(out_path, {
+            "section": name, "ok": False, "completed": False,
+            "relay_dead": True,
+            "elapsed_s": round(time.time() - t0, 1),
+            "error": "relay dead: liveness probe failed; section skipped",
+        })
+        return
     try:
         payload = fn(deadline)
-        emit(out_path, {"section": name, "ok": True,
-                        "elapsed_s": round(time.time() - t0, 1), **payload})
+        measured_n = payload.pop("measured_n", None)
+        rec = {"section": name,
+               "ok": True if measured_n is None else measured_n > 0,
+               "completed": True,
+               "elapsed_s": round(time.time() - t0, 1), **payload}
+        if measured_n is not None:
+            rec["measured_n"] = measured_n
+        emit(out_path, rec)
     except Exception:
         emit(out_path, {
-            "section": name, "ok": False,
+            "section": name, "ok": False, "completed": False,
             "elapsed_s": round(time.time() - t0, 1),
             "error": traceback.format_exc()[-1500:],
         })
@@ -95,6 +155,101 @@ def section(out_path, name, fn):
 # the implementation lives in bench.py (shared with the live --run path,
 # which reuses fresh halves the same way a capture retry does)
 from bench import fresh_subrecord  # noqa: E402
+
+
+def fresh_failure(out_path, section_name, max_age_h=None):
+    """Newest fresh ``ok: false / completed: true`` sub-record of
+    ``section_name`` — a DETERMINISTIC failure captured by an earlier
+    window.  The mirror of ``fresh_subrecord`` for the other kind of
+    captured answer; same freshness gate."""
+    from bench import ts_epoch
+
+    if max_age_h is None:
+        max_age_h = float(os.environ.get("APEX_TPU_REPLAY_MAX_AGE_H", "24"))
+    if not os.path.exists(out_path):
+        return None
+    best = None
+    with open(out_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if (rec.get("section") == section_name and not rec.get("ok")
+                    and rec.get("completed") and rec.get("error")):
+                best = rec  # append-ordered: last is newest
+    if best is None:
+        return None
+    age = time.time() - ts_epoch(best)
+    return best if 0 <= age <= max_age_h * 3600 else None
+
+
+def run_items(items, deadline, out_path, prefix, min_slice=60):
+    """One implementation of the per-item capture contract shared by
+    micro/configs/profile/sweep (round-5 review: four hand copies had
+    already drifted — different budget floors, configs missing the
+    equal-slice deadline entirely):
+
+    - a fresh ``{prefix}_{name}`` sub-record from an earlier window is
+      REUSED, never re-bought (the headline halves' protocol);
+    - a dead relay (seconds-cheap probe) skips instantly;
+    - each live item gets an equal slice of the remaining budget so one
+      runaway measurement can't strand the rest (r3: bench_adam alone ran
+      12,671 s);
+    - every measurement is emitted as a sub-record the moment it lands;
+    - budget/relay failures mark the item ``incomplete`` (retry next
+      window); any other exception is a captured deterministic answer.
+
+    ``items``: (name, fn) or (name, fn, extra) tuples — ``fn(deadline)``
+    returns a JSON-serializable value, ``extra`` is folded into the
+    emitted sub-record (units, batch sizes).  Returns
+    ``(results, measured_n, incomplete)``.
+    """
+    results = {}
+    measured = 0
+    incomplete = []
+    for i, item in enumerate(items):
+        name, fn = item[0], item[1]
+        extra = item[2] if len(item) > 2 else {}
+        prior = fresh_subrecord(out_path, f"{prefix}_{name}")
+        if prior is not None:
+            results[name] = prior["value"]
+            measured += 1
+            continue
+        prior_fail = fresh_failure(out_path, f"{prefix}_{name}")
+        if prior_fail is not None:
+            # a deterministic failure is a captured answer too (the
+            # smoke-rc=1 principle at item granularity): re-running it
+            # every retry window re-buys its equal budget slice
+            results[name] = prior_fail["error"]
+            continue
+        # budget first: an exhausted item must skip for free even when the
+        # relay probe would hang (review r5: the probe ran first)
+        remaining = deadline - time.monotonic()
+        if remaining <= min_slice:
+            results[name] = "skipped: section budget exhausted"
+            incomplete.append(name)
+            continue
+        if not relay_alive():
+            results[name] = "skipped: relay dead"
+            incomplete.append(name)
+            continue
+        item_deadline = time.monotonic() + remaining / (len(items) - i)
+        try:
+            results[name] = fn(item_deadline)
+            measured += 1
+            emit(out_path, {"section": f"{prefix}_{name}", "ok": True,
+                            "completed": True, "value": results[name],
+                            **extra})
+        except Exception as e:
+            results[name] = f"error: {e}"[:500]
+            if transient_error(e):
+                incomplete.append(name)
+            else:
+                emit(out_path, {"section": f"{prefix}_{name}", "ok": False,
+                                "completed": True,
+                                "error": results[name], **extra})
+    return results, measured, incomplete
 
 
 def transient_error(e) -> bool:
@@ -148,6 +303,9 @@ def run_headline(deadline, out_path):
         rec["o0_value"] = float(prior_o0["value"])
         rec["o0_reused_from_ts"] = prior_o0.get("ts")
         rec["vs_baseline"] = round(o2 / float(prior_o0["value"]), 3)
+    elif not relay_alive():
+        rec["vs_baseline"] = None
+        rec["note"] = "relay dead before O0 baseline"
     elif time.monotonic() < deadline:
         try:
             o0 = measure(jnp.float32, 256, 224, deadline=deadline)
@@ -166,6 +324,7 @@ def run_headline(deadline, out_path):
     else:
         rec["vs_baseline"] = None
         rec["note"] = "budget exhausted before O0 baseline"
+    rec["measured_n"] = 1 + ("o0_value" in rec)
     return rec
 
 
@@ -183,36 +342,50 @@ def run_smoke(deadline):
     if tpu_kernel_smoke.PROGRESS_PATH is None:
         tpu_kernel_smoke.PROGRESS_PATH = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "tpu_smoke_progress.log")
-    # run-start delimiter: attempts append to one file, and a reader
-    # recovering evidence after a hang must not attribute a prior
-    # attempt's passes to this run
-    tpu_kernel_smoke._emit(f"=== smoke attempt start (pid {os.getpid()}) ===")
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
         rc = tpu_kernel_smoke.main(deadline=deadline)
     lines = [l for l in buf.getvalue().splitlines()
              if l.startswith(("ok", "FAIL", "SKIP", "ALL", "backend"))]
-    return {"rc": rc, "lines": lines,
-            "progress_log": tpu_kernel_smoke.PROGRESS_PATH}
+    rec = {"rc": rc, "lines": lines,
+           "progress_log": tpu_kernel_smoke.PROGRESS_PATH,
+           "measured_n": sum(l.startswith(("ok", "FAIL")) for l in lines)}
+    if rc == 2:
+        # budget / relay died mid-run: checks validated so far are on the
+        # sidecar (and reused next attempt), but the section must retry
+        rec["incomplete"] = ["smoke"]
+    return rec
 
 
-def run_micro(deadline):
+def run_micro(deadline, out_path):
     import jax
 
     import bench_optimizers as bo
 
     key = jax.random.PRNGKey(0)
-    tree = bo.make_param_tree(30_000_000, key)
-    grads = jax.tree_util.tree_map(
-        lambda x: jax.random.normal(jax.random.fold_in(key, 99), x.shape, x.dtype) * 1e-3,
-        tree,
-    )
-    rec = {}
-    # Each item gets an equal slice of what remains, so one runaway
-    # measurement can't strand the others (r3: bench_adam alone ran 12,671 s).
+    rec = {"measured_n": 0}
+
+    def make_inputs():
+        tree = bo.make_param_tree(30_000_000, key)
+        grads = jax.tree_util.tree_map(
+            lambda x: jax.random.normal(
+                jax.random.fold_in(key, 99), x.shape, x.dtype) * 1e-3,
+            tree,
+        )
+        return tree, grads
+
+    # lazy: if every tree-consuming item is reused from a prior window,
+    # the 30M-param tree is never materialized through the relay
+    _cache = {}
+
+    def inputs():
+        if "tree" not in _cache:
+            _cache["tree"], _cache["grads"] = make_inputs()
+        return _cache["tree"], _cache["grads"]
+
     items = [
-        ("adam_step_s", lambda d: bo.bench_adam(tree, grads, deadline=d)),
-        ("l2norm_s", lambda d: bo.bench_l2norm(tree, grads, deadline=d)),
+        ("adam_step_s", lambda d: bo.bench_adam(*inputs(), deadline=d)),
+        ("l2norm_s", lambda d: bo.bench_l2norm(*inputs(), deadline=d)),
         ("layer_norm_s", lambda d: bo.bench_layer_norm(
             8192, 4096, jax.random.fold_in(key, 7), deadline=d)),
         ("attention_s", lambda d: bo.bench_attention(
@@ -223,48 +396,117 @@ def run_micro(deadline):
         ("small_shapes", lambda d: __import__("bench_small_shapes").run_all(
             jax.random.fold_in(key, 10), deadline=d)),
     ]
-    incomplete = []
-    for i, (name, fn) in enumerate(items):
-        remaining = deadline - time.monotonic()
-        if remaining <= 30:
-            rec[name] = "skipped: section budget exhausted"
-            incomplete.append(name)
-            continue
-        item_deadline = time.monotonic() + remaining / (len(items) - i)
-        try:
-            rec[name] = fn(item_deadline)
-        except Exception as e:
-            rec[name] = f"error: {e}"
-            # budget/relay-infra failures retry in a later window; any
-            # other raised measurement is a captured (deterministic)
-            # answer — smoke's rc=1-counts-as-captured reasoning
-            if transient_error(e):
-                incomplete.append(name)
+    results, measured, incomplete = run_items(
+        items, deadline, out_path, "micro", min_slice=30)
+    rec.update(results)
+    rec["measured_n"] = measured
     if incomplete:
         # harvest.py retries sections whose record carries `incomplete`
         rec["incomplete"] = incomplete
     return rec
 
 
-def run_configs(deadline):
+def run_configs(deadline, out_path):
     import bench_configs as bc
 
-    out = {}
-    incomplete = []
-    for name in ("mlp", "bert", "dp", "gpt", "llama", "decode"):
-        if time.monotonic() > deadline:
-            out[name] = {"skipped": "section budget exhausted"}
-            incomplete.append(name)
-            continue
-        t0 = time.time()
-        try:
-            out[name] = bc.CONFIGS[name](tpu=True)
-        except Exception as e:
-            out[name] = {"error": str(e)[-500:]}
-            if transient_error(e):  # see transient_error
-                incomplete.append(name)
-        out[name]["elapsed_s"] = round(time.time() - t0, 1)
-    rec = {"configs": out}
+    def cfg_fn(name):
+        def f(_deadline):
+            # bench_configs functions self-limit their steps; the helper's
+            # equal-slice deadline still bounds what a retry re-attempts
+            t0 = time.time()
+            out = bc.CONFIGS[name](tpu=True)
+            out["elapsed_s"] = round(time.time() - t0, 1)
+            return out
+
+        return f
+
+    # gpt (BASELINE config 5) and bert (config 4) lead: the transformer
+    # stack has zero hardware perf evidence after four rounds (VERDICT r4
+    # missing #3 names them the priority pair)
+    names = ("gpt", "bert", "mlp", "dp", "llama", "decode")
+    results, measured, incomplete = run_items(
+        [(n, cfg_fn(n)) for n in names], deadline, out_path, "config")
+    rec = {"configs": results, "measured_n": measured}
+    if incomplete:
+        rec["incomplete"] = incomplete
+    return rec
+
+
+def run_pair(deadline, out_path):
+    """Same-window O2+O0 headline pair (VERDICT r4 missing #5): both halves
+    measured FRESH in one relay window, no sub-record reuse — the round-4
+    1.99x ratio pairs halves captured two hours apart; one same-window pair
+    retires the residual doubt with the reference's own one-session
+    methodology (/root/reference/tests/L1/common/run_test.sh:20-49).
+    Compiles are cheap here: the programs are byte-identical to the
+    headline's, so the persistent cache already holds them."""
+    import jax.numpy as jnp
+
+    from bench import measure
+
+    rec = {"measured_n": 0}
+    half = (deadline - time.monotonic()) / 2 + time.monotonic()
+    o2 = measure(jnp.bfloat16, 256, 224, deadline=half)
+    rec["o2_imgs_per_sec"] = round(o2, 2)
+    rec["measured_n"] = 1
+    emit(out_path, {"section": "pair_o2", "ok": True, "completed": True,
+                    "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+                    "value": round(o2, 2), "unit": "imgs/sec/chip"})
+    if not relay_alive():
+        rec["incomplete"] = ["o0"]
+        rec["note"] = "relay dead before same-window O0"
+        return rec
+    try:
+        o0 = measure(jnp.float32, 256, 224, deadline=deadline)
+        rec["o0_imgs_per_sec"] = round(o0, 2)
+        rec["vs_baseline_same_window"] = round(o2 / o0, 3)
+        rec["measured_n"] = 2
+        emit(out_path, {"section": "pair_o0", "ok": True, "completed": True,
+                        "metric": "rn50_train_imgs_per_sec_per_chip_O0",
+                        "value": round(o0, 2), "unit": "imgs/sec/chip"})
+    except Exception as e:
+        rec["note"] = f"same-window O0 failed: {e!r}"[:400]
+        if transient_error(e):
+            rec["incomplete"] = ["o0"]
+    return rec
+
+
+def run_profile(deadline, out_path):
+    """Step-time decomposition of the headline RN50 amp-O2 step (VERDICT r4
+    weak #3: 2626 imgs/s is ~16% of v5e bf16 peak and nobody knows where
+    the rest goes).  Slope-times the forward-only, forward+backward, and
+    full-step chains at the headline operating point; the derived breakdown
+    (bwd = fwd_bwd - fwd, optimizer+BN-stat+update = step - fwd_bwd) and
+    achieved-FLOPs arithmetic go to BENCH.md.  Sub-records accumulate
+    across windows (the headline halves' protocol)."""
+    import jax.numpy as jnp
+
+    from bench import measure
+
+    def mode_fn(mode):
+        def f(item_deadline):
+            imgs_per_sec = measure(jnp.bfloat16, 256, 224,
+                                   deadline=item_deadline, mode=mode)
+            return round(256.0 / imgs_per_sec, 5)
+
+        return f
+
+    modes = ("fwd", "fwd_bwd", "step")
+    results, measured, incomplete = run_items(
+        [(m, mode_fn(m), {"unit": "s/step", "batch": 256}) for m in modes],
+        deadline, out_path, "profile")
+    rec = {"measured_n": measured}
+    for m in modes:
+        v = results[m]
+        rec[f"{m}_s_per_step"] = float(v) if isinstance(v, (int, float)) else v
+    vals = {m: rec.get(f"{m}_s_per_step") for m in modes}
+    if all(isinstance(v, float) for v in vals.values()):
+        rec["breakdown_ms"] = {
+            "fwd": round(vals["fwd"] * 1e3, 2),
+            "bwd": round((vals["fwd_bwd"] - vals["fwd"]) * 1e3, 2),
+            "optimizer_and_stats": round((vals["step"] - vals["fwd_bwd"]) * 1e3, 2),
+            "step": round(vals["step"] * 1e3, 2),
+        }
     if incomplete:
         rec["incomplete"] = incomplete
     return rec
@@ -285,37 +527,29 @@ def run_sweep(deadline, out_path):
 
     from bench import measure
 
-    rec = {}
-    incomplete = []
+    def batch_fn(batch):
+        def f(item_deadline):
+            return round(
+                measure(jnp.bfloat16, batch, 224, deadline=item_deadline), 2)
+
+        return f
+
     batches = (384, 512)
-    for i, batch in enumerate(batches):
-        name = f"rn50_ampO2_b{batch}"
-        prior = fresh_subrecord(out_path, f"sweep_b{batch}")
-        if prior is not None:
-            rec[name] = {"imgs_per_sec_per_chip": float(prior["value"]),
-                         "reused_from_ts": prior.get("ts")}
-            continue
-        remaining = deadline - time.monotonic()
-        if remaining <= 60:
-            rec[name] = "skipped: section budget exhausted"
-            incomplete.append(name)
-            continue
-        # equal slice of what remains (run_micro's pattern): one runaway
-        # measurement must not starve the other batch every window
-        item_deadline = time.monotonic() + remaining / (len(batches) - i)
-        try:
-            v = measure(jnp.bfloat16, batch, 224, deadline=item_deadline)
-            emit(out_path, {"section": f"sweep_b{batch}", "ok": True,
-                            "metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
-                            "value": round(v, 2), "unit": "imgs/sec/chip",
-                            "batch": batch})
-            rec[name] = {"imgs_per_sec_per_chip": round(v, 2)}
-        except Exception as e:
-            rec[name] = f"error: {e}"[:400]
-            if transient_error(e):
-                incomplete.append(name)
+    results, measured, incomplete = run_items(
+        [(f"b{batch}", batch_fn(batch),
+          {"metric": "rn50_train_imgs_per_sec_per_chip_ampO2",
+           "unit": "imgs/sec/chip", "batch": batch})
+         for batch in batches],
+        deadline, out_path, "sweep")
+    rec = {"measured_n": measured}
+    for batch in batches:
+        v = results[f"b{batch}"]
+        rec[f"rn50_ampO2_b{batch}"] = (
+            {"imgs_per_sec_per_chip": float(v)}
+            if isinstance(v, (int, float)) else v
+        )
     if incomplete:
-        rec["incomplete"] = incomplete
+        rec["incomplete"] = [f"rn50_ampO2_{n}" for n in incomplete]
     return rec
 
 
@@ -327,27 +561,29 @@ def main():
     skip = set(args.skip.split(",")) if args.skip else set()
 
     enable_compilation_cache()
+    import functools
+
     import jax
 
     dev = jax.devices()[0]
     emit(args.out, {"section": "init", "ok": True,
                     "platform": dev.platform, "device_kind": dev.device_kind})
-    if "headline" not in skip:
-        import functools
-
-        section(args.out, "headline",
-                functools.partial(run_headline, out_path=args.out))
-    if "smoke" not in skip:
-        section(args.out, "smoke", run_smoke)
-    if "micro" not in skip:
-        section(args.out, "micro", run_micro)
-    if "configs" not in skip:
-        section(args.out, "configs", run_configs)
-    if "sweep" not in skip:
-        import functools
-
-        section(args.out, "sweep",
-                functools.partial(run_sweep, out_path=args.out))
+    # Order = VERDICT r4 "next round" ranking: headline (cheap when its
+    # halves are fresh) -> smoke (closes the three remaining partials) ->
+    # micro (FusedAdam TPU default + small-shape decision) -> profile +
+    # pair (headline utilization story) -> configs -> sweep.
+    runners = [
+        ("headline", functools.partial(run_headline, out_path=args.out)),
+        ("smoke", run_smoke),
+        ("micro", functools.partial(run_micro, out_path=args.out)),
+        ("profile", functools.partial(run_profile, out_path=args.out)),
+        ("pair", functools.partial(run_pair, out_path=args.out)),
+        ("configs", functools.partial(run_configs, out_path=args.out)),
+        ("sweep", functools.partial(run_sweep, out_path=args.out)),
+    ]
+    for name, fn in runners:
+        if name not in skip:
+            section(args.out, name, fn)
     emit(args.out, {"section": "done", "ok": True})
 
 

@@ -6,13 +6,26 @@ CPU interpret mode (VERDICT r2 weak #3).  This harness force-dispatches
 checks numerics against the XLA reference implementation for fwd AND bwd of
 each kernel.  Exits non-zero on the first mismatch or Mosaic lowering error.
 
+Round-5 structure (VERDICT r4 missing #1: two windows died mid-smoke and
+took the verdicts with them): every check is an independently named thunk.
+Each verdict streams to the sidecar the moment it exists, and a new attempt
+SKIPS checks a prior attempt already validated — provided the kernel
+sources are byte-identical (source fingerprint in the attempt header; git
+HEAD would discard evidence on unrelated commits).  A relay-infrastructure
+failure mid-check ends the attempt with rc=2 (retry) instead of poisoning
+the record; everything validated so far is already on disk.
+
 Run: python benchmarks/tpu_kernel_smoke.py
 """
 
+import hashlib
 import os
+import re
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import jax
 import jax.numpy as jnp
@@ -29,12 +42,68 @@ def _emit(line):
     print(line, flush=True)
     if PROGRESS_PATH:
         try:
-            import time
-
             with open(PROGRESS_PATH, "a") as f:
                 f.write(f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {line}\n")
         except OSError:
             pass
+
+
+def source_fingerprint():
+    """Hash of the kernel sources this smoke validates.  Sidecar verdicts
+    from prior attempts are reused only under an identical fingerprint, so
+    a kernel edit invalidates exactly the evidence it should."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(root, "apex_tpu", "ops", "*.py")))
+    paths.append(os.path.join(root, "apex_tpu", "optimizers", "_fused_kernels.py"))
+    paths.append(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for p in paths:
+        try:
+            with open(p, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"<missing>")
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def prior_ok_checks(progress_path, fp):
+    """Check names already validated ``ok`` by a prior attempt with the
+    same source fingerprint — these are skipped, not re-bought: relay
+    windows are minutes long and the LN family alone is 16 compiles."""
+    names = set()
+    if not progress_path or not os.path.exists(progress_path):
+        return names
+    current_fp = None
+    try:
+        with open(progress_path) as f:
+            for line in f:
+                if "=== smoke attempt start" in line:
+                    m = re.search(r"fp=([0-9a-f]+)", line)
+                    current_fp = m.group(1) if m else None
+                    continue
+                if current_fp != fp:
+                    continue
+                # line: '<ts> ok   <name>[ (prior)]'  /  '<ts> FAIL <name>: ...'
+                parts = line.rstrip("\n").split(None, 1)
+                if len(parts) != 2:
+                    continue
+                if parts[1].startswith("ok   "):
+                    name = parts[1][5:].strip()
+                    if name.endswith(" (prior)"):
+                        name = name[: -len(" (prior)")]
+                    names.add(name)
+                elif parts[1].startswith("FAIL "):
+                    # a LATER failure under the same sources invalidates an
+                    # earlier ok (flaky compile, autotuning drift): the check
+                    # must re-run, not be skipped as clean forever
+                    name = parts[1][5:].split(":", 1)[0].strip()
+                    names.discard(name)
+    except OSError:
+        pass
+    return names
 
 
 def check(name, got, want, tol):
@@ -52,109 +121,127 @@ def check(name, got, want, tol):
     return True
 
 
-def main(deadline=None):
-    """Run every kernel smoke; ``deadline`` (time.monotonic value) stops
-    BETWEEN kernel families so a flaky relay can't strand the harness —
-    skipped families are reported, not silently dropped.
+def _transient(e):
+    from harvest import _transient_text
 
-    Return codes: 0 = all checked kernels OK; 1 = a numerics/lowering
-    FAILURE (deterministic — retrying wastes a relay window); 2 = budget
-    ran out with everything checked so far OK (worth retrying)."""
-    import time
+    return _transient_text(str(e))
 
-    def out_of_time(where):
-        if deadline is not None and time.monotonic() > deadline:
-            _emit(f"SKIP remaining (budget exhausted before {where})")
-            return True
-        return False
 
-    dev = jax.devices()[0]
-    _emit(f"backend: {dev.platform} / {dev.device_kind}")
-    ok = True
+def build_checks():
+    """Yield (name, thunk) pairs.  Inputs are built inside each thunk so a
+    skipped check costs zero relay traffic."""
     key = jax.random.PRNGKey(0)
 
     # ---- layer norm / rms norm fwd+bwd ----
-    from apex_tpu.ops import layer_norm, rms_norm
-
     # Shapes cover both measured v5e failure modes: (512, 1024) runs the bwd
     # dgamma/dbeta accumulation at grid>1 (block_rows=256 -> 2 grid steps;
     # a per-step partials layout was rejected by Mosaic's 8-sublane rule),
     # and (1024, 4096) is the shape whose fp32 temporaries blew the 16MB
     # scoped-vmem limit before _pick_block_rows budgeted 1MB/operand.
     # bf16 at 4096 covers VERDICT r3 item 2: grid>1 + wide hidden + bf16.
+    from apex_tpu.ops import layer_norm, rms_norm
+
+    def ln_inputs(rows, hidden, dtype):
+        x = jax.random.normal(key, (rows, hidden), jnp.float32).astype(dtype)
+        w = (jax.random.normal(jax.random.fold_in(key, 1), (hidden,)) * 0.1 + 1.0).astype(dtype)
+        b = (jax.random.normal(jax.random.fold_in(key, 2), (hidden,)) * 0.1).astype(dtype)
+        return x, w, b
+
     for rows, hidden, dtype, ftol, btol in [
         (512, 1024, jnp.float32, 2e-5, 2e-4),
         (1024, 4096, jnp.float32, 2e-5, 2e-3),
         (512, 1024, jnp.bfloat16, 2e-2, 2e-2),
         (1024, 4096, jnp.bfloat16, 3e-2, 3e-2),
     ]:
-        if out_of_time(f"layer_norm {rows}x{hidden}"):
-            return 2 if ok else 1
-        x = jax.random.normal(key, (rows, hidden), jnp.float32).astype(dtype)
-        w = (jax.random.normal(jax.random.fold_in(key, 1), (hidden,)) * 0.1 + 1.0).astype(dtype)
-        b = (jax.random.normal(jax.random.fold_in(key, 2), (hidden,)) * 0.1).astype(dtype)
         tag = f"{rows}x{hidden} {jnp.dtype(dtype).name}"
-
-        for name, fn in [
+        for opname, fn in [
             ("layer_norm", lambda impl: lambda x, w, b: layer_norm(x, w, b, impl=impl)),
             ("rms_norm", lambda impl: lambda x, w, b: rms_norm(x, w, impl=impl)),
         ]:
-            f_p = jax.jit(lambda x, w, b, f=fn("pallas"): f(x, w, b))
-            f_x = jax.jit(lambda x, w, b, f=fn("xla"): f(x, w, b))
-            ok &= check(f"{name} fwd {tag}", f_p(x, w, b), f_x(x, w, b), ftol)
-            g_p = jax.jit(jax.grad(lambda x, w, b, f=fn("pallas"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
-            g_x = jax.jit(jax.grad(lambda x, w, b, f=fn("xla"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
-            ok &= check(f"{name} bwd {tag}", g_p(x, w, b), g_x(x, w, b), btol)
+            def fwd(name=f"{opname} fwd {tag}", fn=fn, shape=(rows, hidden),
+                    dtype=dtype, tol=ftol):
+                x, w, b = ln_inputs(*shape, dtype)
+                f_p = jax.jit(lambda x, w, b, f=fn("pallas"): f(x, w, b))
+                f_x = jax.jit(lambda x, w, b, f=fn("xla"): f(x, w, b))
+                return check(name, f_p(x, w, b), f_x(x, w, b), tol)
+
+            def bwd(name=f"{opname} bwd {tag}", fn=fn, shape=(rows, hidden),
+                    dtype=dtype, tol=btol):
+                x, w, b = ln_inputs(*shape, dtype)
+                g_p = jax.jit(jax.grad(lambda x, w, b, f=fn("pallas"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
+                g_x = jax.jit(jax.grad(lambda x, w, b, f=fn("xla"): jnp.sum(jnp.sin(f(x, w, b).astype(jnp.float32))), argnums=(0, 1, 2)))
+                return check(name, g_p(x, w, b), g_x(x, w, b), tol)
+
+            yield f"{opname} fwd {tag}", fwd
+            yield f"{opname} bwd {tag}", bwd
 
     # ---- flash attention fwd+bwd (causal + non-causal) ----
-    if out_of_time("flash_attention"):
-        return 2 if ok else 1
-    from apex_tpu.ops import flash_attention
-
     # Tolerances are hardware-calibrated, not wishful: on TPU the fp32 dots in
     # BOTH paths run at MXU default precision (bf16 passes), and measured
     # distance-from-fp64-ground-truth on v5e is ~3e-3 (non-causal) / ~1e-2
     # (causal) for EACH path, with Pallas slightly closer to fp64 than XLA.
     # The pallas-vs-xla delta is precision noise, so the gate is set at the
     # 2x-the-measured-noise level rather than an fp32-exactness fantasy.
-    q = jax.random.normal(jax.random.fold_in(key, 3), (2, 4, 256, 64), jnp.float32)
-    k_ = jax.random.normal(jax.random.fold_in(key, 4), (2, 4, 256, 64), jnp.float32)
-    v = jax.random.normal(jax.random.fold_in(key, 5), (2, 4, 256, 64), jnp.float32)
+    from apex_tpu.ops import flash_attention
+
+    def qkv(kq=3, kk=4, kv=5, hq=4, hkv=4, seq=256):
+        q = jax.random.normal(jax.random.fold_in(key, kq), (2, hq, seq, 64), jnp.float32)
+        k_ = jax.random.normal(jax.random.fold_in(key, kk), (2, hkv, seq, 64), jnp.float32)
+        v = jax.random.normal(jax.random.fold_in(key, kv), (2, hkv, seq, 64), jnp.float32)
+        return q, k_, v
+
     for causal in (False, True):
-        f_p = jax.jit(lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c, impl="pallas"))
-        f_x = jax.jit(lambda q, k, v, c=causal: flash_attention(q, k, v, causal=c, impl="xla"))
-        ok &= check(f"flash_attention fwd causal={causal}", f_p(q, k_, v), f_x(q, k_, v), 2e-2)
-        g_p = jax.jit(jax.grad(lambda q, k, v, c=causal: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=c, impl="pallas"))), argnums=(0, 1, 2)))
-        g_x = jax.jit(jax.grad(lambda q, k, v, c=causal: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=c, impl="xla"))), argnums=(0, 1, 2)))
-        ok &= check(f"flash_attention bwd causal={causal}", g_p(q, k_, v), g_x(q, k_, v), 5e-2)
+        def fa_fwd(name=f"flash_attention fwd causal={causal}", c=causal):
+            q, k_, v = qkv()
+            f_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=c, impl="pallas"))
+            f_x = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=c, impl="xla"))
+            return check(name, f_p(q, k_, v), f_x(q, k_, v), 2e-2)
+
+        def fa_bwd(name=f"flash_attention bwd causal={causal}", c=causal):
+            q, k_, v = qkv()
+            g_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=c, impl="pallas"))), argnums=(0, 1, 2)))
+            g_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(flash_attention(q, k, v, causal=c, impl="xla"))), argnums=(0, 1, 2)))
+            return check(name, g_p(q, k_, v), g_x(q, k_, v), 5e-2)
+
+        yield f"flash_attention fwd causal={causal}", fa_fwd
+        yield f"flash_attention bwd causal={causal}", fa_bwd
 
     # ---- GQA / sliding window / key-padding fast paths (compiled) ----
-    if out_of_time("GQA/window/kpm"):
-        return 2 if ok else 1
-    q4 = jax.random.normal(jax.random.fold_in(key, 10), (2, 4, 256, 64), jnp.float32)
-    k4 = jax.random.normal(jax.random.fold_in(key, 11), (2, 2, 256, 64), jnp.float32)
-    v4 = jax.random.normal(jax.random.fold_in(key, 12), (2, 2, 256, 64), jnp.float32)
-    gq_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="pallas"))
-    gq_x = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="xla"))
-    ok &= check("flash_attention GQA fwd", gq_p(q4, k4, v4), gq_x(q4, k4, v4), 2e-2)
-    gg_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
-        flash_attention(q, k, v, causal=True, impl="pallas"))), argnums=(0, 1, 2)))
-    gg_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
-        flash_attention(q, k, v, causal=True, impl="xla"))), argnums=(0, 1, 2)))
-    ok &= check("flash_attention GQA bwd", gg_p(q4, k4, v4), gg_x(q4, k4, v4), 5e-2)
+    def gqa_fwd(name="flash_attention GQA fwd"):
+        q4, k4, v4 = qkv(10, 11, 12, hq=4, hkv=2)
+        gq_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="pallas"))
+        gq_x = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True, impl="xla"))
+        return check(name, gq_p(q4, k4, v4), gq_x(q4, k4, v4), 2e-2)
 
-    w_p = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, window=100, impl="pallas"))
-    w_x = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, causal=True, window=100, impl="xla"))
-    ok &= check("flash_attention window fwd", w_p(q, k_, v), w_x(q, k_, v), 2e-2)
+    def gqa_bwd(name="flash_attention GQA bwd"):
+        q4, k4, v4 = qkv(10, 11, 12, hq=4, hkv=2)
+        gg_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+            flash_attention(q, k, v, causal=True, impl="pallas"))), argnums=(0, 1, 2)))
+        gg_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+            flash_attention(q, k, v, causal=True, impl="xla"))), argnums=(0, 1, 2)))
+        return check(name, gg_p(q4, k4, v4), gg_x(q4, k4, v4), 5e-2)
 
-    kpm = jnp.zeros((2, 256), bool).at[0, 180:].set(True)
-    kp_p = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, key_padding_mask=kpm, impl="pallas"))
-    kp_x = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, key_padding_mask=kpm, impl="xla"))
-    ok &= check("flash_attention kpm fwd", kp_p(q, k_, v), kp_x(q, k_, v), 2e-2)
+    def window_fwd(name="flash_attention window fwd"):
+        q, k_, v = qkv()
+        w_p = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=100, impl="pallas"))
+        w_x = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=100, impl="xla"))
+        return check(name, w_p(q, k_, v), w_x(q, k_, v), 2e-2)
+
+    def kpm_fwd(name="flash_attention kpm fwd"):
+        q, k_, v = qkv()
+        kpm = jnp.zeros((2, 256), bool).at[0, 180:].set(True)
+        kp_p = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, key_padding_mask=kpm, impl="pallas"))
+        kp_x = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, key_padding_mask=kpm, impl="xla"))
+        return check(name, kp_p(q, k_, v), kp_x(q, k_, v), 2e-2)
+
+    yield "flash_attention GQA fwd", gqa_fwd
+    yield "flash_attention GQA bwd", gqa_bwd
+    yield "flash_attention window fwd", window_fwd
+    yield "flash_attention kpm fwd", kpm_fwd
 
     # ---- blockwise long-context + decode-shaped attention (compiled) ----
     # VERDICT r3 weak #3: the round-3 KV-cache decode and blockwise
@@ -162,68 +249,132 @@ def main(deadline=None):
     # path is the single-chip long-context engine (ops/attention.py
     # _attn_blockwise); seq=300 is deliberately non-divisible so the
     # padded-tail chunking (the _bw_chunk divisor fix) compiles too.
-    if out_of_time("blockwise/decode"):
-        return 2 if ok else 1
-    qL = jax.random.normal(jax.random.fold_in(key, 20), (1, 4, 300, 64), jnp.float32)
-    kL = jax.random.normal(jax.random.fold_in(key, 21), (1, 4, 300, 64), jnp.float32)
-    vL = jax.random.normal(jax.random.fold_in(key, 22), (1, 4, 300, 64), jnp.float32)
-    kpmL = jnp.zeros((1, 300), bool).at[0, 250:].set(True)
+    def qkv_long():
+        qL = jax.random.normal(jax.random.fold_in(key, 20), (1, 4, 300, 64), jnp.float32)
+        kL = jax.random.normal(jax.random.fold_in(key, 21), (1, 4, 300, 64), jnp.float32)
+        vL = jax.random.normal(jax.random.fold_in(key, 22), (1, 4, 300, 64), jnp.float32)
+        return qL, kL, vL
+
+    kpmL_spec = lambda: jnp.zeros((1, 300), bool).at[0, 250:].set(True)
     for tag, kw in [
         ("causal", dict(causal=True)),
         ("window", dict(causal=True, window=64)),
-        ("kpm", dict(key_padding_mask=kpmL)),
+        ("kpm", "kpm"),
     ]:
-        b_p = jax.jit(lambda q, k, v, kw=kw: flash_attention(
-            q, k, v, impl="blockwise", **kw))
-        b_x = jax.jit(lambda q, k, v, kw=kw: flash_attention(
-            q, k, v, impl="xla", **kw))
-        ok &= check(f"blockwise {tag} fwd", b_p(qL, kL, vL), b_x(qL, kL, vL), 2e-2)
-        gb_p = jax.jit(jax.grad(lambda q, k, v, kw=kw: jnp.sum(jnp.sin(
-            flash_attention(q, k, v, impl="blockwise", **kw))), argnums=(0, 1, 2)))
-        gb_x = jax.jit(jax.grad(lambda q, k, v, kw=kw: jnp.sum(jnp.sin(
-            flash_attention(q, k, v, impl="xla", **kw))), argnums=(0, 1, 2)))
-        ok &= check(f"blockwise {tag} bwd", gb_p(qL, kL, vL), gb_x(qL, kL, vL), 5e-2)
+        def bw_fwd(name=f"blockwise {tag} fwd", kw=kw):
+            qL, kL, vL = qkv_long()
+            kw2 = dict(key_padding_mask=kpmL_spec()) if kw == "kpm" else kw
+            b_p = jax.jit(lambda q, k, v: flash_attention(q, k, v, impl="blockwise", **kw2))
+            b_x = jax.jit(lambda q, k, v: flash_attention(q, k, v, impl="xla", **kw2))
+            return check(name, b_p(qL, kL, vL), b_x(qL, kL, vL), 2e-2)
+
+        def bw_bwd(name=f"blockwise {tag} bwd", kw=kw):
+            qL, kL, vL = qkv_long()
+            kw2 = dict(key_padding_mask=kpmL_spec()) if kw == "kpm" else kw
+            gb_p = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+                flash_attention(q, k, v, impl="blockwise", **kw2))), argnums=(0, 1, 2)))
+            gb_x = jax.jit(jax.grad(lambda q, k, v: jnp.sum(jnp.sin(
+                flash_attention(q, k, v, impl="xla", **kw2))), argnums=(0, 1, 2)))
+            return check(name, gb_p(qL, kL, vL), gb_x(qL, kL, vL), 5e-2)
+
+        yield f"blockwise {tag} fwd", bw_fwd
+        yield f"blockwise {tag} bwd", bw_bwd
 
     # decode hot path: one query token against a 256-slot KV cache with the
     # unwritten tail padded out — exactly the call transformer/layer.py:418
     # makes per generated token (causal=False + kpm, sq=1)
-    qd = jax.random.normal(jax.random.fold_in(key, 23), (2, 4, 1, 64), jnp.float32)
-    kpm_d = jnp.broadcast_to(jnp.arange(256)[None, :] > 200, (2, 256))
-    d_p = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, key_padding_mask=kpm_d, impl="pallas"))
-    d_x = jax.jit(lambda q, k, v: flash_attention(
-        q, k, v, key_padding_mask=kpm_d, impl="xla"))
-    ok &= check("decode sq=1 kpm fwd", d_p(qd, k_, v), d_x(qd, k_, v), 2e-2)
+    def decode_fwd(name="decode sq=1 kpm fwd"):
+        _, k_, v = qkv()
+        qd = jax.random.normal(jax.random.fold_in(key, 23), (2, 4, 1, 64), jnp.float32)
+        kpm_d = jnp.broadcast_to(jnp.arange(256)[None, :] > 200, (2, 256))
+        d_p = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, key_padding_mask=kpm_d, impl="pallas"))
+        d_x = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, key_padding_mask=kpm_d, impl="xla"))
+        return check(name, d_p(qd, k_, v), d_x(qd, k_, v), 2e-2)
+
+    yield "decode sq=1 kpm fwd", decode_fwd
 
     # ---- flat optimizer engine ----
-    if out_of_time("flat optimizer engine"):
-        return 2 if ok else 1
-    from apex_tpu.optimizers._fused_kernels import adam_flat, l2norm_flat
-    from apex_tpu.ops.multi_tensor import CHUNK_SIZE
-
     # 3 chunks: the production case is a MULTI-chunk buffer (grid > 1), which
     # exercises the sequential-grid accumulation in l2norm_flat and the
     # per-chunk block walk in adam_flat — grid=1 alone would leave the same
     # hazard class that bit the LN bwd partials (see above) uncovered
-    n = 3 * CHUNK_SIZE
-    buf = jax.random.normal(jax.random.fold_in(key, 8), (n,), jnp.float32)
-    g = jax.random.normal(jax.random.fold_in(key, 9), (n,), jnp.float32)
-    m = jnp.zeros_like(buf)
-    v2 = jnp.zeros_like(buf)
-    bc1, bc2 = jnp.float32(1 - 0.9), jnp.float32(1 - 0.999)
+    def flat_inputs():
+        from apex_tpu.ops.multi_tensor import CHUNK_SIZE
 
-    adam = lambda impl: jax.jit(
-        lambda g, p, m, v, bc1, bc2: adam_flat(
-            g, p, m, v, bc1, bc2, lr=1e-3, beta1=0.9, beta2=0.999,
-            eps=1e-8, weight_decay=0.01, adam_w_mode=True, impl=impl)
-    )
-    ok &= check("adam_flat", adam("pallas")(g, buf, m, v2, bc1, bc2),
-                adam("xla")(g, buf, m, v2, bc1, bc2), 1e-6)
+        n = 3 * CHUNK_SIZE
+        buf = jax.random.normal(jax.random.fold_in(key, 8), (n,), jnp.float32)
+        g = jax.random.normal(jax.random.fold_in(key, 9), (n,), jnp.float32)
+        return buf, g
 
-    n_p = jax.jit(lambda x: l2norm_flat(x, impl="pallas"))(buf)
-    n_x = jax.jit(lambda x: l2norm_flat(x, impl="xla"))(buf)
-    ok &= check("l2norm_flat", n_p, n_x, 1e-2)
+    def adam_check(name="adam_flat"):
+        from apex_tpu.optimizers._fused_kernels import adam_flat
 
+        buf, g = flat_inputs()
+        m = jnp.zeros_like(buf)
+        v2 = jnp.zeros_like(buf)
+        bc1, bc2 = jnp.float32(1 - 0.9), jnp.float32(1 - 0.999)
+        adam = lambda impl: jax.jit(
+            lambda g, p, m, v, bc1, bc2: adam_flat(
+                g, p, m, v, bc1, bc2, lr=1e-3, beta1=0.9, beta2=0.999,
+                eps=1e-8, weight_decay=0.01, adam_w_mode=True, impl=impl)
+        )
+        return check(name, adam("pallas")(g, buf, m, v2, bc1, bc2),
+                     adam("xla")(g, buf, m, v2, bc1, bc2), 1e-6)
+
+    def l2norm_check(name="l2norm_flat"):
+        from apex_tpu.optimizers._fused_kernels import l2norm_flat
+
+        buf, _ = flat_inputs()
+        n_p = jax.jit(lambda x: l2norm_flat(x, impl="pallas"))(buf)
+        n_x = jax.jit(lambda x: l2norm_flat(x, impl="xla"))(buf)
+        return check(name, n_p, n_x, 1e-2)
+
+    yield "adam_flat", adam_check
+    yield "l2norm_flat", l2norm_check
+
+
+def main(deadline=None, skip_ok=None):
+    """Run every kernel smoke; ``deadline`` (time.monotonic value) stops
+    BETWEEN checks so a flaky relay can't strand the harness — skipped
+    checks are reported, not silently dropped.
+
+    Return codes: 0 = all checked kernels OK; 1 = a numerics/lowering
+    FAILURE (deterministic — retrying wastes a relay window); 2 = budget
+    ran out / relay died with everything checked so far OK (worth
+    retrying — a retry reuses this attempt's sidecar verdicts)."""
+    fp = source_fingerprint()
+    if skip_ok is None:
+        skip_ok = prior_ok_checks(PROGRESS_PATH, fp)
+    # run-start delimiter: attempts append to one file, and a reader
+    # recovering evidence after a hang must not attribute a prior
+    # attempt's passes to this run (nor reuse verdicts for edited kernels)
+    _emit(f"=== smoke attempt start (pid {os.getpid()}, fp={fp}) ===")
+
+    dev = jax.devices()[0]
+    _emit(f"backend: {dev.platform} / {dev.device_kind}")
+    ok = True
+    for name, thunk in build_checks():
+        if name in skip_ok:
+            _emit(f"ok   {name} (prior)")
+            continue
+        if deadline is not None and time.monotonic() > deadline:
+            # rc=2 even after a deterministic FAIL: the FAIL is already on
+            # the sidecar (and re-runs next attempt), but the UNRUN checks
+            # still need a window — rc=1 here would capture the section
+            # with no verdict on them, and resume makes the retry cheap
+            _emit(f"SKIP remaining (budget exhausted before {name})")
+            return 2
+        try:
+            ok &= bool(thunk())
+        except Exception as e:
+            if _transient(e):
+                _emit(f"SKIP remaining ({name}: relay infrastructure failure: "
+                      f"{e!r:.200})")
+                return 2  # see the budget-exhaustion comment above
+            _emit(f"FAIL {name}: raised {e!r:.300}")
+            ok = False
     _emit("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
 

@@ -155,6 +155,91 @@ def test_poisoned_all_transient_sections_retry(tmp_path):
     assert "micro" in state and "configs" in state
 
 
+def test_completed_flag_semantics(tmp_path):
+    # round-5 records: `ok` = produced data, `completed` = harness health.
+    # A completed section whose failures were all deterministic is a
+    # captured answer even with ok=false; relay-dead and incomplete
+    # sections retry.
+    p = _write(tmp_path, [
+        # all-deterministic-failure micro: captured (the rc=1 principle)
+        {"section": "micro", "ok": False, "completed": True,
+         "adam_step_s": "error: non-positive slope", "measured_n": 0},
+        # relay died before the section ran: retry
+        {"section": "configs", "ok": False, "completed": False,
+         "relay_dead": True},
+        # measured some items but others transiently failed: retry
+        {"section": "sweep", "ok": True, "completed": True,
+         "measured_n": 1, "incomplete": ["rn50_ampO2_b512"]},
+        # fully measured: captured
+        {"section": "profile", "ok": True, "completed": True,
+         "measured_n": 3, "fwd_s_per_step": 0.01},
+    ])
+    state = harvest.results_state(p)
+    assert "micro" in state and "profile" in state
+    assert "configs" not in state and "sweep" not in state
+
+
+def test_completed_smoke_rc_semantics(tmp_path):
+    # rc semantics carry over to round-5 records: rc=2 (budget/relay)
+    # retries even when checks streamed to the sidecar made ok=true
+    for rc, captured in [(0, True), (1, True), (2, False)]:
+        p = _write(tmp_path, [{"section": "smoke", "ok": True,
+                               "completed": True, "rc": rc,
+                               "measured_n": 5}])
+        assert ("smoke" in harvest.results_state(p)) is captured, rc
+
+
+def test_micro_reuses_fresh_subrecords(tmp_path):
+    # an item measured by an earlier window is reused, not re-measured;
+    # the remaining items retry (and with an expired deadline they skip
+    # without touching the backend)
+    import json
+    import time
+
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps({
+            "section": "micro_adam_step_s", "ok": True, "completed": True,
+            "value": {"tree": 0.004, "flat": 0.005},
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }) + "\n")
+    rec = run_all_tpu.run_micro(deadline=0.0, out_path=out)
+    assert rec["adam_step_s"] == {"tree": 0.004, "flat": 0.005}
+    assert rec["measured_n"] == 1
+    assert "adam_step_s" not in rec["incomplete"]
+    assert "l2norm_s" in rec["incomplete"]
+
+
+def test_configs_reuses_fresh_subrecords(tmp_path):
+    import json
+    import time
+
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps({
+            "section": "config_gpt", "ok": True, "completed": True,
+            "value": {"tokens_per_sec": 1000.0, "elapsed_s": 9.0},
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }) + "\n")
+    rec = run_all_tpu.run_configs(deadline=0.0, out_path=out)
+    assert rec["configs"]["gpt"]["tokens_per_sec"] == 1000.0
+    assert rec["measured_n"] == 1
+    assert "gpt" not in rec["incomplete"] and "bert" in rec["incomplete"]
+
+
+def test_profile_budget_exhaustion_marks_incomplete(tmp_path):
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+    rec = run_all_tpu.run_profile(deadline=0.0, out_path=out)
+    assert rec["incomplete"] == ["fwd", "fwd_bwd", "step"]
+    assert rec["measured_n"] == 0
+
+
 def test_deterministic_all_error_sections_count_as_captured(tmp_path):
     # every item failed, but deterministically (numerics/shape bugs):
     # retrying re-burns a window on the same answer — captured
@@ -164,3 +249,76 @@ def test_deterministic_all_error_sections_count_as_captured(tmp_path):
          "l2norm_s": "error: max abs err 0.5"},
     ])
     assert "micro" in harvest.results_state(p)
+
+
+def test_smoke_later_fail_invalidates_prior_ok(tmp_path):
+    # a check that FAILed under the same source fingerprint after an
+    # earlier ok must re-run, not be skipped as clean forever
+    import tpu_kernel_smoke as s
+
+    p = tmp_path / "progress.log"
+    fp = "ab" * 8
+    p.write_text(
+        f"t === smoke attempt start (pid 1, fp={fp}) ===\n"
+        "t ok   layer_norm fwd 512x1024 float32\n"
+        "t ok   adam_flat\n"
+        f"t === smoke attempt start (pid 2, fp={fp}) ===\n"
+        "t FAIL adam_flat: max abs err 0.5 > 1e-06\n"
+        "t ok   l2norm_flat\n"
+    )
+    got = s.prior_ok_checks(str(p), fp)
+    assert got == {"layer_norm fwd 512x1024 float32", "l2norm_flat"}
+
+
+def test_run_items_reuses_deterministic_failures(tmp_path):
+    # an item that failed DETERMINISTICALLY in an earlier window is a
+    # captured answer: the retry must not re-buy it (and it is neither
+    # measured nor incomplete)
+    import json
+    import time
+
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+    with open(out, "w") as f:
+        f.write(json.dumps({
+            "section": "micro_adam_step_s", "ok": False, "completed": True,
+            "error": "error: non-positive slope",
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }) + "\n")
+    calls = []
+
+    def fn(d):
+        calls.append(1)
+        return 1.0
+
+    results, measured, incomplete = run_all_tpu.run_items(
+        [("adam_step_s", fn)], time.monotonic() + 300, out, "micro")
+    assert calls == []  # not re-run
+    assert results["adam_step_s"] == "error: non-positive slope"
+    assert measured == 0 and incomplete == []
+
+
+def test_run_items_emits_failure_subrecords(tmp_path):
+    # a deterministic in-window failure is persisted so the NEXT window
+    # can reuse it; transient failures are not (they must retry)
+    import json
+    import time
+
+    import run_all_tpu
+
+    out = str(tmp_path / "r.jsonl")
+
+    def det(d):
+        raise ValueError("non-positive slope")
+
+    def trans(d):
+        raise RuntimeError("UNAVAILABLE: transport: connection refused")
+
+    results, measured, incomplete = run_all_tpu.run_items(
+        [("a", det), ("b", trans)], time.monotonic() + 300, out, "micro")
+    assert incomplete == ["b"]
+    recs = [json.loads(l) for l in open(out)]
+    fails = [r for r in recs if r["section"] == "micro_a"]
+    assert len(fails) == 1 and fails[0]["completed"] and not fails[0]["ok"]
+    assert not [r for r in recs if r["section"] == "micro_b"]
