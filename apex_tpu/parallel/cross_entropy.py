@@ -4,8 +4,18 @@ Reference parity: apex/transformer/tensor_parallel/cross_entropy.py
 (_VocabParallelCrossEntropy, :23-131): logits are sharded along vocab over
 TP; the softmax-CE is computed with three TP collectives — max (pmax),
 sum-exp (psum), and the target-logit partial (psum) — and the BACKWARD is
-hand-written (softmax - onehot, :105-130), exactly like the reference's
-autograd Function.
+hand-written (softmax - onehot, :105-130) in the same spirit as the
+reference's autograd Function.
+
+INTENTIONAL label-smoothing deviation: the reference rescales the
+smoothing coefficient by K/(K-1) and computes the smooth term over the
+LOCAL vocab partition (cross_entropy.py:86-103); this implementation
+uses ``label_smoothing`` directly with a uniform prior over the GLOBAL
+vocab — the textbook formulation, self-consistent between fwd
+(``(1-ls)*ce + ls*(lse - mean_logit)``) and bwd (``- ls/V_global``),
+and invariant to the TP degree (the reference's local-partition term
+changes with tp). Exact-parity porting of the K/(K-1) variant was
+rejected, not overlooked.
 
 The backward is a ``custom_vjp``, not autodiff: differentiating through
 the forward's psums under ``check_vma=False`` double-counts (the psum

@@ -90,7 +90,14 @@ def all_reduce_gradients(
     ``axis_name`` in the loss (e.g. SyncBatchNorm), differentiate the
     GLOBAL loss — ``jax.grad(lambda p: lax.pmean(loss_fn(p), axis_name))``
     — so the cross-shard terms transpose correctly
-    (tests/test_amp_convergence.py pins the patterns).
+    (tests/test_amp_convergence.py pins the patterns) — and then **skip
+    this function entirely**.  Those grads arrive unvarying and ALREADY
+    AVERAGED (the pmean's 1/N rides the transpose), and the unvarying
+    type cannot distinguish a sum (divide by N) from a mean (already
+    final): the already-reduced branch here would silently return
+    mean/N.  Like ``zero_scatter_grads``, this function is ONLY for
+    grads of a PER-RANK (shard-local) loss; tests/test_ddp.py pins both
+    regimes.
     """
     n = jax.lax.psum(1, axis_name)
     tracking = vma_tracking_live(axis_name)

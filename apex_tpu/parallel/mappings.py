@@ -50,10 +50,21 @@ def _all_gather_invariant_dim(x, axis_name: str, dim: int):
     pp x tp x sp integration under default shard_map). Same collective,
     different type; identical under ``check_vma=False``."""
     try:
+        # private import: jax exposes no public invariant gather yet —
+        # switch to the public API the release it appears
         from jax._src.lax.parallel import all_gather_invariant
     except ImportError:  # older jax: unchecked semantics, plain gather
         return _all_gather_dim(x, axis_name, dim)
-    return all_gather_invariant(x, axis_name, axis=dim, tiled=True)
+    try:
+        return all_gather_invariant(x, axis_name, axis=dim, tiled=True)
+    except TypeError as e:  # signature drift in a future jax release
+        raise TypeError(
+            "jax._src.lax.parallel.all_gather_invariant's signature "
+            "changed; update _all_gather_invariant_dim in "
+            "apex_tpu/parallel/mappings.py (falling back to the plain "
+            "gather would silently lose the invariant typing checked "
+            f"shard_map requires): {e}"
+        ) from e
 
 
 def _reduce_scatter_dim(x, axis_name: str, dim: int):

@@ -107,6 +107,49 @@ class TestAllReduceGradients:
         assert out.dtype == jnp.float16
         np.testing.assert_allclose(np.asarray(out), 30000.0)
 
+    def test_pmean_global_loss_grads_are_final_skip_allreduce(self, mesh):
+        """The documented pmean'd-GLOBAL-loss regime (the SyncBatchNorm
+        pattern): under checked shard_map those grads arrive unvarying and
+        ALREADY AVERAGED — they equal the full-batch gradient with NO call
+        to all_reduce_gradients, and calling it anyway silently divides by
+        N again (the unvarying type cannot tell a sum from a mean).  Pins
+        the docstring's 'skip this function' guidance."""
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+        x = jax.random.normal(k1, (32, 8))
+        y = jax.random.normal(k2, (32, 1))
+        params = {
+            "w": jax.random.normal(k3, (8, 1)),
+            "b": jnp.zeros((1,)),
+        }
+        full = jax.grad(_loss)(params, x, y)
+
+        def run(call_allreduce):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(), P("dp"), P("dp")), out_specs=P(),
+            )
+            def dp_grads(params, x, y):
+                g = jax.grad(
+                    lambda p: jax.lax.pmean(_loss(p, x, y), "dp")
+                )(params)
+                return all_reduce_gradients(g, "dp") if call_allreduce else g
+
+            return dp_grads(params, x, y)
+
+        got = run(call_allreduce=False)
+        for k in full:
+            np.testing.assert_allclose(
+                np.asarray(got[k]), np.asarray(full[k]), rtol=1e-5, atol=1e-6
+            )
+        # the trap, pinned so a refactor can't silently change it: the
+        # already-reduced branch has no way to know these are means
+        wrong = run(call_allreduce=True)
+        np.testing.assert_allclose(
+            np.asarray(wrong["w"]), np.asarray(full["w"]) / 8.0,
+            rtol=1e-5, atol=1e-7,
+        )
+
     def test_sum_mode_when_average_off(self, mesh):
         @jax.jit
         @functools.partial(
