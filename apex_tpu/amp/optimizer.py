@@ -8,7 +8,10 @@ update_master_grads :439).
 TPU design: instead of patching a mutable optimizer object, ``AmpOptimizer``
 is a pure state machine over (master fp32 params, inner optax state, scaler
 state). The skip-on-overflow control flow is a ``lax.cond`` with donated
-state — the whole step stays inside one jit (hard part #4 in SURVEY.md §7).
+state — the whole step stays inside one jit (hard part #4 in SURVEY.md §7);
+under checked shard_map it is ``parallel.vma_cond``, which widens the two
+branches' outputs to a common vma type while keeping single-branch
+evaluation (so skipped steps don't pay for the update).
 """
 
 from typing import Any, Optional
@@ -144,7 +147,14 @@ class AmpOptimizer:
         def skip_step(operand):
             return operand
 
-        new_master, new_inner = jax.lax.cond(
+        # vma_cond, not lax.cond: under checked shard_map the step branch's
+        # outputs inherit the grads' varying axes while the skip branch
+        # returns the (often replicated) old state — plain cond rejects the
+        # mixed-vma branch types, and a where-select would pay for the
+        # optimizer update even on skipped steps
+        from apex_tpu.parallel.utils import vma_cond
+
+        new_master, new_inner = vma_cond(
             found_inf, skip_step, do_step, (state.master, state.inner)
         )
         if isinstance(state.scaler, tuple):

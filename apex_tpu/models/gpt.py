@@ -79,7 +79,10 @@ class Embedding(nn.Module):
         self.dropout = ShardAwareDropout(rate=cfg.hidden_dropout, axis_names=cp_axes)
 
     def __call__(self, tokens, position_ids=None, tokentype_ids=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, decode_step: bool = False):
+        # decode_step: a replicated single token — skip the SP scatter (one
+        # token cannot be sequence-sharded; see transformer/layer.py's
+        # plain-TP decode layout)
         cfg = self.config
         h = self.word_embeddings(tokens)  # (b, s, h)
         if cfg.position_embedding_type == "learned":
@@ -110,7 +113,8 @@ class Embedding(nn.Module):
         h = h.astype(cfg.compute_dtype)
         if cfg.hidden_dropout > 0.0:
             h = self.dropout(h, deterministic=deterministic)
-        if cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1:
+        if (cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
+                and not decode_step):
             h = scatter_to_sequence_parallel_region(h, cfg.tensor_axis)
         return h
 
@@ -177,7 +181,9 @@ class GPTModel(nn.Module):
         cfg = self.config
         cache_active = cache_len is not None or decode_step
         if self.pre_process:
-            h = self.embedding(tokens, position_ids, deterministic=deterministic)
+            h = self.embedding(tokens, position_ids,
+                               deterministic=deterministic,
+                               decode_step=decode_step)
         else:
             h = tokens  # already (s_local, b, h) hidden states from prev stage
 
@@ -215,7 +221,10 @@ class GPTModel(nn.Module):
             return h
 
         tied = cfg.share_embeddings_and_output_weights
-        sp_gathered = cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
+        # decode steps carry a replicated single token — nothing is
+        # sequence-sharded, so the SP head gather must not run
+        sp_gathered = (cfg.sequence_parallel and _tp_size(cfg.tensor_axis) > 1
+                       and not decode_step)
         if tied:
             if sp_gathered:
                 # to_model_parallel=True — attend(parallel_input=True) leaves
@@ -231,7 +240,11 @@ class GPTModel(nn.Module):
         else:
             # the layer performs the SP gather itself (reduce-scatter
             # backward) and emits fp32 logits
-            logits = self.output_layer(h)
+            logits = self.output_layer(
+                h,
+                **({"sequence_parallel_override": False}
+                   if decode_step else {}),
+            )
         logits = jnp.transpose(logits, (1, 0, 2))  # (b, s, v/tp)
         if labels is None:
             return logits

@@ -25,6 +25,7 @@ from apex_tpu.parallel import pipeline
 from apex_tpu.optimizers.larc import LARC, larc
 from apex_tpu.parallel import random
 from apex_tpu.parallel.ring_attention import (
+    cp_decode_attention,
     ring_attention,
     ulysses_attention,
     zigzag_shard,
@@ -37,6 +38,7 @@ from apex_tpu.parallel.utils import (
     pvary_params,
     scan_carry_fixed_point,
     split_tensor_along_last_dim,
+    vma_cond,
 )
 
 __all__ = [
@@ -56,6 +58,7 @@ __all__ = [
     "mappings",
     "pipeline",
     "random",
+    "cp_decode_attention",
     "ring_attention",
     "ulysses_attention",
     "zigzag_shard",
@@ -65,5 +68,6 @@ __all__ = [
     "promote_to_vma",
     "pvary_params",
     "scan_carry_fixed_point",
+    "vma_cond",
     "split_tensor_along_last_dim",
 ]

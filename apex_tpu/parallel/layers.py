@@ -88,7 +88,14 @@ class ColumnParallelLinear(nn.Module):
     output_dtype: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, sequence_parallel_override: Optional[bool] = None):
+        # call-time SP override for setup-built instances whose input layout
+        # changes per call — KV-cache decode feeds a replicated single token
+        # through a layer constructed for sequence-sharded training inputs
+        # (params are identical either way; only the gather moves)
+        sp = (self.sequence_parallel_enabled
+              if sequence_parallel_override is None
+              else sequence_parallel_override)
         tp = _tp_size(self.axis_name)
         assert self.output_size % tp == 0, (
             f"output_size {self.output_size} not divisible by tp {tp}"
@@ -101,7 +108,7 @@ class ColumnParallelLinear(nn.Module):
             self.params_dtype,
         )
         if tp > 1:
-            if self.sequence_parallel_enabled:
+            if sp:
                 x = gather_from_sequence_parallel_region(x, self.axis_name)
             else:
                 x = copy_to_tensor_model_parallel_region(x, self.axis_name)
@@ -120,7 +127,7 @@ class ColumnParallelLinear(nn.Module):
             )
             y = y + bias.astype(y.dtype)
         if self.gather_output and tp > 1:
-            assert not self.sequence_parallel_enabled
+            assert not sp
             y = gather_from_tensor_model_parallel_region(y, self.axis_name)
         return y
 

@@ -584,3 +584,50 @@ def ulysses_attention(
         )
     oh = attn_fn(qh, kh, vh, causal=causal, scale=scale, **kw)
     return to_seq(oh)
+
+
+def cp_decode_attention(q, k, v, padded, axis_name: str, scale=None):
+    """Single-token decode attention over a context-parallel KV cache.
+
+    The decode-time counterpart of :func:`ring_attention` (extension — the
+    reference has no inference path): each rank holds a shard of the KV
+    cache, the one new query token is replicated over ``axis_name``, and
+    the per-rank partial softmax stats merge with the flash/ring
+    log-sum-exp identity via one ``pmax`` + two ``psum``s.  Per decode
+    step that is O(1) collective latency instead of re-gathering the
+    cache, and each rank's compute is O(L_local) — long-context decode
+    scales across the mesh exactly like the ring trains it.
+
+    Args:
+      q: (b, h, 1, d), replicated over ``axis_name``.
+      k, v: (b, h_kv, L_local, d) — this rank's cache shard (GQA: h must
+        be a multiple of h_kv; consecutive grouping, q_head // g).
+      padded: (b, L_local) bool, True = slot holds no valid key (unwritten
+        tail, out-of-window, or another rank's turn in a round-robin
+        layout).
+      scale: softmax scale, default 1/sqrt(d) (flash_attention's default).
+
+    Returns (b, h, 1, d), replicated over ``axis_name``.
+    """
+    b, h, sq, d = q.shape
+    if sq != 1:
+        raise ValueError(f"cp_decode_attention is single-token (sq={sq})")
+    h_kv = k.shape[1]
+    if h % h_kv:
+        raise ValueError(f"GQA heads {h} not a multiple of kv heads {h_kv}")
+    g = h // h_kv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qf = q.astype(jnp.float32).reshape(b, h_kv, g, d)
+    s = jnp.einsum("bhgd,bhld->bhgl", qf, k.astype(jnp.float32)) * scale
+    pad = padded[:, None, None, :]
+    s = jnp.where(pad, _NEG_INF, s)
+    m = jnp.max(s, axis=-1, keepdims=True)  # (b, h_kv, g, 1)
+    p = jnp.where(pad, 0.0, jnp.exp(s - m))  # all-padded shard: p == 0
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhgl,bhld->bhgd", p, v.astype(jnp.float32))
+    m_g = jax.lax.pmax(m, axis_name)
+    alpha = jnp.exp(m - m_g)  # -> 0 for shards far below the global max
+    l_g = jax.lax.psum(l * alpha, axis_name)
+    o_g = jax.lax.psum(o * alpha, axis_name) / l_g
+    return o_g.reshape(b, h, 1, d).astype(q.dtype)

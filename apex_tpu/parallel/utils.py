@@ -117,6 +117,64 @@ def pvary_params(tree, axis_name: str = "tp"):
     return jax.tree_util.tree_map(one, tree)
 
 
+def vma_cond(pred, true_fn, false_fn, *operands):
+    """``jax.lax.cond`` whose branch outputs are pcast to their per-leaf
+    JOIN vma, so branches varying over different manual-axis sets
+    typecheck under jax's checked ``shard_map``.
+
+    Checked mode types every value with its varying-manual-axes (vma)
+    set, and ``lax.cond`` requires the two branch output types to match
+    EXACTLY — which natural code frequently violates: the canonical
+    "skip the optimizer step on overflow" cond returns the (replicated)
+    old state from one branch and grad-varying new state from the other.
+    A ``jnp.where`` select sidesteps the typecheck (selects auto-pvary)
+    but evaluates BOTH branches; this wrapper keeps cond's single-branch
+    evaluation by eval_shaping both branches (trace only, no compute),
+    taking each output leaf's vma union, and widening each branch's
+    outputs to that join INSIDE the branch.
+
+    Falls back to plain ``lax.cond`` when nothing needs widening — in
+    particular on pre-vma jax, under ``check_vma=False``, and outside
+    ``shard_map``, where it is exactly ``jax.lax.cond``.
+    """
+    try:
+        t_shape = jax.eval_shape(true_fn, *operands)
+        f_shape = jax.eval_shape(false_fn, *operands)
+        t_leaves, t_def = jax.tree_util.tree_flatten(t_shape)
+        f_leaves, f_def = jax.tree_util.tree_flatten(f_shape)
+        if t_def != f_def or len(t_leaves) != len(f_leaves):
+            # mismatched structures: let lax.cond produce its own error
+            return jax.lax.cond(pred, true_fn, false_fn, *operands)
+        wants = []
+        any_cast = False
+        for a, b in zip(t_leaves, f_leaves):
+            va, vb = getattr(a, "vma", None), getattr(b, "vma", None)
+            if va is None or vb is None:
+                wants.append(None)
+                continue
+            union = set(va) | set(vb)
+            wants.append(tuple(sorted(union)))
+            if union != set(va) or union != set(vb):
+                any_cast = True
+    except Exception:
+        # eval_shape failing here says nothing cond itself won't say better
+        return jax.lax.cond(pred, true_fn, false_fn, *operands)
+    if not any_cast:
+        return jax.lax.cond(pred, true_fn, false_fn, *operands)
+
+    def widened(fn):
+        def g(*ops):
+            out = fn(*ops)
+            leaves, treedef = jax.tree_util.tree_flatten(out)
+            leaves = [l if w is None else _widen_leaf(l, w)
+                      for l, w in zip(leaves, wants)]
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+
+        return g
+
+    return jax.lax.cond(pred, widened(true_fn), widened(false_fn), *operands)
+
+
 def scan_carry_fixed_point(body, carry, x0, max_iters: int = 3):
     """Promote ``carry``'s leaves to the vma fixed point of ``body`` so
     ``jax.lax.scan(body, carry, xs)`` typechecks under checked shard_map.
@@ -141,8 +199,10 @@ def scan_carry_fixed_point(body, carry, x0, max_iters: int = 3):
         except AttributeError:
             return None
 
-    changed = False
-    for _ in range(max_iters):
+    # max_iters + 1 evals: a round whose widening REACHES the fixed point
+    # must not raise — convergence means some eval produced no widening,
+    # so the last allowed widening gets one extra verification eval
+    for _ in range(max_iters + 1):
         out_carry = jax.eval_shape(lambda c: body(c, x0)[0], carry)
         changed = False
 
@@ -156,11 +216,9 @@ def scan_carry_fixed_point(body, carry, x0, max_iters: int = 3):
 
         carry = jax.tree_util.tree_map(widen, carry, out_carry)
         if not changed:
-            break
-    if changed:
-        raise ValueError(
-            "scan_carry_fixed_point did not converge within "
-            f"max_iters={max_iters} widening rounds; raise max_iters "
-            "(vma sets only grow toward the mesh axis count)"
-        )
-    return carry
+            return carry
+    raise ValueError(
+        "scan_carry_fixed_point did not converge within "
+        f"max_iters={max_iters} widening rounds; raise max_iters "
+        "(vma sets only grow toward the mesh axis count)"
+    )

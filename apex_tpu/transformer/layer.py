@@ -16,6 +16,7 @@ be forced to fp32 (``fp32_residual_connection``); matmuls accumulate in fp32
 on the MXU via ``preferred_element_type``.
 """
 
+import dataclasses
 import functools
 import math
 from typing import Optional
@@ -234,6 +235,13 @@ class ParallelAttention(nn.Module):
         decode_step: bool = False,
     ):
         cfg = self.config
+        if decode_step and cfg.sequence_parallel:
+            # one decode token cannot be sequence-sharded over tp: the step
+            # runs in plain-TP layout (replicated 1-token input/output; SP
+            # only moves activations, so every param is identical) while
+            # PREFILL keeps full SP — its column linears gather the
+            # sequence anyway, so the cache receives full-length K/V
+            cfg = dataclasses.replace(cfg, sequence_parallel=False)
         s, b, _ = hidden_states.shape
         tp = _tp_size(cfg.tensor_axis)
         np_local = cfg.num_attention_heads // tp
@@ -246,7 +254,10 @@ class ParallelAttention(nn.Module):
             # prompt + rotated K/V written into "cache" variables. Step
             # (decode_step): one new token attends the cache through the
             # flash key-padding fast path. TP shards the cache with the
-            # heads; SP/CP/cross-attention have no decode meaning here.
+            # heads. Under SP, decode steps run plain-TP (see above); under
+            # CP, each rank caches the positions it computed (prompt shard
+            # + round-robin decode slots) and decode merges per-rank
+            # partial softmax stats via cp_decode_attention.
             # CONTRACT: at most N - prompt_len decode steps after a
             # cache_len=N prefill. The index is traced, so overstepping
             # cannot raise here — the dynamic updates would clamp and
@@ -254,12 +265,6 @@ class ParallelAttention(nn.Module):
             # cache so this cannot happen; direct callers must too.
             if self.attn_type != AttnType.self_attn:
                 raise NotImplementedError("KV cache is self-attention only")
-            if cfg.sequence_parallel and tp > 1:
-                raise NotImplementedError("KV-cache decode with sequence "
-                                          "parallelism is unsupported")
-            if cfg.context_parallel_mode is not None:
-                raise NotImplementedError("KV-cache decode under context "
-                                          "parallelism is unsupported")
             if attention_mask is not None or key_padding_mask is not None:
                 raise NotImplementedError("KV-cache decode computes its own "
                                           "masks")
@@ -351,9 +356,10 @@ class ParallelAttention(nn.Module):
 
         if rotary_pos_emb is not None:
             q_pos_emb, k_pos_emb = rotary_pos_emb
-            if cp > 1:
+            if cp > 1 and not decode_step:
                 # sequence is cp-sharded: slice this rank's chunk out of the
-                # GLOBAL rotary table so positions stay absolute
+                # GLOBAL rotary table so positions stay absolute (a decode
+                # token's position is global — cache_index — not per-rank)
                 def _local_chunk(emb, s_local):
                     if emb.shape[0] == s_local:
                         return emb
@@ -364,12 +370,16 @@ class ParallelAttention(nn.Module):
 
                 q_pos_emb = _local_chunk(q_pos_emb, q.shape[0])
                 k_pos_emb = _local_chunk(k_pos_emb, k.shape[0])
-            if cache_active and q_pos_emb.shape[0] != s:
+            if cache_active and q_pos_emb.shape[0] != q.shape[0]:
                 # cache mode passes the FULL-length table; this call covers
-                # absolute positions [pos0, pos0 + s)
+                # absolute positions [pos0, pos0 + sq).  sq comes from q,
+                # not the layer input: under SP the column linear has
+                # already gathered the sequence, so q is s_global long
                 pos0 = cache_index if decode_step else 0
-                q_pos_emb = jax.lax.dynamic_slice_in_dim(q_pos_emb, pos0, s, 0)
-                k_pos_emb = jax.lax.dynamic_slice_in_dim(k_pos_emb, pos0, s, 0)
+                q_pos_emb = jax.lax.dynamic_slice_in_dim(
+                    q_pos_emb, pos0, q.shape[0], 0)
+                k_pos_emb = jax.lax.dynamic_slice_in_dim(
+                    k_pos_emb, pos0, k.shape[0], 0)
             q = apply_rotary_pos_emb(q, q_pos_emb)
             k = apply_rotary_pos_emb(k, k_pos_emb)
 
@@ -380,50 +390,109 @@ class ParallelAttention(nn.Module):
 
         if cache_active:
             h_kv_local = kb.shape[1]
+            # Under CP each rank caches ONLY the positions it computed:
+            # its contiguous prompt shard in slots [0, prompt_local), then
+            # decode tokens round-robin (token t -> rank t % cp, slot
+            # prompt_local + t // cp).  Slot -> global-position mapping is
+            # reconstructed from (rank, prompt_local) at decode time, so
+            # no cross-rank redistribution ever happens.  cache_index
+            # stays GLOBAL (identical on all ranks) — rotary tables and
+            # validity masks key off absolute positions.
+            if cp > 1:
+                if cache_len is not None and cache_len % cp:
+                    raise ValueError(
+                        f"cache_len ({cache_len}) must divide by cp ({cp})"
+                    )
+                slots = (cache_len or 0) // cp
+            else:
+                slots = cache_len or 0
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (b, h_kv_local, cache_len or 0, hn), kb.dtype,
+                (b, h_kv_local, slots, hn), kb.dtype,
             )
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (b, h_kv_local, cache_len or 0, hn), vb.dtype,
+                (b, h_kv_local, slots, hn), vb.dtype,
             )
             ci = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
+            if cp > 1:
+                pl = self.variable(
+                    "cache", "prompt_len_local",
+                    lambda: jnp.zeros((), jnp.int32)
+                )
             if decode_step:
                 if s != 1:
                     raise NotImplementedError(
                         "decode_step appends one token at a time; use a "
                         "prefill call (cache_len=...) for multi-token blocks"
                     )
-                idx = cache_index
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, kb.astype(ck.value.dtype), (0, 0, idx, 0)
+                idx = cache_index  # global position of this token
+                # One slot/mask implementation for both layouts: with
+                # cp == 1 the round-robin map degenerates to slot = idx
+                # and gpos = j (p_loc = prompt length, r = owner = 0).
+                if cp > 1:
+                    r = jax.lax.axis_index(cfg.context_axis)
+                    p_loc = pl.value
+                    d_cnt = idx - p_loc * cp  # decode tokens written so far
+                    slot = p_loc + d_cnt // cp
+                    write_here = r == d_cnt % cp
+                else:
+                    slot = idx
+                    write_here = None  # every (i.e. the only) rank writes
+                new_k = jax.lax.dynamic_update_slice(
+                    ck.value, kb.astype(ck.value.dtype), (0, 0, slot, 0)
                 )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, vb.astype(cv.value.dtype), (0, 0, idx, 0)
+                new_v = jax.lax.dynamic_update_slice(
+                    cv.value, vb.astype(cv.value.dtype), (0, 0, slot, 0)
                 )
+                if write_here is None:
+                    ck.value, cv.value = new_k, new_v
+                else:
+                    ck.value = jnp.where(write_here, new_k, ck.value)
+                    cv.value = jnp.where(write_here, new_v, cv.value)
                 ci.value = idx + 1
-                total = ck.value.shape[2]
-                pos = jnp.arange(total)
-                # pad out the unwritten future; the sliding window addition-
-                # ally drops keys behind the band (mistral decode)
-                padded = pos > idx
+                j = jnp.arange(ck.value.shape[2])
+                gpos = j if cp == 1 else jnp.where(
+                    j < p_loc,
+                    r * p_loc + j,
+                    p_loc * cp + (j - p_loc) * cp + r,
+                )
+                # pad out the unwritten future; the sliding window
+                # additionally drops keys behind the band (mistral decode)
+                padded = gpos > idx
                 if cfg.attention_window is not None:
                     padded = jnp.logical_or(
-                        padded, pos <= idx - cfg.attention_window
+                        padded, gpos <= idx - cfg.attention_window
                     )
-                kpm = jnp.broadcast_to(padded[None, :], (b, total))
-                ctx = flash_attention(
-                    qb, ck.value, cv.value, causal=False,
-                    key_padding_mask=kpm, impl=cfg.attention_impl,
-                )
+                padded = jnp.broadcast_to(padded[None, :], (b, j.size))
+                if cp > 1:
+                    from apex_tpu.parallel.ring_attention import (
+                        cp_decode_attention,
+                    )
+
+                    ctx = cp_decode_attention(
+                        qb, ck.value, cv.value, padded,
+                        axis_name=cfg.context_axis,
+                    )
+                else:
+                    ctx = flash_attention(
+                        qb, ck.value, cv.value, causal=False,
+                        key_padding_mask=padded, impl=cfg.attention_impl,
+                    )
             else:
                 # prefill: record the (rotated) prompt K/V, then fall
-                # through to the normal attention paths below
-                assert s <= cache_len, (
-                    f"prompt ({s}) exceeds cache ({cache_len})"
+                # through to the normal attention paths below.  kb, not the
+                # layer input, carries the cached length: under SP the
+                # column linear has gathered the full sequence; under CP
+                # this is the rank's contiguous shard (ring/ulysses run on
+                # the default non-zigzag layout — zigzag prefill would
+                # scatter positions the slot map above can't reconstruct)
+                s_kv = kb.shape[2]
+                assert s_kv <= slots, (
+                    f"prompt ({s_kv}{' per cp rank' if cp > 1 else ''}) "
+                    f"exceeds cache ({slots})"
                 )
                 ck.value = jax.lax.dynamic_update_slice(
                     ck.value, kb.astype(ck.value.dtype), (0, 0, 0, 0)
@@ -431,7 +500,9 @@ class ParallelAttention(nn.Module):
                 cv.value = jax.lax.dynamic_update_slice(
                     cv.value, vb.astype(cv.value.dtype), (0, 0, 0, 0)
                 )
-                ci.value = jnp.asarray(s, jnp.int32)
+                ci.value = jnp.asarray(s_kv * cp, jnp.int32)
+                if cp > 1:
+                    pl.value = jnp.asarray(s_kv, jnp.int32)
 
         causal = self.attn_mask_type == AttnMaskType.causal
         # apply_query_key_layer_scaling cancels exactly (scores*norm/coeff
@@ -550,6 +621,11 @@ class ParallelTransformerLayer(nn.Module):
         decode_step: bool = False,
     ):
         cfg = self.config
+        if decode_step and cfg.sequence_parallel:
+            # decode steps run plain-TP (see ParallelAttention): a single
+            # token cannot be sequence-sharded, so the MLP's column/row
+            # linears must not gather/scatter a sequence axis either
+            cfg = dataclasses.replace(cfg, sequence_parallel=False)
         rdtype = jnp.float32 if cfg.fp32_residual_connection else hidden_states.dtype
         cache_active = cache_len is not None or decode_step
 

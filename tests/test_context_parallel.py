@@ -556,6 +556,77 @@ class TestGPTWithCP:
         np.testing.assert_allclose(cp_losses, ref_losses, rtol=2e-4, atol=2e-5)
 
 
+class TestCPDecode:
+    def test_gpt_ring_cp_kv_cache_decode_matches_single_device(self, rng):
+        """KV-cache decode over a context-parallel-sharded cache (VERDICT
+        r4 item 8, formerly a NotImplementedError guard): prefill writes
+        each rank's contiguous prompt shard into its local cache, decode
+        tokens land round-robin (token t -> rank t % cp), and each step
+        merges per-rank partial softmax stats via cp_decode_attention's
+        log-sum-exp identity.  Per-step logits must equal the
+        single-device uncached forward at every decoded position."""
+        from apex_tpu.models import GPTModel
+        from apex_tpu.transformer import TransformerConfig
+
+        cp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            context_parallel_size=cp, devices=jax.devices()[:cp]
+        )
+        total, prompt = 16, 8
+
+        def cfg(cp_mode):
+            return TransformerConfig(
+                num_layers=2,
+                hidden_size=32,
+                num_attention_heads=4,
+                vocab_size=64,
+                max_position_embeddings=total,
+                hidden_dropout=0.0,
+                attention_dropout=0.0,
+                position_embedding_type="rope",
+                compute_dtype=jnp.float32,
+                context_parallel_mode=cp_mode,
+            )
+
+        tokens = jax.random.randint(rng, (2, total), 0, 64)
+        ref_model = GPTModel(config=cfg(None))
+        params = ref_model.init(jax.random.PRNGKey(1), tokens)
+        full = np.asarray(ref_model.apply(params, tokens))  # (b, total, v)
+        cp_model = GPTModel(config=cfg("ring"))
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+        def decode_all(params, tokens):
+            r = jax.lax.axis_index("cp")
+            s_local = prompt // cp
+            local = jax.lax.dynamic_slice_in_dim(
+                tokens[:, :prompt], r * s_local, s_local, 1
+            )
+            _, st = cp_model.apply(
+                params, local, cache_len=total, mutable=["cache"]
+            )
+            cache = st["cache"]
+            outs = []
+            for pos in range(prompt, total):
+                sl, upd = cp_model.apply(
+                    {**params, "cache": cache},
+                    tokens[:, pos : pos + 1],
+                    decode_step=True,
+                    mutable=["cache"],
+                )
+                cache = upd["cache"]
+                outs.append(sl[:, 0])
+            return jnp.stack(outs, axis=1)  # (b, total-prompt, v)
+
+        got = np.asarray(decode_all(params, tokens))
+        np.testing.assert_allclose(
+            got, full[:, prompt:], rtol=2e-4, atol=2e-4
+        )
+
+
 class TestRingBlockwise:
     @pytest.mark.parametrize("block_size", [2, 4, 8])
     def test_inner_blocking_matches(self, rng, block_size):

@@ -259,6 +259,49 @@ class TestGPTTensorParallel:
 
         assert float(run(tokens)) < 2e-5
 
+    def test_sp_kv_cache_decode_matches_full_forward(self, rng):
+        """KV-cache decode under sequence parallelism (VERDICT r4 item 8,
+        formerly a NotImplementedError guard): prefill keeps full SP — the
+        column linears gather the sequence, so the cache holds full-length
+        K/V — while each decode step runs in plain-TP layout (a single
+        replicated token cannot be sequence-sharded).  Per-step decode
+        logits must equal full-forward slices on every rank's vocab
+        shard."""
+        mesh = tp_mesh()
+        model = GPTModel(config=tiny_cfg(sequence_parallel=True))
+        tokens = jax.random.randint(rng, (2, 16), 0, VOCAB)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def run(tokens):
+            variables = model.init(jax.random.PRNGKey(0), tokens[:, :8])
+            full = model.apply(variables, tokens)  # (b, 16, vocab_local)
+            logits, st = model.apply(
+                variables, tokens[:, :8], cache_len=16, mutable=["cache"]
+            )
+            cache = st["cache"]
+            # the SP head gathers the sequence, so prefill logits are
+            # full-length just like the uncached forward's
+            err = jnp.max(jnp.abs(logits - full[:, :8]))
+            for pos in range(8, 16):
+                sl, upd = model.apply(
+                    {**variables, "cache": cache},
+                    tokens[:, pos : pos + 1],
+                    position_ids=jnp.full((1, 1), pos),
+                    decode_step=True,
+                    mutable=["cache"],
+                )
+                cache = upd["cache"]
+                err = jnp.maximum(
+                    err, jnp.max(jnp.abs(sl[:, 0] - full[:, pos]))
+                )
+            return jax.lax.pmax(err, "tp")
+
+        assert float(run(tokens)) < 2e-5
+
     def test_sp_matches_non_sp(self, rng):
         """Same per-rank params ⇒ identical losses with/without SP (the SP
         mappings are pure re-partitionings; ref mappings.py:213-272)."""
