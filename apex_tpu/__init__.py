@@ -20,6 +20,10 @@ Subpackage map (reference parity noted per module):
 - ``apex_tpu.contrib``      — contrib zoo parity (ref: apex/contrib)
 - ``apex_tpu.models``       — flagship models (GPT, BERT, ResNet) used by the
                               examples / benchmarks (ref: apex/examples, testing/standalone_*)
+- ``apex_tpu.resilience``   — training resilience: anomaly sentinel, in-memory
+                              rollback, checkpoint integrity manifests, fault
+                              injection (no reference equivalent; the recovery
+                              layer production pretraining needs)
 """
 
 import logging
@@ -81,6 +85,7 @@ from apex_tpu import fp16_utils  # noqa: E402
 from apex_tpu import normalization  # noqa: E402
 from apex_tpu import optimizers  # noqa: E402
 from apex_tpu import parallel  # noqa: E402
+from apex_tpu import resilience  # noqa: E402
 from apex_tpu import transformer  # noqa: E402
 
 __all__ = [
@@ -90,6 +95,7 @@ __all__ = [
     "normalization",
     "transformer",
     "parallel",
+    "resilience",
     "get_logger",
     "set_logging_level",
     "deprecated_warning",

@@ -103,20 +103,52 @@ class AmpOptimizer:
         return self.scaler.unscale(self._scaler_state(state, loss_id), grads_f32)
 
     def step(self, grads, state: AmpOptimizerState, params, found_inf_extra=None,
-             loss_id: int = 0):
+             loss_id: int = 0, sentinel=None, sentinel_state=None,
+             unscaled_loss=None):
         """One optimizer step: unscale, overflow-gate, update, recast.
 
         Returns (new_params, new_state, info) where info has ``found_inf``
         and ``loss_scale`` for logging parity with the reference's
         "Gradient overflow, skipping step" messages (amp/handle.py:128-154).
+
+        Resilience wiring (apex_tpu.resilience.sentinel): pass a
+        ``sentinel`` (AnomalySentinel), its ``sentinel_state``, and the
+        step's ``unscaled_loss`` to additionally gate the update on
+        loss-spike / non-finite-loss anomalies and run the post-update
+        non-finite-param check. The anomaly gate suppresses the update
+        through the same ``vma_cond`` as the overflow skip but does NOT
+        feed the scaler's dynamic schedule (a spike is not an overflow —
+        backing off the scale for it would only dull fp16 precision).
+        ``info`` then also carries ``sentinel_state`` (advanced) and
+        ``verdict`` (int32 code, see resilience.sentinel) for the host
+        loop to branch on.
         """
         grads_f32, found_inf = self.unscale_grads(grads, state, loss_id)
         if found_inf_extra is not None:
             found_inf = jnp.logical_or(found_inf, found_inf_extra)
-        return self.step_unscaled(grads_f32, state, params, {loss_id: found_inf})
+        gate_extra = None
+        if sentinel is not None:
+            if sentinel_state is None or unscaled_loss is None:
+                raise ValueError(
+                    "sentinel wiring needs sentinel_state and unscaled_loss"
+                )
+            gate_extra = sentinel.is_anomalous_loss(sentinel_state, unscaled_loss)
+        new_params, new_state, info = self.step_unscaled(
+            grads_f32, state, params, {loss_id: found_inf},
+            gate_extra=gate_extra,
+        )
+        if sentinel is not None:
+            new_sent, verdict = sentinel.update(
+                sentinel_state, unscaled_loss,
+                anomaly=info["skipped"],
+                bad_params=sentinel.check_params(new_params),
+            )
+            info["sentinel_state"] = new_sent
+            info["verdict"] = verdict
+        return new_params, new_state, info
 
     def step_unscaled(self, grads_f32, state: AmpOptimizerState, params,
-                      found_infs):
+                      found_infs, gate_extra=None):
         """Apply already-unscaled fp32 grads (the sum of one
         :meth:`unscale_grads` per contributing loss).
 
@@ -125,7 +157,11 @@ class AmpOptimizer:
         scaler's dynamic schedule advances with its OWN flag —
         non-contributing scalers are left untouched (reference semantics:
         every LossScaler adjusts only on its own backward,
-        scaler.py:197-217)."""
+        scaler.py:197-217).
+
+        ``gate_extra`` (bool scalar) additionally suppresses the update
+        WITHOUT touching any scaler schedule — the anomaly-sentinel hook
+        (see :meth:`step`)."""
         n = len(state.scaler) if isinstance(state.scaler, tuple) else 1
         bad = [i for i in found_infs if not 0 <= i < n]
         if bad or not found_infs:
@@ -137,6 +173,9 @@ class AmpOptimizer:
         found_inf = flags[0]
         for f in flags[1:]:
             found_inf = jnp.logical_or(found_inf, f)
+        gate = found_inf
+        if gate_extra is not None:
+            gate = jnp.logical_or(gate, jnp.asarray(gate_extra, bool))
 
         def do_step(operand):
             master, inner = operand
@@ -155,7 +194,7 @@ class AmpOptimizer:
         from apex_tpu.parallel.utils import vma_cond
 
         new_master, new_inner = vma_cond(
-            found_inf, skip_step, do_step, (state.master, state.inner)
+            gate, skip_step, do_step, (state.master, state.inner)
         )
         if isinstance(state.scaler, tuple):
             new_scaler = tuple(
@@ -176,7 +215,7 @@ class AmpOptimizer:
             )
         else:
             new_params = new_master
-        info = {"found_inf": found_inf, "loss_scale": scale_now}
+        info = {"found_inf": found_inf, "loss_scale": scale_now, "skipped": gate}
         return new_params, new_state, info
 
     # -- checkpointing parity (amp.state_dict, frontend.py:367-404) -------

@@ -65,7 +65,19 @@ def initialize_distributed(
     so the call is a no-op returning ``(process_count, process_index)``
     (jax's auto-detection would otherwise raise on a dev box).
     """
-    if jax.distributed.is_initialized():
+    # jax.distributed.is_initialized only exists on current jax; older
+    # builds expose the same fact through the global client handle
+    _is_init = getattr(jax.distributed, "is_initialized", None)
+    if _is_init is not None:
+        initialized = _is_init()
+    else:  # pre-0.5 jax: the global client handle is the same fact
+        try:
+            from jax._src.distributed import global_state
+
+            initialized = global_state.client is not None
+        except Exception:
+            initialized = False
+    if initialized:
         return jax.process_count(), jax.process_index()
     cluster_env = any(
         v in os.environ

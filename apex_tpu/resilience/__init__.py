@@ -1,0 +1,82 @@
+"""Training resilience: keep long runs alive through anomalies and faults.
+
+The package ties the repo's existing recovery *primitives* — dynamic loss
+scaling with hysteresis (``apex_tpu.amp.scaler``), orbax checkpointing
+(``apex_tpu.utils.checkpoint``), SIGTERM auto-resume with multi-host
+consensus (``apex_tpu.utils.autoresume``) — into a *policy* that survives
+loss spikes, NaN blowups, torn checkpoints, and repeated preemptions
+(the fault-tolerance layer TorchTitan-class trainers ship as table
+stakes; see PAPERS.md):
+
+- ``sentinel``  — jit-compatible anomaly monitor: extends the scaler's
+  ``found_inf`` check with EMA + z-score loss-spike detection and
+  non-finite *param* detection after the update; emits a structured
+  verdict (``OK | SKIP | ROLLBACK | HALT``) the step function branches
+  on with ``vma_cond`` so the whole step stays compiled.
+- ``rollback``  — host-side ring of the last K good states plus the
+  escalation policy (skip batch -> rollback + LR dampen -> halt) with
+  bounded retries and snapshot backoff, and the per-run anomaly log.
+- ``integrity`` — per-checkpoint manifest (structure hash, per-leaf
+  checksums, per-file digests; written last, so its presence is the
+  commit marker), verified restore that skips torn/corrupt step dirs,
+  ``keep_last_n`` retention, and save-retry-with-backoff.
+- ``chaos``     — deterministic fault injection for tests: NaN losses
+  at chosen steps, checkpoint truncation/bit-flips, simulated SIGTERM.
+
+End-to-end wiring: ``AmpOptimizer.step(..., sentinel=...)``,
+``AutoResume`` (verified restore + async-finalized saves + retention),
+and ``examples/gpt/pretrain_gpt.py`` (``--chaos-*`` flags). See
+docs/resilience.md.
+"""
+
+from apex_tpu.resilience.sentinel import (
+    AnomalySentinel,
+    SentinelState,
+    VERDICT_OK,
+    VERDICT_SKIP,
+    VERDICT_ROLLBACK,
+    VERDICT_HALT,
+    verdict_name,
+)
+from apex_tpu.resilience.rollback import (
+    EscalationPolicy,
+    ResilienceManager,
+    RollbackBuffer,
+)
+from apex_tpu.resilience.integrity import (
+    apply_retention,
+    load_checkpoint_verified,
+    manifest_path,
+    read_manifest,
+    save_checkpoint_verified,
+    save_with_retry,
+    tree_fingerprint,
+    verified_latest_step,
+    verify_checkpoint,
+    write_manifest,
+)
+from apex_tpu.resilience import chaos
+
+__all__ = [
+    "AnomalySentinel",
+    "SentinelState",
+    "VERDICT_OK",
+    "VERDICT_SKIP",
+    "VERDICT_ROLLBACK",
+    "VERDICT_HALT",
+    "verdict_name",
+    "EscalationPolicy",
+    "ResilienceManager",
+    "RollbackBuffer",
+    "apply_retention",
+    "load_checkpoint_verified",
+    "manifest_path",
+    "read_manifest",
+    "save_checkpoint_verified",
+    "save_with_retry",
+    "tree_fingerprint",
+    "verified_latest_step",
+    "verify_checkpoint",
+    "write_manifest",
+    "chaos",
+]

@@ -1,0 +1,169 @@
+"""Fault injection for deterministic recovery-path testing.
+
+Every recovery path in this package is only trustworthy if a test drives
+it on purpose. These utilities inject the three production failure modes
+on demand, deterministically, on the 8-device CPU test mesh:
+
+- **NaN losses/grads at chosen steps** — ``poison_loss`` is a
+  jit-compatible multiplicative poison (``loss * NaN`` when armed), so
+  both the loss value and every gradient flowing through it go
+  non-finite, exactly like a real overflow; the host arms it per step
+  through a ``FaultPlan``.
+- **Checkpoint corruption** — ``corrupt_checkpoint`` truncates or
+  bit-flips checkpoint payload files in place (seeded, reproducible),
+  simulating torn writes and disk rot that the integrity manifest must
+  catch.
+- **Preemption** — ``simulate_sigterm`` delivers a real SIGTERM to this
+  process, driving the actual AutoResume signal path, not a mock.
+
+``FaultPlan`` schedules all three by global step with consumed-once
+semantics: after a rollback re-winds the loop, the REPLAYED step runs
+clean — which is what makes the recovered trajectory comparable to an
+uninjected run in tests (persistent=True disables that for testing the
+halt path).
+"""
+
+import dataclasses
+import os
+import signal as _signal
+from typing import FrozenSet, Iterable, Optional, Set, Union
+
+import jax.numpy as jnp
+
+from apex_tpu.utils.checkpoint import finalized_steps
+
+
+def poison_loss(loss, armed):
+    """``loss * NaN`` when ``armed`` is truthy, identity otherwise.
+
+    Multiplicative (not additive: ``loss + NaN`` leaves the gradients
+    finite) and jit-compatible — ``armed`` may be a traced 0-d array, so
+    the injection step is an ordinary argument of the compiled train
+    step, not a recompile.
+    """
+    return loss * jnp.where(
+        jnp.asarray(armed, bool), jnp.float32(jnp.nan), jnp.float32(1.0)
+    )
+
+
+def parse_steps(spec: Union[str, Iterable[int], None]) -> FrozenSet[int]:
+    """Parse '3,7,10-12' (or any int iterable) into a step set."""
+    if spec is None:
+        return frozenset()
+    if isinstance(spec, str):
+        out: Set[int] = set()
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "-" in part:
+                lo, hi = part.split("-", 1)
+                out.update(range(int(lo), int(hi) + 1))
+            else:
+                out.add(int(part))
+        return frozenset(out)
+    return frozenset(int(s) for s in spec)
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Step-keyed fault schedule with consumed-once semantics.
+
+    ``nan_steps``: steps whose loss gets poisoned (see ``poison_loss``).
+    ``sigterm_steps``: steps after which a real SIGTERM is delivered.
+    ``persistent``: re-arm faults on replay (halt-path testing) instead
+    of the default fire-once behavior (recovery-path testing).
+    """
+
+    nan_steps: FrozenSet[int] = frozenset()
+    sigterm_steps: FrozenSet[int] = frozenset()
+    persistent: bool = False
+
+    def __post_init__(self):
+        self.nan_steps = parse_steps(self.nan_steps)
+        self.sigterm_steps = parse_steps(self.sigterm_steps)
+        self._fired_nan: Set[int] = set()
+        self._fired_sigterm: Set[int] = set()
+
+    def take_nan(self, step: int) -> float:
+        """1.0 if a NaN should poison this step's loss, else 0.0."""
+        step = int(step)
+        if step in self.nan_steps and (
+            self.persistent or step not in self._fired_nan
+        ):
+            self._fired_nan.add(step)
+            return 1.0
+        return 0.0
+
+    def maybe_sigterm(self, step: int) -> bool:
+        step = int(step)
+        if step in self.sigterm_steps and (
+            self.persistent or step not in self._fired_sigterm
+        ):
+            self._fired_sigterm.add(step)
+            simulate_sigterm()
+            return True
+        return False
+
+
+def simulate_sigterm() -> None:
+    """Deliver a real SIGTERM to this process (drives the actual
+    AutoResume handler, unlike setting its flag directly)."""
+    os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def _payload_files(step_dir: str):
+    """Checkpoint payload files, largest first (stable tiebreak on name).
+
+    Metadata files are tiny; the array payload dominates, so "largest
+    first" deterministically targets real tensor bytes.
+    """
+    files = []
+    for root, _, names in os.walk(step_dir):
+        for n in names:
+            p = os.path.join(root, n)
+            files.append((-os.path.getsize(p), os.path.relpath(p, step_dir), p))
+    files.sort()
+    return [p for _, _, p in files]
+
+
+def corrupt_checkpoint(step_dir: str, mode: str = "bitflip", seed: int = 0) -> str:
+    """Corrupt a checkpoint directory in place; returns the file touched.
+
+    ``bitflip``: XOR one byte (position seeded) in the largest payload
+    file — silent disk rot. ``truncate``: cut that file to half — a torn
+    write on a non-atomic backend. Both leave the directory structure
+    intact, so only content verification (the manifest) can catch them.
+    """
+    files = _payload_files(step_dir)
+    if not files:
+        raise FileNotFoundError(f"no files to corrupt under {step_dir}")
+    target = files[seed % len(files)]
+    size = os.path.getsize(target)
+    if mode == "truncate":
+        with open(target, "rb+") as f:
+            f.truncate(max(size // 2, 0))
+    elif mode == "bitflip":
+        if size == 0:
+            raise ValueError(f"cannot bit-flip empty file {target}")
+        pos = (seed * 2654435761 + size // 2) % size
+        with open(target, "rb+") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0x40]))
+    else:
+        raise ValueError(f"unknown corruption mode: {mode!r}")
+    return target
+
+
+def corrupt_latest_checkpoint(
+    directory: str, mode: str = "bitflip", seed: int = 0
+) -> Optional[str]:
+    """Corrupt the NEWEST finalized step dir; returns it (None if empty)."""
+    steps = finalized_steps(directory)
+    if not steps:
+        return None
+    step_dir = os.path.join(os.path.abspath(directory), f"step_{steps[-1]}")
+    corrupt_checkpoint(step_dir, mode=mode, seed=seed)
+    return step_dir

@@ -1,0 +1,213 @@
+"""Anomaly sentinel: jit-compatible training-health monitor.
+
+Extends the loss scaler's ``found_inf`` overflow check (amp/scaler.py) to
+the anomalies a scaler cannot see:
+
+- **loss spikes**: an EMA of the loss and an EMA of its squared deviation
+  give a running z-score; a finite but wildly out-of-distribution loss
+  (data corruption, LR instability) flags before it poisons the run;
+- **non-finite loss**: NaN/Inf loss even when every grad is finite
+  (e.g. an overflowing reduction in the loss itself);
+- **non-finite params after the update**: the last line of defense — if
+  corruption reached the weights, skipping the next batch cannot help;
+  only a rollback (or halt) recovers.
+
+Everything is pure pytree-in/pytree-out jnp so the monitor lives INSIDE
+the jitted train step; the step gates its optimizer update on
+``is_anomalous_loss`` with the same ``vma_cond`` machinery AmpOptimizer
+already uses, and the host reads one int32 verdict per step:
+
+    0 OK        clean step, update applied
+    1 SKIP      anomalous batch, update was suppressed; keep going
+    2 ROLLBACK  state is (or repeatedly risks being) corrupt; restore a
+                known-good snapshot (resilience.rollback)
+    3 HALT      anomaly persisted past every budget; checkpoint and stop
+
+Escalation between SKIP / ROLLBACK / HALT is driven by the in-state
+``consecutive`` anomaly counter against the configured budgets, so the
+verdict is deterministic and replayable. Host-side bounded retries and
+backoff live in ``resilience.rollback.EscalationPolicy``.
+"""
+
+from typing import Any, Optional, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.pytree import tree_any_non_finite
+
+VERDICT_OK = 0
+VERDICT_SKIP = 1
+VERDICT_ROLLBACK = 2
+VERDICT_HALT = 3
+
+_NAMES = {
+    VERDICT_OK: "ok",
+    VERDICT_SKIP: "skip",
+    VERDICT_ROLLBACK: "rollback",
+    VERDICT_HALT: "halt",
+}
+
+
+def verdict_name(verdict) -> str:
+    """Human name for a verdict code (accepts int or 0-d array)."""
+    return _NAMES.get(int(verdict), f"unknown({int(verdict)})")
+
+
+@flax.struct.dataclass
+class SentinelState:
+    ema: jax.Array          # f32: EMA of the (unscaled) loss
+    var: jax.Array          # f32: EMA of squared deviation from the EMA
+    count: jax.Array        # i32: clean steps folded into the EMA
+    consecutive: jax.Array  # i32: consecutive anomalous steps
+    anomalies: jax.Array    # i32: total anomalous steps this run
+
+
+class AnomalySentinel:
+    """Stateless config over :class:`SentinelState` (scaler.py pattern).
+
+    Args:
+        ema_decay: smoothing for the loss EMA/variance (0.98 ~ 50-step
+            memory).
+        z_threshold: flag a finite loss more than this many running
+            standard deviations ABOVE the EMA (one-sided: a falling loss
+            is what training is for).
+        warmup_steps: no spike verdicts until this many clean losses have
+            been folded in — the early variance estimate is garbage.
+        skip_budget: consecutive anomalies answered with SKIP before
+            escalating to ROLLBACK. 0 escalates immediately.
+        rollback_budget: further consecutive anomalies answered with
+            ROLLBACK before escalating to HALT.
+        min_spike_loss: absolute floor — a loss below this never counts
+            as a spike regardless of z-score (guards the tail of training
+            where var collapses and tiny wiggles get huge z).
+    """
+
+    def __init__(
+        self,
+        ema_decay: float = 0.98,
+        z_threshold: float = 6.0,
+        warmup_steps: int = 20,
+        skip_budget: int = 2,
+        rollback_budget: int = 2,
+        min_spike_loss: float = 0.0,
+        eps: float = 1e-12,
+    ):
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        if skip_budget < 0 or rollback_budget < 0:
+            raise ValueError("budgets must be >= 0")
+        self.ema_decay = float(ema_decay)
+        self.z_threshold = float(z_threshold)
+        self.warmup_steps = int(warmup_steps)
+        self.skip_budget = int(skip_budget)
+        self.rollback_budget = int(rollback_budget)
+        self.min_spike_loss = float(min_spike_loss)
+        self.eps = float(eps)
+
+    def init(self) -> SentinelState:
+        return SentinelState(
+            ema=jnp.asarray(0.0, jnp.float32),
+            var=jnp.asarray(0.0, jnp.float32),
+            count=jnp.asarray(0, jnp.int32),
+            consecutive=jnp.asarray(0, jnp.int32),
+            anomalies=jnp.asarray(0, jnp.int32),
+        )
+
+    # -- in-step checks (pure, call under jit) -----------------------------
+
+    def is_anomalous_loss(self, state: SentinelState, loss) -> jax.Array:
+        """Bool scalar: is this (unscaled) loss non-finite or a spike?
+
+        Gate the optimizer update on ``found_inf | is_anomalous_loss`` —
+        the spike check costs two FLOPs, not a pytree reduction. Pass the
+        UNSCALED loss: the dynamic scale moves over time, so an EMA over
+        scaled losses self-triggers on every scale change.
+        """
+        loss = jnp.asarray(loss, jnp.float32)
+        nonfinite = jnp.logical_not(jnp.isfinite(loss))
+        z = (loss - state.ema) * jax.lax.rsqrt(state.var + self.eps)
+        spike = jnp.logical_and(
+            state.count >= self.warmup_steps,
+            jnp.logical_and(z > self.z_threshold, loss > self.min_spike_loss),
+        )
+        return jnp.logical_or(nonfinite, spike)
+
+    def update(
+        self,
+        state: SentinelState,
+        loss,
+        anomaly,
+        bad_params=False,
+    ) -> Tuple[SentinelState, jax.Array]:
+        """Advance sentinel state; returns ``(new_state, verdict)``.
+
+        ``anomaly`` is the flag the step actually gated its update on
+        (``found_inf | is_anomalous_loss``) so the statistics agree with
+        what the optimizer did; ``bad_params`` is non-finiteness of the
+        POST-update params (see :meth:`check_params`) and forces the
+        verdict to at least ROLLBACK — corrupted weights cannot be
+        skipped away.
+        """
+        loss = jnp.asarray(loss, jnp.float32)
+        anomaly = jnp.logical_or(
+            jnp.asarray(anomaly, bool), jnp.asarray(bad_params, bool)
+        )
+        d = self.ema_decay
+        # seed the EMA with the first clean loss; never fold anomalous
+        # losses in (a NaN would stick forever, a spike would widen var
+        # and mask the next spike)
+        first = state.count == 0
+        ema_clean = jnp.where(first, loss, d * state.ema + (1.0 - d) * loss)
+        dev = loss - state.ema
+        var_clean = jnp.where(first, 0.0, d * state.var + (1.0 - d) * dev * dev)
+        clean = jnp.logical_not(anomaly)
+        new_state = SentinelState(
+            ema=jnp.where(clean, ema_clean, state.ema),
+            var=jnp.where(clean, var_clean, state.var),
+            count=jnp.where(clean, state.count + 1, state.count),
+            consecutive=jnp.where(anomaly, state.consecutive + 1, 0),
+            anomalies=state.anomalies + jnp.asarray(anomaly, jnp.int32),
+        )
+        consec = new_state.consecutive
+        escalated = jnp.where(
+            consec <= self.skip_budget,
+            VERDICT_SKIP,
+            jnp.where(
+                consec <= self.skip_budget + self.rollback_budget,
+                VERDICT_ROLLBACK,
+                VERDICT_HALT,
+            ),
+        )
+        verdict = jnp.where(anomaly, escalated, VERDICT_OK)
+        verdict = jnp.where(
+            jnp.asarray(bad_params, bool),
+            jnp.maximum(verdict, VERDICT_ROLLBACK),
+            verdict,
+        )
+        return new_state, jnp.asarray(verdict, jnp.int32)
+
+    def check_params(self, params: Any) -> jax.Array:
+        """Bool scalar: any non-finite leaf in the post-update params.
+
+        One fused ``isfinite`` reduction over the pytree (same kernel
+        shape as the scaler's overflow check) — cheap next to a step.
+        """
+        return tree_any_non_finite(params)
+
+    def check(
+        self,
+        state: SentinelState,
+        loss,
+        found_inf=False,
+        params: Optional[Any] = None,
+    ) -> Tuple[SentinelState, jax.Array]:
+        """One-call form for steps that do not gate on the spike check:
+        combines :meth:`is_anomalous_loss`, the caller's ``found_inf``,
+        and (optionally) :meth:`check_params` into the verdict."""
+        anomaly = jnp.logical_or(
+            jnp.asarray(found_inf, bool), self.is_anomalous_loss(state, loss)
+        )
+        bad = self.check_params(params) if params is not None else False
+        return self.update(state, loss, anomaly, bad_params=bad)

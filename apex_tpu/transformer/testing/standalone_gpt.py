@@ -22,7 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.gpt_pipeline import build_gpt_pipeline

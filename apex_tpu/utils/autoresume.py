@@ -29,6 +29,7 @@ The consensus collective costs one scalar all-reduce per *polled* step;
 poll every step (it is negligible next to a train step) or at a cadence.
 """
 
+import logging
 import os
 import signal as _signal
 from typing import Any, Optional, Sequence, Tuple
@@ -38,12 +39,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from apex_tpu.utils.checkpoint import (
+    AsyncCheckpointWriter,
     latest_step,
     load_checkpoint,
-    save_checkpoint,
 )
 
 __all__ = ["AutoResume"]
+
+logger = logging.getLogger("apex_tpu.utils.autoresume")
 
 
 class AutoResume:
@@ -62,6 +65,17 @@ class AutoResume:
     as the ``get_adlr_autoresume()`` global in the testing harness — it
     implements ``termination_requested()`` and ``request_resume()`` with
     the reference's polling contract.
+
+    Durability & integrity (resilience.integrity wiring):
+
+    - interval saves are ASYNC (the next train step overlaps the write);
+      each is finalized — ``wait()`` + checksum-manifest commit + optional
+      ``keep_last_n`` retention — before the next save is issued, or
+      explicitly via :meth:`finalize` / :meth:`close`;
+    - a TERMINATION save is finalized before ``step()`` returns True, so
+      "saved, you may exit" is never claimed for bytes still in flight;
+    - ``restore()`` skips torn or corrupt step directories (manifest
+      verification) and falls back to the newest verified checkpoint.
     """
 
     def __init__(
@@ -70,16 +84,103 @@ class AutoResume:
         interval: Optional[int] = None,
         signals: Sequence[int] = (_signal.SIGTERM,),
         install_handlers: bool = True,
+        keep_last_n: Optional[int] = None,
+        use_async: bool = True,
+        verify: bool = True,
+        save_retries: int = 3,
+        save_backoff: float = 0.1,
+        leaf_fingerprint: bool = True,
     ):
         self.directory = os.path.abspath(directory)
         self.interval = interval
+        self.keep_last_n = keep_last_n
+        self.use_async = use_async
+        self.verify = verify
+        self.save_retries = save_retries
+        self.save_backoff = save_backoff
+        # per-leaf crc32 fingerprints enable restore-time deep verification
+        # but cost a synchronous full-state device->host copy per save; the
+        # manifest's per-file digests (computed at finalize, off the saved
+        # bytes) still catch disk corruption with this off
+        self.leaf_fingerprint = leaf_fingerprint
         self._requested = False
         self._saved_for_termination = False
         self._prev_handlers = {}
         self._consensus = None  # lazily-built (sharding, jitted max) pair
+        self._writer: Optional[AsyncCheckpointWriter] = None
+        # (step, fingerprint) of an async save whose manifest is not yet
+        # committed — finalized before the next save / restore / close,
+        # and IMMEDIATELY for a termination save (durability claim)
+        self._pending: Optional[Tuple[int, Optional[dict]]] = None
         if install_handlers:
             for sig in signals:
                 self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
+
+    # -- checkpoint IO -----------------------------------------------------
+
+    def _integrity(self):
+        # lazy: apex_tpu.resilience imports this module's package
+        from apex_tpu.resilience import integrity
+
+        return integrity
+
+    def finalize(self) -> None:
+        """Block until every issued save is durable AND committed.
+
+        ``AsyncCheckpointWriter.wait()``-style finalization plus the
+        integrity manifest (the commit marker) and retention sweep. A
+        save is only as durable as this call — ``step()`` performs it
+        before reporting a termination save, and interval saves are
+        finalized before the next save is issued (one step of overlap).
+        """
+        if self._pending is None:
+            return
+        step, fingerprint = self._pending
+        self._writer.wait()
+        if jax.process_index() == 0:
+            integrity = self._integrity()
+            # retried, and _pending is only cleared on success: a transient
+            # manifest-write failure is re-attempted at the next finalize
+            # point instead of silently losing the commit marker
+            integrity.save_with_retry(
+                lambda: integrity.write_manifest(
+                    os.path.join(self.directory, f"step_{step}"),
+                    fingerprint=fingerprint,
+                ),
+                retries=self.save_retries, backoff=self.save_backoff,
+            )
+            if self.keep_last_n is not None:
+                integrity.apply_retention(self.directory, self.keep_last_n)
+        self._pending = None
+
+    def _save(self, step: int, state: Any, durable: bool) -> None:
+        integrity = self._integrity()
+        if not self.use_async:
+            integrity.save_checkpoint_verified(
+                self.directory, step, state,
+                retries=self.save_retries, backoff=self.save_backoff,
+                keep_last_n=self.keep_last_n if jax.process_index() == 0 else None,
+            )
+            return
+        self.finalize()  # previous pending save first (ordering + bounded lag)
+        if self._writer is None:
+            self._writer = AsyncCheckpointWriter()
+        # fingerprint NOW: the caller may donate/mutate these buffers the
+        # moment step() returns, and the manifest commits later
+        fingerprint = (
+            integrity.tree_fingerprint(state) if self.leaf_fingerprint else None
+        )
+        # the retry covers save ISSUANCE (snapshot-to-host + handoff); an
+        # error in the background write itself surfaces un-retried at the
+        # next finalize()'s wait() — by then the source buffers may be
+        # donated, so there is nothing left to re-save from
+        integrity.save_with_retry(
+            lambda: self._writer.save(self.directory, step, state),
+            retries=self.save_retries, backoff=self.save_backoff,
+        )
+        self._pending = (step, fingerprint)
+        if durable:
+            self.finalize()
 
     # -- signal plumbing ---------------------------------------------------
 
@@ -89,7 +190,11 @@ class AutoResume:
         self._requested = True
 
     def close(self):
-        """Restore previously-installed signal handlers."""
+        """Finalize pending saves and restore previous signal handlers."""
+        self.finalize()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
         for sig, h in self._prev_handlers.items():
             _signal.signal(sig, h)
         self._prev_handlers = {}
@@ -148,22 +253,41 @@ class AutoResume:
         """
         terminating = self.termination_requested()
         if terminating and not self._saved_for_termination:
-            save_checkpoint(self.directory, step, state)
+            # durable=True: wait for the write AND commit the manifest
+            # BEFORE telling the caller it may exit — an exit on an
+            # un-finalized async save is exactly the torn checkpoint this
+            # machinery exists to prevent
+            self._save(step, state, durable=True)
             self._saved_for_termination = True
             return True
         if terminating:
             return True
         if self.interval and step % self.interval == 0:
-            save_checkpoint(self.directory, step, state)
+            self._save(step, state, durable=False)
         return False
 
     def restore(self, init_state: Any) -> Tuple[int, Any]:
-        """(step, state): latest checkpoint if one exists, else (0, init).
+        """(step, state): newest RESTORABLE checkpoint, else (0, init).
 
         ``init_state`` also serves as the restore target so dtypes and
         shardings round-trip exactly (see utils/checkpoint.py).
+
+        With ``verify=True`` (default) restoration walks step dirs
+        newest-first, checks each integrity manifest, and falls back past
+        torn / bit-flipped / uncommitted checkpoints to the newest step
+        that verifies (pre-manifest legacy checkpoints are accepted, as
+        their corruption is undetectable). ``verify=False`` restores the
+        raw latest step and lets corruption crash the run.
         """
-        step = latest_step(self.directory)
-        if step is None:
+        self.finalize()
+        if not self.verify:
+            step = latest_step(self.directory)
+            if step is None:
+                return 0, init_state
+            return step, load_checkpoint(self.directory, step, target=init_state)
+        try:
+            return self._integrity().load_checkpoint_verified(
+                self.directory, target=init_state, allow_unverified=True
+            )
+        except FileNotFoundError:
             return 0, init_state
-        return step, load_checkpoint(self.directory, step, target=init_state)

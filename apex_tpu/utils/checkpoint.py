@@ -116,12 +116,50 @@ class AsyncCheckpointWriter:
         return False
 
 
-def latest_step(directory: str) -> Optional[int]:
+# an in-progress (not yet atomically renamed) orbax save lives at
+# "<name>.orbax-checkpoint-tmp-<ts>"; it must never be offered for restore
+ORBAX_TMP_MARKER = ".orbax-checkpoint-tmp-"
+
+
+def _is_complete_step_dir(path: str) -> bool:
+    """Reject step directories that are still being (or were never fully)
+    written: orbax tmp names from an interrupted async save, and empty or
+    file-typed ``step_N`` entries from a torn copy / non-atomic backend
+    (the GCS-style layout where the final name exists before the commit
+    marker lands). Content-level corruption needs the checksum manifest
+    (resilience.integrity.verify_checkpoint) — this is the cheap gate
+    every ``latest_step`` caller gets for free."""
+    if ORBAX_TMP_MARKER in os.path.basename(path):
+        return False
+    if not os.path.isdir(path):
+        return False
+    try:
+        return bool(os.listdir(path))
+    except OSError:
+        return False
+
+
+def finalized_steps(directory: str) -> list:
+    """Ascending step numbers of complete ``step_N`` dirs in ``directory``.
+
+    A crash during an async save used to leave the torn directory where
+    the next ``restore()`` would pick it up; in-progress/tmp and empty
+    step dirs are excluded here (see ``_is_complete_step_dir``).
+    """
     if not os.path.isdir(directory):
-        return None
-    steps = [
-        int(d.split("_", 1)[1])
-        for d in os.listdir(directory)
-        if d.startswith("step_") and d.split("_", 1)[1].isdigit()
-    ]
-    return max(steps) if steps else None
+        return []
+    steps = []
+    for d in os.listdir(directory):
+        if not d.startswith("step_"):
+            continue
+        tail = d.split("_", 1)[1]
+        if not tail.isdigit():
+            continue
+        if _is_complete_step_dir(os.path.join(directory, d)):
+            steps.append(int(tail))
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = finalized_steps(directory)
+    return steps[-1] if steps else None

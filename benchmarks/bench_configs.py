@@ -110,7 +110,7 @@ def bench_dp_syncbn(tpu):
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import shard_map
+    from apex_tpu.compat import shard_map
     from jax.sharding import Mesh, PartitionSpec as P
 
     from apex_tpu.models import cross_entropy_loss
@@ -228,7 +228,7 @@ def bench_gpt_tp(tpu, force_tp=None):
     from apex_tpu.parallel import parallel_state
     from apex_tpu.transformer import TransformerConfig
 
-    from jax import shard_map
+    from apex_tpu.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_dev = len(jax.devices())

@@ -35,7 +35,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.parallel.pipeline import (

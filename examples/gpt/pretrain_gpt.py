@@ -6,6 +6,20 @@ trained from a memory-mapped token corpus through the native data path
 (apex_tpu.data), Megatron-style tensor/sequence parallelism over a mesh,
 FusedAdam, dynamic loss scaling, named timers, and orbax checkpoints.
 
+Resilience (apex_tpu.resilience, docs/resilience.md): the step carries an
+anomaly-sentinel state next to the scaler state; loss spikes / NaNs gate
+the update inside the compiled step, and the host escalates skip ->
+rollback (in-memory snapshot ring + data-iterator rewind + LR dampen) ->
+halt-and-checkpoint. Checkpoints are manifest-verified; restore falls
+back past torn or bit-flipped step dirs. ``--chaos-*`` flags inject all
+three fault classes so the whole recovery ladder is drivable from the
+command line:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
+    python examples/gpt/pretrain_gpt.py --steps 12 --hidden 64 --layers 2 \\
+        --seq-len 64 --micro-batch 2 --global-batch 16 --save /tmp/ck \\
+        --save-interval 4 --chaos-nan-steps 5 --chaos-sigterm-step 9
+
 CPU smoke (8 virtual devices, synthetic corpus):
 
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
@@ -21,7 +35,7 @@ import tempfile
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 
@@ -44,7 +58,36 @@ def parse_args():
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--save", default=None, help="checkpoint directory")
     p.add_argument("--save-interval", type=int, default=100)
+    p.add_argument("--keep-last-n", type=int, default=None,
+                   help="checkpoint retention: keep only the newest N steps")
     p.add_argument("--seed", type=int, default=0)
+    # resilience policy (apex_tpu.resilience; docs/resilience.md)
+    p.add_argument("--spike-z", type=float, default=6.0,
+                   help="loss z-score above the running EMA that counts as a spike")
+    p.add_argument("--spike-warmup", type=int, default=10,
+                   help="clean steps before spike detection arms")
+    p.add_argument("--skip-budget", type=int, default=1,
+                   help="consecutive anomalies answered by skipping the batch")
+    p.add_argument("--rollback-budget", type=int, default=2,
+                   help="further consecutive anomalies answered by rollback")
+    p.add_argument("--snapshot-interval", type=int, default=10,
+                   help="steps between in-memory rollback snapshots")
+    p.add_argument("--snapshot-capacity", type=int, default=2,
+                   help="rollback snapshots kept in host RAM")
+    p.add_argument("--max-rollbacks", type=int, default=3,
+                   help="rollbacks per run before halting")
+    p.add_argument("--lr-dampen", type=float, default=0.5,
+                   help="lr_scale multiplier applied on each rollback")
+    p.add_argument("--anomaly-log", default=None,
+                   help="jsonl anomaly log (default: <save>/anomalies.jsonl)")
+    # fault injection (apex_tpu.resilience.chaos) — for tests and drills
+    p.add_argument("--chaos-nan-steps", default="",
+                   help="comma/range list of steps whose loss is NaN-poisoned")
+    p.add_argument("--chaos-sigterm-step", type=int, default=None,
+                   help="deliver a real SIGTERM after this step")
+    p.add_argument("--chaos-corrupt-latest", default="none",
+                   choices=["none", "bitflip", "truncate"],
+                   help="corrupt the newest checkpoint BEFORE restoring")
     return p.parse_args()
 
 
@@ -68,8 +111,12 @@ def main():
     from apex_tpu.optimizers import fused_adam
     from apex_tpu.parallel import parallel_state
     from apex_tpu.parallel.ddp import all_reduce_gradients
+    from apex_tpu.parallel.utils import vma_cond
     from apex_tpu.transformer import TransformerConfig
     from apex_tpu.utils import AutoResume, Timers
+    from apex_tpu.utils.pytree import tree_any_non_finite
+    from apex_tpu import resilience
+    from apex_tpu.resilience import chaos
 
     import optax
 
@@ -105,32 +152,55 @@ def main():
 
     opt = fused_adam(lr=args.lr, weight_decay=0.01)
     scaler = GradScaler(loss_scale="dynamic")
+    sentinel = resilience.AnomalySentinel(
+        z_threshold=args.spike_z,
+        warmup_steps=args.spike_warmup,
+        skip_budget=args.skip_budget,
+        rollback_budget=args.rollback_budget,
+    )
 
-    # donated carried state: params/opt/scaler buffers are reused in place
-    # across the Python step loop instead of double-buffering the full
-    # parameter set in HBM (the torch reference mutates in place for free;
-    # under jit, donation is the explicit equivalent)
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    # donated carried state: params/opt/scaler/sentinel buffers are reused
+    # in place across the Python step loop instead of double-buffering the
+    # full parameter set in HBM (the torch reference mutates in place for
+    # free; under jit, donation is the explicit equivalent)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, "dp"), P(None, "dp")),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(), P(None, "dp"), P(None, "dp"), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    def train_step(params, opt_state, scaler_state, tokens, labels):
+    def train_step(params, opt_state, scaler_state, sent_state, tokens,
+                   labels, inject_nan, lr_scale):
         # tokens: (num_micro, micro*dp, seq) -> this dp shard's microbatches
         def micro_loss(p, tok, lab):
             return gpt_loss_fn(model.apply(p, tok, labels=lab))
 
         def scaled_total(p):
             losses = jax.vmap(lambda t, l: micro_loss(p, t, l))(tokens, labels)
-            return scaler.scale(scaler_state, jnp.mean(losses))
+            # multiplicative NaN poison (chaos harness): both the loss and
+            # every grad through it go non-finite, like a real blowup
+            return chaos.poison_loss(
+                scaler.scale(scaler_state, jnp.mean(losses)), inject_nan
+            )
 
         loss, grads = jax.value_and_grad(scaled_total)(params)
         grads = all_reduce_gradients(grads, axis_name="dp")
         grads, found_inf = scaler.unscale(scaler_state, grads)
+        # the scaler's dynamic schedule reacts to true overflow only; the
+        # sentinel's spike gate must NOT halve the scale (a spike is not a
+        # precision problem)
         new_scaler_state = scaler.update(scaler_state, found_inf)
+
+        # the loss is tp-replicated even under SP: model.apply gathers the
+        # sequence before the head and vocab_parallel_cross_entropy psums
+        # over tp internally — only the dp average is needed (verified
+        # empirically: tp=2 SP and non-SP local losses are identical)
+        unscaled = jax.lax.pmean(loss / scaler_state.scale, "dp")
+        gate = jnp.logical_or(
+            found_inf, sentinel.is_anomalous_loss(sent_state, unscaled)
+        )
 
         # the skip must gate the OPTIMIZER STATE too: opt.update on inf
         # grads would fold inf into the Adam moments permanently (m =
@@ -138,17 +208,19 @@ def main():
         # backs off — same both-or-neither rule as AmpOptimizer.step
         def apply():
             updates, new_opt = opt.update(grads, opt_state, params)
+            # rollback escalation dampens the effective LR through here
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
             return optax.apply_updates(params, updates), new_opt
 
-        new_params, new_opt_state = jax.lax.cond(
-            found_inf, lambda: (params, opt_state), apply
+        new_params, new_opt_state = vma_cond(
+            gate, lambda: (params, opt_state), apply
         )
-        # the loss is tp-replicated even under SP: model.apply gathers the
-        # sequence before the head and vocab_parallel_cross_entropy psums
-        # over tp internally — only the dp average is needed (verified
-        # empirically: tp=2 SP and non-SP local losses are identical)
-        unscaled = jax.lax.pmean(loss / scaler_state.scale, "dp")
-        return new_params, new_opt_state, new_scaler_state, unscaled
+        new_sent_state, verdict = sentinel.update(
+            sent_state, unscaled, anomaly=gate,
+            bad_params=tree_any_non_finite(new_params),
+        )
+        return (new_params, new_opt_state, new_scaler_state, new_sent_state,
+                unscaled, verdict)
 
     # tp-sharded init must run under the mesh like the step
     @functools.partial(
@@ -166,17 +238,32 @@ def main():
     replicated = jax.sharding.NamedSharding(mesh, P())
     opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
     scaler_state = jax.device_put(scaler.init(), replicated)
+    sent_state = jax.device_put(sentinel.init(), replicated)
+
+    # chaos drill: corrupt the newest checkpoint BEFORE restore — the
+    # verified restore must fall back to the previous intact step
+    if args.save and args.chaos_corrupt_latest != "none":
+        touched = chaos.corrupt_latest_checkpoint(
+            args.save, mode=args.chaos_corrupt_latest
+        )
+        if touched:
+            print(f"[chaos] corrupted newest checkpoint: {touched}")
 
     # --save enables BOTH periodic checkpoints and preemption-safe exit:
     # SIGTERM (preemptible TPU VMs send it before eviction) checkpoints the
     # current step and breaks the loop; a rerun with the same --save dir
-    # resumes.
-    ar = AutoResume(args.save, interval=args.save_interval) if args.save else None
+    # resumes — from the newest CHECKSUM-VERIFIED step (torn/corrupt step
+    # dirs are skipped; see apex_tpu.resilience.integrity).
+    ar = (
+        AutoResume(args.save, interval=args.save_interval,
+                   keep_last_n=args.keep_last_n)
+        if args.save else None
+    )
     step0 = 0
     if ar is not None:
         try:
-            step0, (params, opt_state, scaler_state) = ar.restore(
-                (params, opt_state, scaler_state)
+            step0, (params, opt_state, scaler_state, sent_state) = ar.restore(
+                (params, opt_state, scaler_state, sent_state)
             )
         except ValueError as e:
             # a --save dir written by an older payload layout: train fresh
@@ -186,41 +273,108 @@ def main():
         if step0:
             print(f"resumed from step {step0}")
 
-    # the sampler's own resume mechanism picks the data stream up exactly
-    # where the saved run left off
-    sampler = MegatronPretrainingSampler(
-        total_samples=len(lm),
-        consumed_samples=step0 * args.global_batch,
-        local_minibatch_size=args.global_batch,  # host-level batch; dp
-        data_parallel_rank=0,                    # sharding happens on device
-        data_parallel_size=1,
+    # host half of the resilience loop: snapshot ring + escalation policy
+    # (skip -> rollback + LR dampen -> halt) + per-run anomaly log
+    mgr = resilience.ResilienceManager(
+        buffer=resilience.RollbackBuffer(
+            capacity=args.snapshot_capacity, interval=args.snapshot_interval
+        ),
+        policy=resilience.EscalationPolicy(
+            max_rollbacks=args.max_rollbacks, lr_dampen=args.lr_dampen
+        ),
+        log_path=args.anomaly_log
+        or (os.path.join(args.save, "anomalies.jsonl") if args.save else None),
+    )
+    plan = chaos.FaultPlan(
+        nan_steps=args.chaos_nan_steps,
+        sigterm_steps=(
+            {args.chaos_sigterm_step}
+            if args.chaos_sigterm_step is not None else frozenset()
+        ),
     )
 
+    # the sampler's own resume mechanism picks the data stream up exactly
+    # where the saved (or rolled-back-to) run left off
+    def make_iter(start_step):
+        return iter(MegatronPretrainingSampler(
+            total_samples=len(lm),
+            consumed_samples=start_step * args.global_batch,
+            local_minibatch_size=args.global_batch,  # host batch; dp shards
+            data_parallel_rank=0,                    # on device
+            data_parallel_size=1,
+        ))
+
     timers = Timers()
-    it = iter(sampler)
+    it = make_iter(step0)
+    # seed the ring so an anomaly before the first cadence point can still
+    # roll back instead of escalating straight to halt
+    mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
     steps_run = 0
-    for step_i in range(step0, args.steps):
+    step_i = step0
+    while step_i < args.steps:
         idx = next(it)
         x, y = lm.batch(idx)
         x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         timers("step").start()
-        params, opt_state, scaler_state, loss = train_step(
-            params, opt_state, scaler_state, jnp.asarray(x), jnp.asarray(y)
+        params, opt_state, scaler_state, sent_state, loss, verdict = train_step(
+            params, opt_state, scaler_state, sent_state,
+            jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(plan.take_nan(step_i), jnp.float32),
+            jnp.asarray(mgr.lr_scale, jnp.float32),
         )
         timers("step").stop(barrier_on=loss)
         steps_run += 1
+        state = (params, opt_state, scaler_state, sent_state)
+        action = mgr.resolve(step_i, int(verdict), loss=float(loss))
+        if action == "halt":
+            # save the newest KNOWN-GOOD state, not the possibly-corrupt
+            # live one, then stop: the anomaly outlived every budget
+            good_step, good_state = (
+                mgr.buffer.rollback() if len(mgr.buffer) else (step_i, state)
+            )
+            if args.save:
+                if ar is not None:
+                    # an interval save may still be in flight to the same
+                    # directory; finalize it before writing (its retention
+                    # sweep would otherwise race the async write's tmp dir)
+                    ar.finalize()
+                resilience.save_checkpoint_verified(
+                    args.save, good_step, good_state,
+                    keep_last_n=args.keep_last_n,
+                )
+            print(f"halting at step {step_i}: anomaly persisted; "
+                  f"checkpointed known-good step {good_step}")
+            break
+        if action == "rollback":
+            step_i, (params, opt_state, scaler_state, sent_state) = (
+                mgr.do_rollback()
+            )
+            it = make_iter(step_i)
+            print(f"rolled back to step {step_i} "
+                  f"(lr_scale {mgr.lr_scale:.3f})")
+            continue
+        if action == "skip":
+            print(f"anomalous step {step_i}: update skipped "
+                  f"(loss {float(loss):.4f})")
+        else:
+            mgr.observe_good(step_i + 1, state)
         if step_i % 5 == 0 or step_i == args.steps - 1:
             print(
                 f"step {step_i:5d} loss {float(loss):8.4f} "
                 f"scale {float(scaler_state.scale):9.1f}"
             )
-        if ar is not None and ar.step(
-            step_i + 1, (params, opt_state, scaler_state)
-        ):
+        plan.maybe_sigterm(step_i)
+        if ar is not None and ar.step(step_i + 1, state):
             print(f"termination checkpoint at step {step_i + 1}; exiting")
             break
+        step_i += 1
     timers.log(["step"], normalizer=max(1, steps_run))
+    if mgr.events:
+        print(f"anomalies this run: {len(mgr.events)} "
+              f"(rollbacks {mgr.rollbacks_used}, lr_scale {mgr.lr_scale:.3f})")
+    if ar is not None:
+        ar.close()  # finalize any in-flight interval save (manifest commit)
 
 
 if __name__ == "__main__":

@@ -29,7 +29,7 @@ sys.path.insert(0, os.path.join(
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.parallel.ddp import all_reduce_gradients

@@ -28,6 +28,7 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu import amp
+from apex_tpu.compat import shard_map
 from apex_tpu.models.resnet import BasicBlock, ResNet, cross_entropy_loss
 from apex_tpu.optimizers import clip_grad_norm, fused_adam, fused_sgd
 
@@ -111,7 +112,7 @@ def run_trace(opt_level, half_name=None, loss_scale=None, keep_bn=None,
     if dp:
         mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
         sharded = jax.jit(
-            jax.shard_map(
+            shard_map(
                 step, mesh=mesh,
                 in_specs=(P(), P(), P(), P("dp"), P("dp")),
                 out_specs=(P(), P(), P(), P(), P(), P()),

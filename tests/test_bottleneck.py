@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.contrib import Bottleneck, SpatialBottleneck, halo_exchange_1d

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.ops.attention import flash_attention
@@ -28,6 +28,18 @@ B, H, D = 2, 4, 8
 SEQ = 32
 
 
+def _skip_if_old_jaxlib_noncausal(causal, window=None):
+    """The non-causal, windowless ring schedule visits every chunk, which
+    this old jaxlib lowers through a PartitionId instruction that its SPMD
+    partitioner rejects ('PartitionId instruction is not supported for
+    SPMD partitioning'). Current jax lowers it fine; skip there-only."""
+    from apex_tpu.compat import HAS_VMA
+
+    if not HAS_VMA and not causal and window is None:
+        pytest.skip("old jaxlib: PartitionId unsupported in SPMD lowering "
+                    "of the non-causal ring schedule")
+
+
 def full_reference(q, k, v, causal):
     return flash_attention(q, k, v, causal=causal, impl="xla")
 
@@ -40,6 +52,7 @@ class TestRingAttention:
     @pytest.mark.parametrize("causal", [False, True])
     @pytest.mark.parametrize("cp", [4, 8])
     def test_forward_parity(self, rng, causal, cp):
+        _skip_if_old_jaxlib_noncausal(causal)
         mesh = parallel_state.initialize_model_parallel(
             context_parallel_size=cp, devices=jax.devices()[:cp]
         )
@@ -300,6 +313,7 @@ class TestRingGQAAndKeyPadding:
                              [(False, None), (True, None), (True, 12)])
     @pytest.mark.parametrize("use_kpm", [False, True])
     def test_parity_and_grads(self, rng, h_kv, causal, window, use_kpm):
+        _skip_if_old_jaxlib_noncausal(causal, window)
         cp = 4
         mesh = parallel_state.initialize_model_parallel(
             context_parallel_size=cp, devices=jax.devices()[:cp]

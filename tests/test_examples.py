@@ -76,6 +76,29 @@ def test_gpt_pretrain_resume(tmp_path):
     assert "step     4" in out
 
 
+def test_gpt_pretrain_chaos(tmp_path):
+    """The resilience drill through the real example script: run A hits
+    an injected NaN step (rollback) and a SIGTERM (durable termination
+    checkpoint); run B starts with that newest checkpoint bit-flipped
+    and must fall back to the previous verified step, then finish."""
+    base = ["--layers", "2", "--hidden", "64", "--heads", "4",
+            "--seq-len", "32", "--micro-batch", "1", "--global-batch", "16",
+            "--save", str(tmp_path), "--save-interval", "4",
+            "--snapshot-interval", "2", "--skip-budget", "0"]
+    out = _run("examples/gpt/pretrain_gpt.py",
+               ["--steps", "12", "--chaos-nan-steps", "6",
+                "--chaos-sigterm-step", "9"] + base)
+    assert "rolled back to step 6" in out
+    assert "termination checkpoint at step 10; exiting" in out
+
+    out = _run("examples/gpt/pretrain_gpt.py",
+               ["--steps", "12", "--chaos-corrupt-latest", "bitflip"] + base)
+    assert "[chaos] corrupted newest checkpoint" in out
+    # newest (step 10) is corrupt -> verified fallback to the interval save
+    assert "resumed from step 8" in out
+    assert "step    11" in out  # ran to completion
+
+
 def test_llama_finetune_example():
     out = _run("examples/llama/finetune_llama.py", ["--steps", "20"])
     assert "final loss" in out

@@ -1,0 +1,670 @@
+"""Resilience subsystem: sentinel, rollback, integrity, chaos recovery.
+
+Covers apex_tpu/resilience end to end — unit behavior of each piece, the
+AmpOptimizer sentinel wiring, and the acceptance scenario: an
+examples/gpt-style training loop (dynamic scaler + fused_adam + vma_cond
+skip gate + AutoResume, the exact wiring of examples/gpt/pretrain_gpt.py,
+sized down for tier-1) driven through an injected NaN-loss step, a
+bit-flipped newest checkpoint, and a real SIGTERM — completing with the
+uninjected run's trajectory after each recovery point and restoring only
+from checksum-verified checkpoints.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import resilience
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers import fused_adam
+from apex_tpu.parallel.utils import vma_cond
+from apex_tpu.resilience import chaos
+from apex_tpu.resilience.sentinel import (
+    VERDICT_HALT,
+    VERDICT_OK,
+    VERDICT_ROLLBACK,
+    VERDICT_SKIP,
+)
+from apex_tpu.utils import AutoResume
+from apex_tpu.utils.checkpoint import finalized_steps, latest_step, save_checkpoint
+from apex_tpu.utils.pytree import tree_any_non_finite
+
+CHAOS_SEED = 1234
+
+
+@pytest.fixture
+def chaos_seed():
+    """Deterministic seed for every injected-fault test: the fault step,
+    the injected payload, and the data stream all derive from it, so a
+    failing chaos test replays identically under ``-k`` reruns."""
+    np.random.seed(CHAOS_SEED)
+    return CHAOS_SEED
+
+
+# ---------------------------------------------------------------------------
+# sentinel
+
+
+class TestAnomalySentinel:
+    def _warm(self, sent, losses=(1.0, 0.98, 1.02, 0.99, 1.01)):
+        st = sent.init()
+        for l in losses:
+            an = sent.is_anomalous_loss(st, l)
+            st, v = sent.update(st, l, an)
+            assert int(v) == VERDICT_OK
+        return st
+
+    def test_no_false_positive_on_smooth_losses(self):
+        sent = resilience.AnomalySentinel(warmup_steps=3)
+        st = self._warm(sent)
+        assert int(st.anomalies) == 0
+        # a loss inside the observed band is not a spike (1.03 at ~7 running
+        # sigma WOULD be — the z-test is about the run's own variance)
+        assert not bool(sent.is_anomalous_loss(st, 1.02))
+
+    def test_spike_detected_after_warmup_only(self):
+        sent = resilience.AnomalySentinel(warmup_steps=3, z_threshold=6.0)
+        st = sent.init()
+        # during warmup even a huge loss passes (variance estimate is junk)
+        assert not bool(sent.is_anomalous_loss(st, 1e6))
+        st = self._warm(sent)
+        assert bool(sent.is_anomalous_loss(st, 50.0))
+
+    def test_nonfinite_loss_always_anomalous(self):
+        sent = resilience.AnomalySentinel()
+        st = sent.init()
+        assert bool(sent.is_anomalous_loss(st, float("nan")))
+        assert bool(sent.is_anomalous_loss(st, float("inf")))
+
+    def test_anomalous_loss_never_pollutes_ema(self):
+        sent = resilience.AnomalySentinel(warmup_steps=3)
+        st = self._warm(sent)
+        ema_before = float(st.ema)
+        st, v = sent.update(st, jnp.nan, True)
+        assert int(v) == VERDICT_SKIP
+        assert float(st.ema) == ema_before  # NaN never folded in
+
+    def test_escalation_ladder_and_reset(self):
+        sent = resilience.AnomalySentinel(skip_budget=1, rollback_budget=1)
+        st = sent.init()
+        st, v1 = sent.update(st, jnp.nan, True)
+        st, v2 = sent.update(st, jnp.nan, True)
+        st, v3 = sent.update(st, jnp.nan, True)
+        assert [int(v1), int(v2), int(v3)] == [
+            VERDICT_SKIP, VERDICT_ROLLBACK, VERDICT_HALT]
+        # one clean step re-arms the ladder
+        st, v = sent.update(st, 1.0, False)
+        assert int(v) == VERDICT_OK and int(st.consecutive) == 0
+        st, v = sent.update(st, jnp.nan, True)
+        assert int(v) == VERDICT_SKIP
+
+    def test_bad_params_forces_at_least_rollback(self):
+        sent = resilience.AnomalySentinel(skip_budget=5)
+        st = sent.init()
+        st, v = sent.update(st, 1.0, False, bad_params=True)
+        assert int(v) == VERDICT_ROLLBACK
+        assert bool(sent.check_params({"w": jnp.array([1.0, jnp.nan])}))
+        assert not bool(sent.check_params({"w": jnp.ones(2)}))
+
+    def test_jit_compatible_and_verdict_is_int32(self):
+        sent = resilience.AnomalySentinel()
+
+        @jax.jit
+        def step(st, loss):
+            return sent.check(st, loss, params={"w": jnp.ones(2)})
+
+        st, v = step(sent.init(), 1.0)
+        assert v.dtype == jnp.int32 and int(v) == VERDICT_OK
+
+
+# ---------------------------------------------------------------------------
+# rollback
+
+
+class TestRollbackBuffer:
+    def test_snapshot_restore_roundtrip_and_isolation(self):
+        buf = resilience.RollbackBuffer(capacity=2, interval=1)
+        state = {"w": jnp.arange(4.0), "n": jnp.asarray(1, jnp.int32)}
+        buf.snapshot(3, state)
+        # mutating the live state must not reach the snapshot
+        state["w"] = state["w"] * 0 - 7.0
+        step, restored = buf.rollback()
+        assert step == 3
+        np.testing.assert_allclose(restored["w"], np.arange(4.0))
+        assert restored["w"].sharding is not None  # real jax.Array again
+
+    def test_ring_capacity_and_cadence(self):
+        buf = resilience.RollbackBuffer(capacity=2, interval=5)
+        for s in range(1, 21):
+            buf.maybe_snapshot(s, {"s": jnp.asarray(s)})
+        assert buf.steps == [15, 20]  # only cadence steps, only newest 2
+
+    def test_pop_falls_back_to_older_snapshot(self):
+        buf = resilience.RollbackBuffer(capacity=3, interval=1)
+        for s in (1, 2, 3):
+            buf.snapshot(s, {"s": jnp.asarray(s)})
+        assert buf.rollback()[0] == 3
+        assert buf.rollback(pop=True)[0] == 2
+        assert buf.rollback(pop=True)[0] == 1
+        assert buf.rollback(pop=True)[0] == 1  # never pops the last one
+
+    def test_empty_rollback_raises(self):
+        with pytest.raises(RuntimeError):
+            resilience.RollbackBuffer().rollback()
+
+
+class TestResilienceManager:
+    def _mgr(self, tmp_path, **pol):
+        return resilience.ResilienceManager(
+            buffer=resilience.RollbackBuffer(capacity=2, interval=1),
+            policy=resilience.EscalationPolicy(**pol),
+            log_path=str(tmp_path / "anomalies.jsonl"),
+        )
+
+    def test_actions_and_anomaly_log(self, tmp_path):
+        mgr = self._mgr(tmp_path)
+        mgr.buffer.snapshot(0, {"w": jnp.ones(2)})
+        assert mgr.resolve(1, VERDICT_OK) == "ok"
+        assert mgr.resolve(2, VERDICT_SKIP, loss=9.9) == "skip"
+        assert mgr.resolve(3, VERDICT_ROLLBACK) == "rollback"
+        assert mgr.resolve(4, VERDICT_HALT) == "halt"
+        lines = [json.loads(l) for l in
+                 open(tmp_path / "anomalies.jsonl").read().splitlines()]
+        kinds = [l["kind"] for l in lines]
+        assert kinds == ["skip", "rollback", "halt"]  # one event per step
+        assert lines[0]["loss"] == 9.9
+
+    def test_rollback_dampens_lr_and_is_bounded(self, tmp_path):
+        mgr = self._mgr(tmp_path, max_rollbacks=2, lr_dampen=0.5)
+        mgr.buffer.snapshot(5, {"w": jnp.ones(2)})
+        assert mgr.resolve(6, VERDICT_ROLLBACK) == "rollback"
+        step, _ = mgr.do_rollback()
+        assert step == 5 and mgr.lr_scale == 0.5
+        assert mgr.resolve(6, VERDICT_ROLLBACK) == "rollback"
+        mgr.do_rollback()
+        assert mgr.lr_scale == 0.25
+        # budget exhausted -> rollback verdicts degrade to halt
+        assert mgr.resolve(6, VERDICT_ROLLBACK) == "halt"
+
+    def test_rollback_without_snapshots_halts(self, tmp_path):
+        mgr = resilience.ResilienceManager(buffer=None)
+        assert mgr.resolve(1, VERDICT_ROLLBACK) == "halt"
+
+    def test_repeat_rollback_backs_off_to_older_snapshot(self, tmp_path):
+        mgr = self._mgr(tmp_path, max_rollbacks=5)
+        mgr.buffer.snapshot(2, {"s": jnp.asarray(2)})
+        mgr.buffer.snapshot(4, {"s": jnp.asarray(4)})
+        assert mgr.do_rollback()[0] == 4
+        # same newest snapshot again -> pops to the older one
+        assert mgr.do_rollback()[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# integrity (manifest, verification, retention, retry)
+
+
+class TestIntegrity:
+    def _tree(self, seed=0):
+        k = jax.random.PRNGKey(seed)
+        return {"w": jax.random.normal(k, (16, 16)),
+                "n": jnp.asarray(seed, jnp.int32)}
+
+    def test_manifest_commit_and_verify(self, tmp_path):
+        d = str(tmp_path)
+        path = resilience.save_checkpoint_verified(d, 1, self._tree())
+        ok, why = resilience.verify_checkpoint(path)
+        assert ok, why
+        assert resilience.verified_latest_step(d) == 1
+        m = resilience.read_manifest(path)
+        assert m["fingerprint"]["structure_hash"]
+        assert m["files"]
+
+    def test_missing_manifest_means_uncommitted(self, tmp_path):
+        d = str(tmp_path)
+        save_checkpoint(d, 2, self._tree())  # plain save: no manifest
+        ok, why = resilience.verify_checkpoint(os.path.join(d, "step_2"))
+        assert not ok and "manifest" in why
+        assert resilience.verified_latest_step(d) is None
+
+    @pytest.mark.chaos
+    @pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+    def test_corruption_detected_and_restore_falls_back(
+        self, tmp_path, chaos_seed, mode
+    ):
+        d = str(tmp_path)
+        t1, t2 = self._tree(1), self._tree(2)
+        resilience.save_checkpoint_verified(d, 1, t1)
+        resilience.save_checkpoint_verified(d, 2, t2)
+        touched = chaos.corrupt_latest_checkpoint(d, mode=mode, seed=chaos_seed)
+        assert touched and touched.endswith("step_2")
+        ok, why = resilience.verify_checkpoint(os.path.join(d, "step_2"))
+        assert not ok
+        step, tree = resilience.load_checkpoint_verified(d, target=t1)
+        assert step == 1
+        np.testing.assert_allclose(tree["w"], t1["w"])
+
+    def test_corrupt_manifest_is_not_legacy(self, tmp_path):
+        """A present-but-unparseable manifest is corruption, not a
+        pre-manifest legacy checkpoint: even with allow_unverified the
+        restore must fall back rather than trust it."""
+        d = str(tmp_path)
+        t1 = self._tree(1)
+        resilience.save_checkpoint_verified(d, 1, t1)
+        resilience.save_checkpoint_verified(d, 2, self._tree(2))
+        with open(resilience.manifest_path(os.path.join(d, "step_2")), "w") as f:
+            f.write("{definitely not json")
+        step, tree = resilience.load_checkpoint_verified(
+            d, target=t1, allow_unverified=True
+        )
+        assert step == 1
+        np.testing.assert_allclose(tree["w"], t1["w"])
+
+    def test_nothing_restorable_raises(self, tmp_path):
+        d = str(tmp_path)
+        resilience.save_checkpoint_verified(d, 1, self._tree())
+        chaos.corrupt_checkpoint(os.path.join(d, "step_1"), mode="truncate")
+        with pytest.raises(FileNotFoundError):
+            resilience.load_checkpoint_verified(d, target=self._tree())
+
+    def test_retention_keeps_last_n_and_sweeps_tmp(self, tmp_path):
+        d = str(tmp_path)
+        for s in range(1, 6):
+            resilience.save_checkpoint_verified(d, s, self._tree(s))
+        os.makedirs(tmp_path / "step_9.orbax-checkpoint-tmp-0")
+        deleted = resilience.apply_retention(d, keep_last_n=2)
+        assert deleted == [1, 2, 3]
+        assert finalized_steps(d) == [4, 5]
+        assert not (tmp_path / "step_9.orbax-checkpoint-tmp-0").exists()
+        assert not (tmp_path / "step_1.apex-manifest.json").exists()
+
+    def test_retention_never_drops_newest_verified(self, tmp_path):
+        d = str(tmp_path)
+        for s in (1, 2, 3):
+            resilience.save_checkpoint_verified(d, s, self._tree(s))
+        chaos.corrupt_checkpoint(os.path.join(d, "step_3"), mode="truncate")
+        chaos.corrupt_checkpoint(os.path.join(d, "step_2"), mode="truncate")
+        # keep_last_n=1 would keep only corrupt step 3; verified step 1 must
+        # survive as the fallback restore point
+        resilience.apply_retention(d, keep_last_n=1)
+        assert 1 in finalized_steps(d)
+        assert resilience.load_checkpoint_verified(d, target=self._tree())[0] == 1
+
+    def test_save_with_retry_recovers_transient_failures(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "done"
+
+        assert resilience.save_with_retry(flaky, retries=3, backoff=0.0) == "done"
+        assert calls["n"] == 3
+
+    def test_save_with_retry_reraises_after_budget(self):
+        def always():
+            raise OSError("disk on fire")
+
+        with pytest.raises(OSError):
+            resilience.save_with_retry(always, retries=2, backoff=0.0)
+
+
+# ---------------------------------------------------------------------------
+# chaos harness itself
+
+
+class TestChaosHarness:
+    def test_poison_loss_poisons_value_and_grads(self):
+        def f(w, armed):
+            return chaos.poison_loss(jnp.sum(w * w), armed)
+
+        w = jnp.ones(3)
+        assert float(f(w, 0.0)) == 3.0
+        assert not np.isfinite(float(f(w, 1.0)))
+        g = jax.grad(f)(w, 1.0)
+        assert bool(tree_any_non_finite(g))  # multiplicative: grads die too
+        g0 = jax.grad(f)(w, 0.0)
+        np.testing.assert_allclose(g0, 2 * np.ones(3))
+
+    def test_fault_plan_consumed_once_vs_persistent(self):
+        plan = chaos.FaultPlan(nan_steps="3,5-6")
+        assert plan.take_nan(3) == 1.0
+        assert plan.take_nan(3) == 0.0  # consumed: the replay runs clean
+        assert plan.take_nan(4) == 0.0
+        persistent = chaos.FaultPlan(nan_steps={3}, persistent=True)
+        assert persistent.take_nan(3) == 1.0
+        assert persistent.take_nan(3) == 1.0
+
+    def test_corruption_is_deterministic(self, tmp_path, chaos_seed):
+        import shutil
+
+        save_checkpoint(str(tmp_path / "a"), 1, {"w": jnp.arange(64.0)})
+        # identical dir contents (orbax randomizes payload names per save,
+        # so two saves can't be compared — two copies of one save can)
+        shutil.copytree(tmp_path / "a", tmp_path / "b")
+        f1 = chaos.corrupt_checkpoint(
+            str(tmp_path / "a" / "step_1"), "bitflip", seed=chaos_seed)
+        f2 = chaos.corrupt_checkpoint(
+            str(tmp_path / "b" / "step_1"), "bitflip", seed=chaos_seed)
+        assert (os.path.relpath(f1, tmp_path / "a")
+                == os.path.relpath(f2, tmp_path / "b"))
+        assert open(f1, "rb").read() == open(f2, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# AmpOptimizer sentinel wiring
+
+
+class TestAmpOptimizerSentinel:
+    def _setup(self):
+        from apex_tpu import amp
+
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        params, amp_opt, _ = amp.initialize(
+            params, optax.sgd(0.1), opt_level="O2", half_dtype=jnp.float16,
+        )
+        return params, amp_opt, amp_opt.init(params)
+
+    def _warm_sentinel(self, sent):
+        st = sent.init()
+        for l in (1.0, 1.01, 0.99, 1.0, 1.02):
+            st, _ = sent.update(st, l, False)
+        return st
+
+    def test_clean_step_updates_and_reports_ok(self):
+        params, amp_opt, state = self._setup()
+        sent = resilience.AnomalySentinel(warmup_steps=3)
+        grads = {"w": jnp.full((4,), float(state.scaler.scale))}
+        new_params, new_state, info = amp_opt.step(
+            grads, state, params, sentinel=sent,
+            sentinel_state=self._warm_sentinel(sent), unscaled_loss=1.0,
+        )
+        assert int(info["verdict"]) == VERDICT_OK
+        assert not bool(info["skipped"])
+        assert float(np.asarray(new_params["w"])[0]) != 1.0  # stepped
+        assert int(info["sentinel_state"].count) == 6
+
+    def test_spike_skips_update_but_not_scaler_schedule(self):
+        params, amp_opt, state = self._setup()
+        sent = resilience.AnomalySentinel(warmup_steps=3, z_threshold=6.0)
+        grads = {"w": jnp.full((4,), float(state.scaler.scale))}
+        scale_before = float(state.scaler.scale)
+        new_params, new_state, info = amp_opt.step(
+            grads, state, params, sentinel=sent,
+            sentinel_state=self._warm_sentinel(sent), unscaled_loss=1e4,
+        )
+        assert int(info["verdict"]) == VERDICT_SKIP
+        assert bool(info["skipped"]) and not bool(info["found_inf"])
+        np.testing.assert_allclose(np.asarray(new_params["w"]), 1.0)  # untouched
+        # a spike is not an overflow: the loss scale must NOT back off
+        assert float(new_state.scaler.scale) == scale_before
+
+    def test_overflow_still_backs_off_scale(self):
+        params, amp_opt, state = self._setup()
+        sent = resilience.AnomalySentinel(warmup_steps=3)
+        grads = {"w": jnp.array([jnp.inf, 1.0, 1.0, 1.0])}
+        new_params, new_state, info = amp_opt.step(
+            grads, state, params, sentinel=sent,
+            sentinel_state=self._warm_sentinel(sent), unscaled_loss=1.0,
+        )
+        assert bool(info["found_inf"]) and int(info["verdict"]) == VERDICT_SKIP
+        assert float(new_state.scaler.scale) < float(state.scaler.scale)
+
+    def test_corrupt_params_escalate_to_rollback(self):
+        params, amp_opt, state = self._setup()
+        params = {"w": jnp.array([jnp.nan, 1.0, 1.0, 1.0], jnp.float16)}
+        state = state.replace(master={"w": jnp.array([jnp.nan, 1.0, 1.0, 1.0])})
+        sent = resilience.AnomalySentinel(warmup_steps=3)
+        grads = {"w": jnp.full((4,), float(state.scaler.scale))}
+        _, _, info = amp_opt.step(
+            grads, state, params, sentinel=sent,
+            sentinel_state=self._warm_sentinel(sent), unscaled_loss=1.0,
+        )
+        assert int(info["verdict"]) >= VERDICT_ROLLBACK
+
+    def test_sentinel_requires_loss_and_state(self):
+        params, amp_opt, state = self._setup()
+        with pytest.raises(ValueError):
+            amp_opt.step({"w": jnp.ones(4)}, state, params,
+                         sentinel=resilience.AnomalySentinel())
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the gpt-example wiring under all three fault classes
+
+
+def _batch(step, n=32, d=8):
+    """Deterministic per-step batch (stands in for the indexed dataset's
+    consumed_samples-keyed stream: rebuild-at-step == identical data)."""
+    r = np.random.RandomState(CHAOS_SEED + step)
+    x = r.randn(n, d).astype(np.float32)
+    w = np.linspace(-1, 1, d, dtype=np.float32)
+    y = (x @ w[:, None] + 0.1 * r.randn(n, 1)).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _mini_gpt_style_trainer(
+    steps,
+    save_dir=None,
+    interval=None,
+    keep_last_n=3,
+    plan=None,
+    snapshot_interval=2,
+    skip_budget=0,
+    max_rollbacks=3,
+    lr_dampen=1.0,
+):
+    """The pretrain_gpt.py wiring at tier-1 scale: dynamic LossScaler,
+    fused_adam, sentinel gate through vma_cond, donation-free toy model,
+    AutoResume with verified restore, rollback ring + escalation."""
+    scaler = LossScaler(loss_scale="dynamic")
+    sentinel = resilience.AnomalySentinel(
+        warmup_steps=4, skip_budget=skip_budget, rollback_budget=2,
+    )
+    opt = fused_adam(lr=0.05)
+    plan = plan or chaos.FaultPlan()
+
+    @jax.jit
+    def train_step(params, opt_state, scaler_state, sent_state, x, y,
+                   inject_nan, lr_scale):
+        def scaled_loss(p):
+            h = jnp.tanh(x @ p["w1"])
+            loss = jnp.mean((h @ p["w2"] - y) ** 2)
+            return chaos.poison_loss(scaler.scale(scaler_state, loss), inject_nan)
+
+        loss, grads = jax.value_and_grad(scaled_loss)(params)
+        grads, found_inf = scaler.unscale(scaler_state, grads)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        unscaled = loss / scaler_state.scale
+        gate = jnp.logical_or(
+            found_inf, sentinel.is_anomalous_loss(sent_state, unscaled)
+        )
+
+        def apply():
+            updates, new_opt = opt.update(grads, opt_state, params)
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            return optax.apply_updates(params, updates), new_opt
+
+        new_params, new_opt_state = vma_cond(
+            gate, lambda: (params, opt_state), apply
+        )
+        new_sent_state, verdict = sentinel.update(
+            sent_state, unscaled, anomaly=gate,
+            bad_params=tree_any_non_finite(new_params),
+        )
+        return (new_params, new_opt_state, new_scaler_state, new_sent_state,
+                unscaled, verdict)
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    params = {
+        "w1": 0.5 * jax.random.normal(k1, (8, 16)),
+        "w2": 0.5 * jax.random.normal(k2, (16, 1)),
+    }
+    opt_state = opt.init(params)
+    scaler_state = scaler.init()
+    sent_state = sentinel.init()
+
+    ar = (
+        AutoResume(save_dir, interval=interval, keep_last_n=keep_last_n)
+        if save_dir else None
+    )
+    step0 = 0
+    if ar is not None:
+        step0, (params, opt_state, scaler_state, sent_state) = ar.restore(
+            (params, opt_state, scaler_state, sent_state)
+        )
+    mgr = resilience.ResilienceManager(
+        buffer=resilience.RollbackBuffer(
+            capacity=2, interval=snapshot_interval
+        ),
+        policy=resilience.EscalationPolicy(
+            max_rollbacks=max_rollbacks, lr_dampen=lr_dampen
+        ),
+    )
+    mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
+
+    losses, result = {}, {
+        "resumed_from": step0, "halted": False, "terminated": False,
+        "halt_saved_step": None,
+    }
+    try:
+        step_i = step0
+        while step_i < steps:
+            x, y = _batch(step_i)
+            params, opt_state, scaler_state, sent_state, loss, verdict = (
+                train_step(
+                    params, opt_state, scaler_state, sent_state, x, y,
+                    jnp.asarray(plan.take_nan(step_i), jnp.float32),
+                    jnp.asarray(mgr.lr_scale, jnp.float32),
+                )
+            )
+            state = (params, opt_state, scaler_state, sent_state)
+            action = mgr.resolve(step_i, int(verdict), loss=float(loss))
+            if action == "halt":
+                good_step, good_state = (
+                    mgr.buffer.rollback() if len(mgr.buffer)
+                    else (step_i, state)
+                )
+                if save_dir:
+                    ar.finalize()  # never race an in-flight interval save
+                    resilience.save_checkpoint_verified(
+                        save_dir, good_step, good_state,
+                        keep_last_n=keep_last_n,
+                    )
+                    result["halt_saved_step"] = good_step
+                result["halted"] = True
+                break
+            if action == "rollback":
+                step_i, (params, opt_state, scaler_state, sent_state) = (
+                    mgr.do_rollback()
+                )
+                continue
+            losses[step_i] = float(loss)
+            if action == "ok":
+                mgr.observe_good(step_i + 1, state)
+            plan.maybe_sigterm(step_i)
+            if ar is not None and ar.step(step_i + 1, state):
+                result["terminated"] = True
+                result["terminated_at"] = step_i + 1
+                break
+            step_i += 1
+    finally:
+        if ar is not None:
+            ar.close()  # finalize pending saves + restore SIGTERM handler
+    result.update(losses=losses, params=params, events=mgr.events, mgr=mgr)
+    return result
+
+
+@pytest.mark.chaos
+class TestChaosEndToEnd:
+    STEPS = 20
+
+    def test_run_survives_nan_corruption_and_sigterm(self, tmp_path, chaos_seed):
+        """The acceptance scenario, one continuous story:
+
+        phase A trains with a NaN injected at step 6 (escalates straight
+        to rollback: skip_budget=0) and a real SIGTERM after step 13;
+        the newest checkpoint is then bit-flipped; phase B resumes —
+        necessarily from an older verified step — and completes. Both
+        phases replay the baseline's exact trajectory after each
+        recovery point (the anomalous update never committed and the
+        data stream rewound), so the final loss matches the uninjected
+        run's to float tolerance.
+        """
+        base = _mini_gpt_style_trainer(self.STEPS)
+        assert not base["halted"] and len(base["losses"]) == self.STEPS
+
+        d = str(tmp_path / "ck")
+        plan = chaos.FaultPlan(nan_steps={6}, sigterm_steps={13})
+        prev = signal.getsignal(signal.SIGTERM)
+        a = _mini_gpt_style_trainer(
+            self.STEPS, save_dir=d, interval=4, plan=plan
+        )
+        assert signal.getsignal(signal.SIGTERM) == prev  # handler restored
+        # (a) NaN step: rollback event recorded, then the replayed step 6
+        # is clean and matches baseline exactly
+        kinds = [e["kind"] for e in a["events"]]
+        assert "rollback" in kinds and "rollback_restore" in kinds
+        assert not np.isfinite(
+            next(e["loss"] for e in a["events"] if e["kind"] == "rollback")
+        )
+        for s in range(self.STEPS):
+            if s in a["losses"]:
+                np.testing.assert_allclose(
+                    a["losses"][s], base["losses"][s], rtol=1e-5,
+                    err_msg=f"post-recovery divergence at step {s}",
+                )
+        # (c) SIGTERM: durable termination checkpoint, immediately verified
+        assert a["terminated"] and a["terminated_at"] == 14
+        assert resilience.verified_latest_step(d) == 14
+        # retention bounded the directory
+        assert len(finalized_steps(d)) <= 3
+
+        # (b) bit-flip the newest checkpoint; resume must fall back to the
+        # newest VERIFIED step, never the corrupt one
+        chaos.corrupt_latest_checkpoint(d, mode="bitflip", seed=chaos_seed)
+        fallback = resilience.verified_latest_step(d)
+        assert fallback is not None and fallback < 14
+        b = _mini_gpt_style_trainer(self.STEPS, save_dir=d, interval=4)
+        assert b["resumed_from"] == fallback
+        assert not b["halted"]
+        for s, l in b["losses"].items():
+            np.testing.assert_allclose(l, base["losses"][s], rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(b["params"]["w1"]), np.asarray(base["params"]["w1"]),
+            rtol=1e-5,
+        )
+        assert not bool(tree_any_non_finite(b["params"]))
+
+    def test_persistent_fault_halts_with_known_good_checkpoint(
+        self, tmp_path, chaos_seed
+    ):
+        d = str(tmp_path / "ck")
+        plan = chaos.FaultPlan(nan_steps="5-19", persistent=True)
+        res = _mini_gpt_style_trainer(
+            self.STEPS, save_dir=d, interval=100, plan=plan,
+            max_rollbacks=1, snapshot_interval=2,
+        )
+        assert res["halted"] and not res["terminated"]
+        # the halt checkpoint is a verified, finite, known-good state
+        s = res["halt_saved_step"]
+        assert s is not None and s <= 5
+        assert resilience.verified_latest_step(d) == s
+        _, tree = resilience.load_checkpoint_verified(d, target=None)
+        assert not bool(tree_any_non_finite(tree))
+
+    def test_lr_dampening_applies_after_rollback(self, chaos_seed):
+        plan = chaos.FaultPlan(nan_steps={6})
+        res = _mini_gpt_style_trainer(
+            self.STEPS, plan=plan, lr_dampen=0.5,
+        )
+        assert not res["halted"]
+        assert res["mgr"].lr_scale == 0.5
+        assert res["mgr"].rollbacks_used == 1
+        assert len(res["losses"]) == self.STEPS

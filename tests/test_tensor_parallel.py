@@ -14,7 +14,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from apex_tpu.compat import shard_map
 
 from apex_tpu.models import GPTModel, gpt_loss_fn
 from apex_tpu.parallel import parallel_state

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.data import (
@@ -88,6 +88,49 @@ class TestCheckpoint:
         np.testing.assert_allclose(old["params"]["w"], tree["params"]["w"])
         assert old["step"].dtype == jnp.int32
 
+
+    def test_latest_step_ignores_torn_and_tmp_dirs(self, tmp_path, rng):
+        """Regression pin: a crash during an async save leaves an orbax
+        tmp directory (and a non-atomic backend can leave an empty final
+        name); neither may be offered for restore."""
+        import os
+
+        save_checkpoint(str(tmp_path), 3, {"w": jax.random.normal(rng, (4,))})
+        os.makedirs(tmp_path / "step_9")  # torn: final name, no content
+        os.makedirs(tmp_path / "step_7.orbax-checkpoint-tmp-0")  # in-progress
+        assert latest_step(str(tmp_path)) == 3
+        from apex_tpu.utils.checkpoint import finalized_steps
+
+        assert finalized_steps(str(tmp_path)) == [3]
+
+    def test_structure_migration_old_scaler_state(self, tmp_path):
+        """The documented migration path (utils/checkpoint.py docstring):
+        a checkpoint from before LossScalerState gained
+        ``hysteresis_tracker`` resumes through the scaler's
+        state_dict/load_state_dict pair (tolerant of missing keys), while
+        a raw-pytree restore into the new structure fails fast."""
+        from apex_tpu.amp.scaler import LossScaler
+
+        scaler = LossScaler(hysteresis=2)
+        old = scaler.state_dict(scaler.init())
+        del old["hysteresis_tracker"]  # the pre-hysteresis era on disk
+        save_checkpoint(str(tmp_path), 1, {"scaler": old})
+
+        # raw restore into the NEW dataclass structure cannot line up
+        with pytest.raises(Exception):
+            load_checkpoint(
+                str(tmp_path), 1, target={"scaler": scaler.init()}
+            )
+
+        # the supported path: raw dict out, load_state_dict in — missing
+        # key falls back to the constructor's hysteresis
+        raw = load_checkpoint(str(tmp_path), 1)
+        state = scaler.load_state_dict(raw["scaler"])
+        assert int(state.hysteresis_tracker) == 2
+        assert float(state.scale) == float(raw["scaler"]["loss_scale"])
+        # and the migrated state round-trips with the new field pinned
+        again = scaler.load_state_dict(scaler.state_dict(state))
+        assert int(again.hysteresis_tracker) == 2
 
     def test_async_writer_round_trip_and_mutation_safety(self, tmp_path, rng):
         from apex_tpu.utils.checkpoint import AsyncCheckpointWriter
@@ -167,6 +210,8 @@ class TestAutoResume:
     def test_interval_saves_and_fresh_restore(self, tmp_path):
         ar = AutoResume(str(tmp_path), interval=2, install_handlers=False)
         state, end, exited = self._train(self._init(), 5, ar)
+        # interval saves are async; finalize() is the durability point
+        ar.finalize()
         assert not exited and latest_step(str(tmp_path)) == 4
 
         step0, restored = ar.restore(self._init())
