@@ -24,6 +24,11 @@ Subpackage map (reference parity noted per module):
                               rollback, checkpoint integrity manifests, fault
                               injection (no reference equivalent; the recovery
                               layer production pretraining needs)
+- ``apex_tpu.monitor``      — unified training telemetry: in-step metric taps
+                              (MetricBag), pluggable metric sinks, MFU /
+                              throughput, stall watchdog, on-anomaly profiler
+                              capture (no reference equivalent; see
+                              docs/observability.md)
 """
 
 import logging
@@ -82,6 +87,7 @@ def deprecated_warning(msg: str) -> None:
 # works like `import apex; apex.amp...`.
 from apex_tpu import amp  # noqa: E402
 from apex_tpu import fp16_utils  # noqa: E402
+from apex_tpu import monitor  # noqa: E402
 from apex_tpu import normalization  # noqa: E402
 from apex_tpu import optimizers  # noqa: E402
 from apex_tpu import parallel  # noqa: E402
@@ -91,6 +97,7 @@ from apex_tpu import transformer  # noqa: E402
 __all__ = [
     "amp",
     "fp16_utils",
+    "monitor",
     "optimizers",
     "normalization",
     "transformer",

@@ -104,7 +104,7 @@ class AmpOptimizer:
 
     def step(self, grads, state: AmpOptimizerState, params, found_inf_extra=None,
              loss_id: int = 0, sentinel=None, sentinel_state=None,
-             unscaled_loss=None):
+             unscaled_loss=None, collect_metrics: bool = False):
         """One optimizer step: unscale, overflow-gate, update, recast.
 
         Returns (new_params, new_state, info) where info has ``found_inf``
@@ -122,6 +122,17 @@ class AmpOptimizer:
         ``info`` then also carries ``sentinel_state`` (advanced) and
         ``verdict`` (int32 code, see resilience.sentinel) for the host
         loop to branch on.
+
+        Telemetry wiring (apex_tpu.monitor): ``collect_metrics=True``
+        adds ``info["grad_norm"]`` — the L2 norm of the UNSCALED fp32
+        grads (one fused reduction, the same kernel shape as the overflow
+        check). Feed it, ``info["loss_scale"]``, and the verdict into an
+        in-step MetricBag; off by default so steps that don't log don't
+        pay even that reduction. Inside ``shard_map`` over a model-
+        parallel axis the grads are LOCAL shards and this is the local
+        partial norm — combine across ranks yourself (the tp-aware form
+        is ``transformer.calc_params_l2_norm(axis_name=...)``, see
+        examples/gpt/pretrain_gpt.py).
         """
         grads_f32, found_inf = self.unscale_grads(grads, state, loss_id)
         if found_inf_extra is not None:
@@ -135,7 +146,7 @@ class AmpOptimizer:
             gate_extra = sentinel.is_anomalous_loss(sentinel_state, unscaled_loss)
         new_params, new_state, info = self.step_unscaled(
             grads_f32, state, params, {loss_id: found_inf},
-            gate_extra=gate_extra,
+            gate_extra=gate_extra, collect_metrics=collect_metrics,
         )
         if sentinel is not None:
             new_sent, verdict = sentinel.update(
@@ -148,7 +159,8 @@ class AmpOptimizer:
         return new_params, new_state, info
 
     def step_unscaled(self, grads_f32, state: AmpOptimizerState, params,
-                      found_infs, gate_extra=None):
+                      found_infs, gate_extra=None,
+                      collect_metrics: bool = False):
         """Apply already-unscaled fp32 grads (the sum of one
         :meth:`unscale_grads` per contributing loss).
 
@@ -216,6 +228,10 @@ class AmpOptimizer:
         else:
             new_params = new_master
         info = {"found_inf": found_inf, "loss_scale": scale_now, "skipped": gate}
+        if collect_metrics:
+            from apex_tpu.monitor.metrics import global_grad_norm
+
+            info["grad_norm"] = global_grad_norm(grads_f32)
         return new_params, new_state, info
 
     # -- checkpointing parity (amp.state_dict, frontend.py:367-404) -------

@@ -1,0 +1,91 @@
+"""Unified training telemetry: in-step taps -> host router -> sinks.
+
+The observability layer over L2-L4 of the stack (SURVEY map): the
+production-pretraining counterpart of TorchTitan's built-in metrics/MFU/
+profiling subsystem (PAPERS.md). Four cooperating pieces:
+
+- ``metrics``  — :class:`MetricBag`, a jit-compatible flax.struct pytree of
+  named scalar aggregates that lives INSIDE the compiled train step and is
+  fetched to host once per log interval, so the relay round-trip
+  (utils/benchmarking.py docstring: ~73 ms per synchronous fetch) is paid
+  O(1/interval), not per step. Plus grad-norm helpers and the reader for
+  ``sow("intermediates", ...)`` taps.
+- ``router``   — :class:`MetricRouter` fanning one shared record schema
+  (``make_record``) out to pluggable sinks: jsonl, CSV, stdout,
+  TensorBoard-if-importable, in-memory. ``Timers.write``, the resilience
+  anomaly log, and the examples all emit through it.
+- ``flops``    — analytic model-FLOPs counters for the GPT/BERT testing
+  models and the MFU / tokens-per-second arithmetic, built on the
+  slope-based timing primitives in utils/benchmarking.py.
+- ``watchdog`` — :class:`StallWatchdog` (heartbeat thread flagging a step
+  that exceeds its deadline; complements the SIGTERM-driven resilience
+  path, which only helps when the cluster TELLS us something died) and
+  :class:`ProfilerTrigger` (snapshots a ``jax.profiler`` trace window at a
+  requested step or when the anomaly sentinel escalates).
+- ``taps``     — the registered-taps table every ``sow`` name used in
+  ``apex_tpu/`` must appear in (lint-tested, so a layer refactor cannot
+  silently drop a metric).
+
+See docs/observability.md for the end-to-end wiring.
+"""
+
+from apex_tpu.monitor.metrics import (
+    MetricBag,
+    global_grad_norm,
+    host_fetch_count,
+    metric_bag,
+    per_layer_grad_norms,
+    read_bag,
+    reset_bag,
+    taps_from_intermediates,
+)
+from apex_tpu.monitor.router import (
+    CsvSink,
+    JsonlSink,
+    MemorySink,
+    MetricRouter,
+    Sink,
+    StdoutSink,
+    make_record,
+    try_tensorboard_sink,
+)
+from apex_tpu.monitor.flops import (
+    bert_flops_per_token,
+    gpt_flops_per_token,
+    mfu,
+    peak_flops_per_device,
+    tokens_per_second,
+    transformer_layer_flops_per_token,
+    training_flops_per_step,
+)
+from apex_tpu.monitor.watchdog import ProfilerTrigger, StallWatchdog
+from apex_tpu.monitor.taps import REGISTERED_TAPS
+
+__all__ = [
+    "MetricBag",
+    "metric_bag",
+    "reset_bag",
+    "read_bag",
+    "host_fetch_count",
+    "global_grad_norm",
+    "per_layer_grad_norms",
+    "taps_from_intermediates",
+    "MetricRouter",
+    "Sink",
+    "JsonlSink",
+    "CsvSink",
+    "StdoutSink",
+    "MemorySink",
+    "make_record",
+    "try_tensorboard_sink",
+    "transformer_layer_flops_per_token",
+    "gpt_flops_per_token",
+    "bert_flops_per_token",
+    "training_flops_per_step",
+    "tokens_per_second",
+    "mfu",
+    "peak_flops_per_device",
+    "StallWatchdog",
+    "ProfilerTrigger",
+    "REGISTERED_TAPS",
+]

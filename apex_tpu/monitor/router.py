@@ -1,0 +1,268 @@
+"""Host-side metric routing: one record schema, pluggable sinks.
+
+Every telemetry producer in the repo — the per-interval :class:`MetricBag`
+read, ``Timers.write``, the resilience anomaly stream — emits the SAME
+flat record shape (:func:`make_record`), so one consumer (a jsonl tailer,
+a dashboard) can join metrics with anomalies on ``step`` without per-
+producer parsers:
+
+    {"t": <unix time>, "step": <int>, "kind": <str>, ...fields}
+
+``kind`` partitions the stream: "metrics" (interval scalars), "timer"
+(named timer averages), and the resilience kinds ("skip", "rollback",
+"rollback_restore", "halt") which predate this module and keep their
+exact historical shape — the schema was chosen to match them.
+
+Sinks are deliberately dumb append-only writers; the router owns fan-out
+and failure isolation (one broken sink must not take down training — a
+metrics pipeline that can kill the run is worse than no metrics).
+"""
+
+import csv
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+logger = logging.getLogger("apex_tpu.monitor")
+
+
+def make_record(kind: str, step: int, **fields) -> dict:
+    """The one shared record shape (see module docstring)."""
+    return {"t": time.time(), "step": int(step), "kind": str(kind), **fields}
+
+
+class Sink:
+    """Append-only record consumer. Subclasses override :meth:`emit`."""
+
+    def emit(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySink(Sink):
+    """Records kept in a list — tests and programmatic consumers."""
+
+    def __init__(self):
+        self.records: List[dict] = []
+
+    def emit(self, record: dict) -> None:
+        self.records.append(record)
+
+
+class JsonlSink(Sink):
+    """One json object per line, append mode (the anomaly-log format)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+
+    def emit(self, record: dict) -> None:
+        self._f.write(json.dumps(record) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class CsvSink(Sink):
+    """CSV of ONE record kind (default "metrics"), header frozen from the
+    first accepted record's keys.
+
+    CSV is a fixed-schema format: other kinds (timer records, anomalies)
+    are FILTERED, not errored — pass ``kinds=None`` to accept everything
+    at your own risk, or use jsonl for open schemas. Later records may
+    omit columns (written empty); a genuinely new key after the header is
+    frozen is surfaced via the router's isolation log. Re-opening an
+    existing non-empty file adopts ITS header instead of writing a second
+    one mid-file (resume with the same --metrics-csv path).
+    """
+
+    def __init__(self, path: str, kinds=("metrics",)):
+        self.path = path
+        self.kinds = None if kinds is None else frozenset(kinds)
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._writer: Optional[csv.DictWriter] = None
+        header = None
+        if os.path.exists(path) and os.path.getsize(path) > 0:
+            with open(path, newline="") as f:
+                header = next(csv.reader(f), None)
+        self._f = open(path, "a", newline="")
+        if header:
+            self._writer = csv.DictWriter(self._f, fieldnames=header)
+
+    def emit(self, record: dict) -> None:
+        if self.kinds is not None and record.get("kind") not in self.kinds:
+            return
+        if self._writer is None:
+            self._writer = csv.DictWriter(self._f, fieldnames=list(record))
+            self._writer.writeheader()
+        self._writer.writerow(record)  # raises on extra keys
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class StdoutSink(Sink):
+    """Human-readable one-liners (the examples' console log).
+
+    "metrics" records render as ``step  NNNN loss   X.XXXX k v ...`` —
+    the exact prefix the example tests (and human eyeballs) key on; other
+    kinds render as ``[kind] step N k=v ...``.
+    """
+
+    def __init__(self, stream=None):
+        self.stream = stream or sys.stdout
+
+    @staticmethod
+    def _fmt(v) -> str:
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return str(v)
+
+    def emit(self, record: dict) -> None:
+        rest = {
+            k: v for k, v in record.items() if k not in ("t", "step", "kind")
+        }
+        if record["kind"] == "metrics":
+            parts = [f"step {record['step']:5d}"]
+            if "loss" in rest:
+                loss = rest.pop("loss")
+                parts.append(
+                    f"loss {loss:8.4f}" if loss is not None else "loss        -"
+                )
+            parts += [f"{k} {self._fmt(v)}" for k, v in rest.items()]
+            line = " ".join(parts)
+        else:
+            kv = " ".join(f"{k}={self._fmt(v)}" for k, v in rest.items())
+            line = f"[{record['kind']}] step {record['step']} {kv}".rstrip()
+        print(line, file=self.stream, flush=True)
+
+
+class TensorBoardSink(Sink):
+    """Scalar summaries via whichever TB writer the environment carries.
+
+    Probes ``tensorboardX`` then ``torch.utils.tensorboard``; construct
+    through :func:`try_tensorboard_sink` to gate on availability instead
+    of catching ImportError at every call site (nothing may be installed
+    here — the container rule is stub-or-gate, never pip install).
+    """
+
+    def __init__(self, log_dir: str):
+        writer_cls = _tb_writer_class()
+        if writer_cls is None:
+            raise ImportError(
+                "no TensorBoard writer importable (tried tensorboardX, "
+                "torch.utils.tensorboard)"
+            )
+        self._writer = writer_cls(log_dir)
+
+    def emit(self, record: dict) -> None:
+        step = record["step"]
+        kind = record["kind"]
+        for k, v in record.items():
+            if k in ("t", "step", "kind") or not isinstance(v, (int, float)):
+                continue
+            self._writer.add_scalar(f"{kind}/{k}", v, step)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+def _tb_writer_class():
+    try:
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter
+    except ImportError:
+        return None
+
+
+def try_tensorboard_sink(log_dir: str) -> Optional[TensorBoardSink]:
+    """A :class:`TensorBoardSink`, or None when no TB writer is importable."""
+    if _tb_writer_class() is None:
+        return None
+    return TensorBoardSink(log_dir)
+
+
+class MetricRouter:
+    """Fan one record stream out to sinks, isolating sink failures.
+
+    The single mouth of the telemetry pipeline: producers call
+    :meth:`metrics` / :meth:`event` / :meth:`emit`, and every configured
+    sink sees every record. A sink that raises is logged and skipped for
+    that record — it is NOT removed, so a transiently full disk resumes
+    logging when space returns. Fan-out is serialized under a lock: the
+    stall watchdog (and any other daemon thread) emits concurrently with
+    the training loop, and interleaved writes on a shared file object
+    would corrupt the stream.
+    """
+
+    def __init__(self, sinks: Sequence[Sink] = ()):
+        self.sinks: List[Sink] = list(sinks)
+        self._lock = threading.Lock()
+
+    def add_sink(self, sink: Sink) -> "MetricRouter":
+        self.sinks.append(sink)
+        return self
+
+    def emit(self, record: dict) -> None:
+        with self._lock:
+            for sink in self.sinks:
+                try:
+                    sink.emit(record)
+                except Exception as e:  # one sink must not kill the run
+                    logger.warning(
+                        "sink %s dropped record (step %s): %s",
+                        type(sink).__name__, record.get("step"), e,
+                    )
+
+    def metrics(self, step: int, **scalars) -> dict:
+        """Emit one interval's scalars as a kind='metrics' record."""
+        record = make_record("metrics", step, **scalars)
+        self.emit(record)
+        return record
+
+    def event(self, kind: str, step: int, **fields) -> dict:
+        """Emit a non-metrics record (anomalies, stalls, profiler marks)."""
+        record = make_record(kind, step, **fields)
+        self.emit(record)
+        return record
+
+    @property
+    def timer_write_fn(self):
+        """Adapter with the ``Timers(write_fn=...)`` signature
+        ``(name, value, iteration)`` — plugs the dangling callback in
+        utils/timers.py into this stream as kind='timer' records."""
+
+        def write(name: str, value: float, iteration: int) -> None:
+            self.event("timer", iteration, name=name, seconds=float(value))
+
+        return write
+
+    def close(self) -> None:
+        with self._lock:
+            for sink in self.sinks:
+                try:
+                    sink.close()
+                except Exception as e:  # pragma: no cover - best-effort
+                    logger.warning(
+                        "sink %s close failed: %s", type(sink).__name__, e
+                    )

@@ -1,0 +1,30 @@
+"""Registered in-graph metric taps (``sow("intermediates", name, ...)``).
+
+Every tap name sown anywhere under ``apex_tpu/`` MUST have a row here —
+a tier-1 lint test (tests/test_monitor.py) greps the source for sow
+calls and fails on unregistered names. The point is drift protection:
+metric taps die silently (a refactor renames a module, the sow vanishes,
+dashboards flatline weeks later); a registry the lint enforces turns
+that into a test failure at the PR that caused it.
+
+Reading taps: ``model.apply(..., mutable=["intermediates"])`` then
+``monitor.taps_from_intermediates(...)`` to flatten the collection into
+``{name: scalar}`` ready for a :class:`~apex_tpu.monitor.MetricBag`.
+"""
+
+#: tap name -> (where it is sown, what the value means)
+REGISTERED_TAPS = {
+    "moe_aux_loss": (
+        "transformer/layer.py ParallelTransformerLayer (MoE branch): the "
+        "load-balancing auxiliary loss of each MoE layer, BEFORE the "
+        "moe_aux_loss_coeff weighting"
+    ),
+    "layer_out_rms": (
+        "transformer/layer.py ParallelTransformerLayer (when "
+        "TransformerConfig.collect_layer_metrics): fp32 RMS of the "
+        "layer's output hidden states — the per-layer activation-scale "
+        "series that makes divergence onsets attributable to a depth"
+    ),
+}
+
+__all__ = ["REGISTERED_TAPS"]

@@ -1,0 +1,239 @@
+"""Stall watchdog and on-anomaly profiler capture.
+
+The resilience subsystem reacts to signals the system DELIVERS — SIGTERM
+before preemption, NaN verdicts from the sentinel. A wedged collective, a
+deadlocked host thread, or a relay hang delivers nothing: the step simply
+never finishes. :class:`StallWatchdog` is the complement — a daemon
+heartbeat thread that flags a step exceeding its deadline from OUTSIDE
+the (possibly stuck) training thread.
+
+:class:`ProfilerTrigger` closes the observability loop: when the sentinel
+escalates (or at a step requested up front with ``--profile-step``), it
+snapshots a ``jax.profiler.trace`` window around the next steps, so the
+capture of a pathological step exists BEFORE anyone knew to ask for it.
+Captures go to timestamped subdirs; ``annotate``/``step_annotation``
+spans (utils/timers.py) and ``jax.named_scope`` names (pipeline
+schedules) appear inside them.
+"""
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("apex_tpu.monitor")
+
+
+class StallWatchdog:
+    """Fire ``on_stall`` when no heartbeat lands within ``deadline_s``.
+
+    The training loop calls :meth:`beat` once per step; a daemon thread
+    polls the wall clock. On expiry, ``on_stall(info)`` runs ONCE in the
+    watchdog thread (info: last step, seconds since its beat) and the dog
+    re-arms on the next beat — a recovered stall can fire again, a dead
+    loop does not spam. The default action logs; pass a router-backed
+    callback (or a :class:`ProfilerTrigger`) for records/captures.
+
+    Usable as a context manager; ``beat`` and ``stop`` are thread-safe.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        on_stall: Optional[Callable[[dict], None]] = None,
+        poll_s: Optional[float] = None,
+    ):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        self.deadline_s = float(deadline_s)
+        self.poll_s = float(poll_s) if poll_s else min(1.0, self.deadline_s / 4)
+        self.on_stall = on_stall
+        self.stalls: List[dict] = []
+        self._lock = threading.Lock()
+        self._last_beat = time.monotonic()
+        self._last_step: Optional[int] = None
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "StallWatchdog":
+        if self._thread is not None:
+            raise RuntimeError("watchdog already started")
+        self._stop.clear()  # restartable after stop() (pause/resume)
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="apex-tpu-stall-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def beat(self, step: Optional[int] = None) -> None:
+        """Mark the training loop alive (call once per completed step)."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            if step is not None:
+                self._last_step = int(step)
+            self._fired = False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                overdue = time.monotonic() - self._last_beat
+                fired, step = self._fired, self._last_step
+                if overdue > self.deadline_s and not fired:
+                    self._fired = True
+                else:
+                    continue
+            info = {
+                "step": step,
+                "overdue_s": overdue,
+                "deadline_s": self.deadline_s,
+            }
+            self.stalls.append(info)
+            logger.warning(
+                "stall: no step heartbeat for %.1fs (deadline %.1fs, "
+                "last step %s)", overdue, self.deadline_s, step,
+            )
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(info)
+                except Exception as e:  # the dog must outlive its handler
+                    logger.warning("on_stall handler failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.poll_s)
+            self._thread = None
+
+    def __enter__(self) -> "StallWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+class ProfilerTrigger:
+    """Capture a ``jax.profiler`` trace window on demand.
+
+    Drive it from the step loop::
+
+        trigger = ProfilerTrigger(log_dir, window_steps=2)
+        trigger.request(step=args.profile_step)      # up-front request
+        while ...:
+            trigger.maybe_start(step)                # BEFORE the step
+            ... run step, read verdict ...
+            trigger.on_verdict(step, int(verdict))   # anomaly capture
+            trigger.maybe_stop(step)                 # AFTER block_until_ready
+
+    ``on_verdict`` arms a capture of the NEXT ``window_steps`` steps when
+    the sentinel says ROLLBACK or worse — the steps that re-run the
+    region that just blew up. One capture at a time; each lands in
+    ``<log_dir>/<tag>-step<NNN>`` and is appended to ``captures``.
+    Profiler failures are logged, never raised: losing a trace must not
+    lose the run. Remember the benchmarking caveat: callers must
+    ``jax.block_until_ready`` the step's outputs before ``maybe_stop`` or
+    in-flight device work leaks out of the window.
+    """
+
+    def __init__(
+        self,
+        log_dir: str,
+        window_steps: int = 2,
+        on_capture: Optional[Callable[[dict], None]] = None,
+    ):
+        if window_steps < 1:
+            raise ValueError(f"window_steps must be >= 1, got {window_steps}")
+        self.log_dir = log_dir
+        self.window_steps = int(window_steps)
+        self.on_capture = on_capture
+        self.captures: List[dict] = []
+        self._requested: Optional[dict] = None  # {"step": int|None, "reason"}
+        self._active: Optional[dict] = None
+
+    # -- arming ------------------------------------------------------------
+
+    def request(self, step: Optional[int] = None, reason: str = "requested") -> None:
+        """Arm a capture: at ``step`` (None/past-due = the next step).
+
+        An immediate request (``step=None`` — the anomaly path) REPLACES
+        a pending scheduled one: the blowup happening now outranks a
+        --profile-step appointment for later. A capture already rolling
+        is never preempted.
+        """
+        if self._active is not None:
+            return
+        pending = self._requested
+        if pending is None or (step is None and pending["step"] is not None):
+            self._requested = {"step": step, "reason": reason}
+
+    def on_verdict(self, step: int, verdict: int) -> None:
+        """Arm on sentinel escalation (>= VERDICT_ROLLBACK)."""
+        from apex_tpu.resilience.sentinel import VERDICT_ROLLBACK
+
+        if int(verdict) >= VERDICT_ROLLBACK:
+            self.request(reason=f"verdict={int(verdict)}")
+
+    # -- step-loop hooks ---------------------------------------------------
+
+    def maybe_start(self, step: int) -> bool:
+        """Start the trace if a request is due at ``step``; True if so."""
+        req = self._requested
+        if req is None or self._active is not None:
+            return False
+        if req["step"] is not None and step < req["step"]:
+            return False
+        path = os.path.join(
+            self.log_dir, f"{req['reason'].replace('=', '')}-step{step:06d}"
+        )
+        import jax
+
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            logger.warning("profiler capture failed to start: %s", e)
+            self._requested = None
+            return False
+        self._requested = None
+        self._active = {
+            "path": path, "start_step": step, "reason": req["reason"],
+        }
+        logger.info("profiler capture started: %s", path)
+        return True
+
+    def maybe_stop(self, step: int) -> Optional[dict]:
+        """Stop after ``window_steps`` steps; returns the capture info."""
+        act = self._active
+        if act is None or step - act["start_step"] + 1 < self.window_steps:
+            return None
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - backend-dependent
+            logger.warning("profiler capture failed to stop: %s", e)
+            self._active = None
+            return None
+        self._active = None
+        info = {**act, "end_step": step}
+        self.captures.append(info)
+        if self.on_capture is not None:
+            try:
+                self.on_capture(info)
+            except Exception as e:
+                logger.warning("on_capture handler failed: %s", e)
+        logger.info("profiler capture written: %s", act["path"])
+        return info
+
+    def close(self) -> None:
+        """Abort any in-flight capture (end of run)."""
+        if self._active is not None:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover
+                pass
+            self._active = None

@@ -148,13 +148,18 @@ def pipeline_forward(
     state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
-        recv = p2p.send_forward_recv_forward(state, axis_name)
+        # named scopes are the per-phase timing taps: they label the HLO
+        # ops, so profiler captures (monitor.ProfilerTrigger, utils.trace)
+        # attribute each tick's time to edge-transfer vs stage compute
+        with jax.named_scope("pp_p2p"):
+            recv = p2p.send_forward_recv_forward(state, axis_name)
         mb = _index(microbatches, jnp.clip(t, 0, num_micro - 1))
         is_first = rank == 0
         x = jax.tree_util.tree_map(
             lambda a, b: jnp.where(is_first, a, b), mb, recv
         )
-        y = body(params, x)
+        with jax.named_scope("pp_stage"):
+            y = body(params, x)
         return y, y
 
     num_ticks = num_micro + num_stages - 1
@@ -230,7 +235,9 @@ def pipeline_forward_interleaved(
     state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
-        recv = p2p.ring_forward(state, axis_name)
+        # per-phase profiler taps, as in pipeline_forward
+        with jax.named_scope("pp_p2p"):
+            recv = p2p.ring_forward(state, axis_name)
         u = t - rank
         uc = jnp.clip(u, 0, V * num_micro - 1)
         v = (uc % (V * num_stages)) // num_stages
@@ -241,7 +248,8 @@ def pipeline_forward_interleaved(
         x = jax.tree_util.tree_map(
             lambda a, b: jnp.where(takes_input, a, b), mb, recv
         )
-        y = body(params_chunks, v, x)
+        with jax.named_scope("pp_stage"):
+            y = body(params_chunks, v, x)
         return y, y
 
     num_ticks = V * num_micro + num_stages - 1
@@ -442,15 +450,19 @@ def forward_backward_with_pre_post(
     ``params``.
     """
     def total_loss(p):
-        h = jax.vmap(lambda mb: pre_fn(p["pre"], mb))(inputs)
+        # pre/stages/post named scopes: the per-phase breakdown a profiler
+        # capture shows for the full pipelined step
+        with jax.named_scope("pp_pre"):
+            h = jax.vmap(lambda mb: pre_fn(p["pre"], mb))(inputs)
         outs = _stages_forward(
             stage_fn, p["stages"], h, axis_name=axis_name, remat=remat,
             num_model_chunks=num_model_chunks,
             tick_block_remat=tick_block_remat,
         )
-        losses = jax.vmap(
-            lambda y, t: post_loss_fn(p["post"], y, t)
-        )(outs, targets)
+        with jax.named_scope("pp_post"):
+            losses = jax.vmap(
+                lambda y, t: post_loss_fn(p["post"], y, t)
+            )(outs, targets)
         return _publish_losses(losses, axis_name)
 
     (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)

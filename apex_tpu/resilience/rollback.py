@@ -19,7 +19,11 @@ in-graph verdict (resilience.sentinel) to an action under a bounded
   one (the newest "good" state evidently wasn't);
 - each rollback dampens the LR (multiply ``lr_scale`` into the update
   inside the step) so the run re-approaches the cliff more slowly;
-- every anomaly is appended to a per-run jsonl anomaly log.
+- every anomaly is appended to a per-run jsonl anomaly log AND emitted
+  through the shared telemetry schema (``apex_tpu.monitor.make_record``):
+  pass ``router=`` a :class:`~apex_tpu.monitor.MetricRouter` and the
+  anomaly stream lands in the same sinks as the metric stream, joinable
+  on ``step`` (one record shape for anomalies and metrics).
 
 The data stream rewinds with the state: ``rollback()`` returns the step
 to resume FROM, and the caller rebuilds its sampler/iterator at that
@@ -32,7 +36,6 @@ import dataclasses
 import json
 import logging
 import os
-import time
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
@@ -154,11 +157,13 @@ class ResilienceManager:
         policy: Optional[EscalationPolicy] = None,
         log_path: Optional[str] = None,
         on_event: Optional[Callable[[dict], None]] = None,
+        router=None,
     ):
         self.buffer = buffer
         self.policy = policy or EscalationPolicy()
         self.log_path = log_path
         self.on_event = on_event
+        self.router = router
         self.lr_scale = 1.0
         self.rollbacks_used = 0
         self.events: List[dict] = []
@@ -169,7 +174,12 @@ class ResilienceManager:
     # -- anomaly log -------------------------------------------------------
 
     def _record(self, step: int, kind: str, **fields) -> dict:
-        event = {"t": time.time(), "step": int(step), "kind": kind, **fields}
+        # the monitor schema IS the historical anomaly-log line shape
+        # ({"t", "step", "kind", ...}), so routing through it keeps every
+        # existing anomalies.jsonl consumer working byte-for-byte
+        from apex_tpu.monitor.router import make_record
+
+        event = make_record(kind, step, **fields)
         self.events.append(event)
         if self.log_path:
             try:
@@ -177,6 +187,8 @@ class ResilienceManager:
                     f.write(json.dumps(event) + "\n")
             except OSError as e:  # pragma: no cover - log loss is non-fatal
                 logger.warning("anomaly log write failed: %s", e)
+        if self.router is not None:
+            self.router.emit(event)
         if self.on_event:
             self.on_event(event)
         return event

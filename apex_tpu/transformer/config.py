@@ -78,6 +78,12 @@ class TransformerConfig:
     moe_aux_loss_coeff: float = 0.01
     recompute_granularity: Optional[str] = None  # None | "full" | "selective"
 
+    # telemetry (apex_tpu.monitor): sow a per-layer output-RMS tap
+    # ("layer_out_rms" under the "intermediates" collection) from every
+    # ParallelTransformerLayer. Off by default — readers must pass
+    # mutable=["intermediates"] to apply() to collect it.
+    collect_layer_metrics: bool = False
+
     # dtypes: params live in fp32, compute in bf16 by default (TPU-native
     # replacement for the reference's fp16 O2 regime)
     params_dtype: jnp.dtype = jnp.float32

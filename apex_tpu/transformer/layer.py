@@ -711,9 +711,20 @@ class ParallelTransformerLayer(nn.Module):
             mlp_out = ShardAwareDropout(
                 rate=cfg.hidden_dropout, axis_names=_hidden_dropout_axes(cfg)
             )(mlp_out, deterministic=deterministic)
-        return (residual.astype(rdtype) + mlp_out.astype(rdtype)).astype(
+        out = (residual.astype(rdtype) + mlp_out.astype(rdtype)).astype(
             hidden_states.dtype
         )
+        if cfg.collect_layer_metrics:
+            # per-layer activation-scale tap (registered in monitor/taps.py;
+            # read via monitor.taps_from_intermediates): fp32 RMS of the
+            # block output, the series that localizes a divergence to a
+            # depth before it reaches the loss
+            self.sow(
+                "intermediates",
+                "layer_out_rms",
+                jnp.sqrt(jnp.mean(jnp.square(out.astype(jnp.float32)))),
+            )
+        return out
 
 
 class ParallelTransformer(nn.Module):

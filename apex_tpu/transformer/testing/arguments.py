@@ -535,6 +535,11 @@ def _add_vision_args(parser):
 
 def _add_logging_args(parser):
     group = parser.add_argument_group(title="logging")
+    # apex_tpu.monitor extension: per-run metric records (loss, tokens/s,
+    # MFU) through the shared MetricRouter sink schema
+    group.add_argument("--metrics-jsonl", type=str, default=None,
+                       help="write kind='metrics' jsonl records here "
+                            "(apex_tpu.monitor schema)")
     group.add_argument("--log-params-norm", action="store_true")
     group.add_argument("--log-num-zeros-in-grad", action="store_true")
     group.add_argument("--tensorboard-log-interval", type=int, default=1)

@@ -53,6 +53,24 @@ def gpt_config_from_args(args) -> TransformerConfig:
     )
 
 
+def _make_router(args):
+    """Telemetry sinks from the Megatron argument surface: jsonl via
+    ``--metrics-jsonl``, TensorBoard via ``--tensorboard-dir`` (gated on a
+    writer being importable), one shared record schema with the other
+    producers (apex_tpu.monitor, docs/observability.md). None when no
+    sink is requested."""
+    from apex_tpu import monitor
+
+    sinks = []
+    if getattr(args, "metrics_jsonl", None):
+        sinks.append(monitor.JsonlSink(args.metrics_jsonl))
+    if getattr(args, "tensorboard_dir", None):
+        tb = monitor.try_tensorboard_sink(args.tensorboard_dir)
+        if tb is not None:
+            sinks.append(tb)
+    return monitor.MetricRouter(sinks) if sinks else None
+
+
 def run_gpt(args=None, log=print):
     """Build mesh + model from args, train ``--train-iters`` steps, return
     the per-step loss list (every loss is the dp/pp-published global mean)."""
@@ -137,9 +155,45 @@ def run_gpt(args=None, log=print):
         _, losses = jax.lax.scan(one_step, (params, opt_state), (tokens, labels))
         return losses
 
-    losses = jax.device_get(train(tokens, labels))
+    import time
+
+    t0 = time.perf_counter()
+    losses = jax.device_get(train(tokens, labels))  # one fetch for ALL steps
+    elapsed = max(time.perf_counter() - t0, 1e-9)
     for i, l in enumerate(losses):
         log(f"iteration {i:4d} | lm loss {float(l):.4f}")
+
+    router = _make_router(args)
+    if router is not None:
+        from apex_tpu import monitor
+
+        interval = max(1, args.log_interval or 1)
+        for i, l in enumerate(losses):
+            if i % interval == 0 or i == len(losses) - 1:
+                router.metrics(i, loss=float(l))
+        # the whole run is ONE jitted scan, so per-step device time is not
+        # separable here; the throughput record is honest about covering
+        # compile + relay dispatch + all steps (slope-based per-step
+        # timing lives in utils/benchmarking.py)
+        # num_micro may be rounded UP to a pp multiple above — count the
+        # tokens the scan actually processed, not the nominal global batch
+        tokens_per_step = num_micro * mb * dp * seq
+        sec_per_step = elapsed / max(1, steps)
+        router.event(
+            "throughput", steps - 1,
+            tokens_per_s=monitor.tokens_per_second(
+                tokens_per_step * steps, elapsed
+            ),
+            mfu=monitor.mfu(
+                monitor.training_flops_per_step(
+                    monitor.gpt_flops_per_token(cfg, seq), tokens_per_step
+                ),
+                sec_per_step,
+                num_devices=len(jax.devices()),
+            ),
+            wall_s=elapsed,
+        )
+        router.close()
     parallel_state.destroy_model_parallel()
     return [float(l) for l in losses]
 

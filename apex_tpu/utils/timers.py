@@ -66,9 +66,21 @@ class Timers:
             self.timers[name] = _Timer(name)
         return self.timers[name]
 
-    def write(self, names, iteration: int, normalizer: float = 1.0):
+    def write(self, names, iteration: int, normalizer: float = 1.0,
+              reset: bool = True):
+        """Push each named timer's elapsed seconds to ``write_fn``.
+
+        ``reset`` defaults to True (matching the reference Megatron
+        ``Timers.write``): each write reports THIS interval's time. The
+        old behavior hard-coded ``elapsed(reset=False)``, so successive
+        writes reported an ever-growing cumulative total — pass
+        ``reset=False`` only if that is genuinely what a sink wants.
+        Plug ``MetricRouter.timer_write_fn`` (apex_tpu.monitor) in as
+        ``write_fn`` to emit kind='timer' records.
+        """
+        assert normalizer > 0.0
         for name in names:
-            value = self.timers[name].elapsed(reset=False) / normalizer
+            value = self.timers[name].elapsed(reset=reset) / normalizer
             if self.write_fn is not None:
                 self.write_fn(f"{name}-time", value, iteration)
 

@@ -6,6 +6,16 @@ trained from a memory-mapped token corpus through the native data path
 (apex_tpu.data), Megatron-style tensor/sequence parallelism over a mesh,
 FusedAdam, dynamic loss scaling, named timers, and orbax checkpoints.
 
+Telemetry (apex_tpu.monitor, docs/observability.md): the step folds loss,
+grad norm, loss scale, sentinel z-score and skip counts into an on-device
+``MetricBag`` and the host fetches it ONCE per ``--log-interval``; records
+(incl. tokens/s and analytic MFU) fan out to stdout and, with
+``--metrics-jsonl``/``--metrics-csv``/``--tensorboard-dir``, to file
+sinks — the anomaly stream below shares the same record schema. A stall
+watchdog (``--step-deadline``) flags wedged steps and
+``--profile-step`` / sentinel escalation snapshot a profiler trace
+window under ``--profile-dir``.
+
 Resilience (apex_tpu.resilience, docs/resilience.md): the step carries an
 anomaly-sentinel state next to the scaler state; loss spikes / NaNs gate
 the update inside the compiled step, and the host escalates skip ->
@@ -31,6 +41,7 @@ import argparse
 import functools
 import os
 import tempfile
+import time
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +91,25 @@ def parse_args():
                    help="lr_scale multiplier applied on each rollback")
     p.add_argument("--anomaly-log", default=None,
                    help="jsonl anomaly log (default: <save>/anomalies.jsonl)")
+    # telemetry (apex_tpu.monitor; docs/observability.md): metrics are
+    # aggregated ON DEVICE in a MetricBag and fetched once per interval —
+    # through the relay a host fetch costs ~73 ms, so per-step logging
+    # would dominate small steps
+    p.add_argument("--log-interval", type=int, default=5,
+                   help="steps between metric records (and bag fetches)")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write metric/anomaly/timer records to this jsonl")
+    p.add_argument("--metrics-csv", default=None,
+                   help="also write metric records to this CSV")
+    p.add_argument("--tensorboard-dir", default=None,
+                   help="also write scalars to TensorBoard (if importable)")
+    p.add_argument("--profile-step", type=int, default=None,
+                   help="capture a jax.profiler trace window at this step")
+    p.add_argument("--profile-dir", default=None,
+                   help="profiler capture dir (default: <save>/profiles)")
+    p.add_argument("--step-deadline", type=float, default=None,
+                   help="stall watchdog: flag a step exceeding this many "
+                        "seconds (default: off)")
     # fault injection (apex_tpu.resilience.chaos) — for tests and drills
     p.add_argument("--chaos-nan-steps", default="",
                    help="comma/range list of steps whose loss is NaN-poisoned")
@@ -112,10 +142,10 @@ def main():
     from apex_tpu.parallel import parallel_state
     from apex_tpu.parallel.ddp import all_reduce_gradients
     from apex_tpu.parallel.utils import vma_cond
-    from apex_tpu.transformer import TransformerConfig
+    from apex_tpu.transformer import TransformerConfig, calc_params_l2_norm
     from apex_tpu.utils import AutoResume, Timers
     from apex_tpu.utils.pytree import tree_any_non_finite
-    from apex_tpu import resilience
+    from apex_tpu import monitor, resilience
     from apex_tpu.resilience import chaos
 
     import optax
@@ -159,19 +189,42 @@ def main():
         rollback_budget=args.rollback_budget,
     )
 
+    # tp-replicated params (counted once in the tp-aware grad norm, not
+    # per rank): norms, position table, and row-parallel biases — the
+    # Megatron tensor_model_parallel-attribute convention
+    def tp_duplicated(path):
+        return ("layernorm" in path or "position_embeddings" in path
+                or path.endswith("dense/bias")
+                or path.endswith("dense_4h_to_h/bias"))
+
+    # in-step metric taps: every scalar the host wants to SEE (as opposed
+    # to branch on) accumulates on device and crosses once per interval
+    METRIC_SPEC = {
+        "loss": "mean",          # unscaled, dp-averaged
+        "grad_norm": "mean",     # global L2 of the unscaled grads
+        "loss_scale": "last",    # dynamic-scaler gauge
+        "loss_z": "last",        # sentinel z-score of this loss
+        "skipped": "sum",        # updates suppressed this interval
+        "anomalies": "last",     # sentinel's running total this run
+    }
+
     # donated carried state: params/opt/scaler/sentinel buffers are reused
     # in place across the Python step loop instead of double-buffering the
     # full parameter set in HBM (the torch reference mutates in place for
-    # free; under jit, donation is the explicit equivalent)
+    # free; under jit, donation is the explicit equivalent). The metric
+    # bag is deliberately NOT donated: its leaves are a handful of
+    # scalars (no HBM to save), and donating host-rebuilt interval resets
+    # risks buffer aliasing across leaves
     @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(None, "dp"), P(None, "dp"), P(), P()),
-        out_specs=(P(), P(), P(), P(), P(), P()),
+        in_specs=(P(), P(), P(), P(), P(), P(None, "dp"), P(None, "dp"),
+                  P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )
-    def train_step(params, opt_state, scaler_state, sent_state, tokens,
+    def train_step(params, opt_state, scaler_state, sent_state, bag, tokens,
                    labels, inject_nan, lr_scale):
         # tokens: (num_micro, micro*dp, seq) -> this dp shard's microbatches
         def micro_loss(p, tok, lab):
@@ -219,8 +272,30 @@ def main():
             sent_state, unscaled, anomaly=gate,
             bad_params=tree_any_non_finite(new_params),
         )
+        # metric taps: cheap scalars folded into the on-device bag; the
+        # z-score reuses the sentinel's pre-update EMA/var, so the record
+        # shows exactly the statistic the verdict was computed from
+        new_bag = bag.add(
+            loss=unscaled,
+            # tp-AWARE global norm: grads of tp-sharded weights are local
+            # shards inside shard_map, so the partial sums psum over tp
+            # (replicated params counted on rank 0 only); a plain
+            # global_grad_norm here would report one shard's norm
+            grad_norm=calc_params_l2_norm(
+                grads, tp_duplicate_predicate=tp_duplicated, axis_name="tp"
+            ),
+            loss_scale=new_scaler_state.scale,
+            loss_z=jnp.where(
+                sent_state.count > 0,  # cold-start var=0 makes z garbage
+                (unscaled - sent_state.ema)
+                * jax.lax.rsqrt(sent_state.var + 1e-12),
+                0.0,
+            ),
+            skipped=jnp.asarray(gate, jnp.float32),
+            anomalies=jnp.asarray(new_sent_state.anomalies, jnp.float32),
+        )
         return (new_params, new_opt_state, new_scaler_state, new_sent_state,
-                unscaled, verdict)
+                new_bag, unscaled, verdict)
 
     # tp-sharded init must run under the mesh like the step
     @functools.partial(
@@ -239,6 +314,54 @@ def main():
     opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
     scaler_state = jax.device_put(scaler.init(), replicated)
     sent_state = jax.device_put(sentinel.init(), replicated)
+    bag = jax.device_put(monitor.metric_bag(METRIC_SPEC), replicated)
+
+    # host half of the telemetry: one router, every producer (metric bag,
+    # timers, anomaly stream) emits the same record schema through it
+    sinks = [monitor.StdoutSink()]
+    if args.metrics_jsonl:
+        sinks.append(monitor.JsonlSink(args.metrics_jsonl))
+    if args.metrics_csv:
+        sinks.append(monitor.CsvSink(args.metrics_csv))
+    if args.tensorboard_dir:
+        tb = monitor.try_tensorboard_sink(args.tensorboard_dir)
+        if tb is None:
+            print("no TensorBoard writer importable; --tensorboard-dir ignored")
+        else:
+            sinks.append(tb)
+    router = monitor.MetricRouter(sinks)
+
+    # analytic model FLOPs for MFU/throughput (docs/observability.md);
+    # peak is None off-TPU unless APEX_TPU_PEAK_FLOPS pins it, and the
+    # mfu field is then emitted as null rather than against a fake peak
+    flops_per_token = monitor.gpt_flops_per_token(cfg, args.seq_len)
+    tokens_per_step = args.global_batch * args.seq_len
+    peak_flops = monitor.peak_flops_per_device()
+
+    profile_dir = args.profile_dir or os.path.join(
+        args.save if args.save else tempfile.gettempdir(), "profiles"
+    )
+    trigger = monitor.ProfilerTrigger(
+        profile_dir, window_steps=2,
+        on_capture=lambda info: router.event(
+            "profile", info["start_step"],
+            path=info["path"], reason=info["reason"],
+        ),
+    )
+    if args.profile_step is not None:
+        trigger.request(step=args.profile_step)
+    # created here, STARTED after the first completed step: the deadline
+    # is a steady-state bound, and arming it across restore + trace +
+    # first-step compile would flag every healthy run as stalled
+    watchdog = None
+    if args.step_deadline:
+        watchdog = monitor.StallWatchdog(
+            args.step_deadline,
+            on_stall=lambda info: router.event(
+                "stall", -1 if info["step"] is None else info["step"],
+                overdue_s=info["overdue_s"], deadline_s=info["deadline_s"],
+            ),
+        )
 
     # chaos drill: corrupt the newest checkpoint BEFORE restore — the
     # verified restore must fall back to the previous intact step
@@ -284,6 +407,7 @@ def main():
         ),
         log_path=args.anomaly_log
         or (os.path.join(args.save, "anomalies.jsonl") if args.save else None),
+        router=router,  # anomalies join the metric stream, same schema
     )
     plan = chaos.FaultPlan(
         nan_steps=args.chaos_nan_steps,
@@ -304,29 +428,43 @@ def main():
             data_parallel_size=1,
         ))
 
-    timers = Timers()
+    timers = Timers(write_fn=router.timer_write_fn)
     it = make_iter(step0)
     # seed the ring so an anomaly before the first cadence point can still
     # roll back instead of escalating straight to halt
     mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
     steps_run = 0
+    steps_since_emit = 0
+    last_emit_t = time.perf_counter()
     step_i = step0
     while step_i < args.steps:
         idx = next(it)
         x, y = lm.batch(idx)
         x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+        trigger.maybe_start(step_i)
         timers("step").start()
-        params, opt_state, scaler_state, sent_state, loss, verdict = train_step(
-            params, opt_state, scaler_state, sent_state,
+        (params, opt_state, scaler_state, sent_state, bag, loss,
+         verdict) = train_step(
+            params, opt_state, scaler_state, sent_state, bag,
             jnp.asarray(x), jnp.asarray(y),
             jnp.asarray(plan.take_nan(step_i), jnp.float32),
             jnp.asarray(mgr.lr_scale, jnp.float32),
         )
+        # the loss/verdict fetch below is the step's host sync point, so
+        # the profiler window closes on completed device work
         timers("step").stop(barrier_on=loss)
         steps_run += 1
+        steps_since_emit += 1
+        if watchdog is not None:
+            if steps_run == 1:
+                watchdog.start()  # compile is behind us; deadline arms now
+            watchdog.beat(step_i)
+        verdict_code = int(verdict)  # ONE fetch; reused below (relay RTT)
+        trigger.on_verdict(step_i, verdict_code)
+        trigger.maybe_stop(step_i)
         state = (params, opt_state, scaler_state, sent_state)
-        action = mgr.resolve(step_i, int(verdict), loss=float(loss))
+        action = mgr.resolve(step_i, verdict_code, loss=float(loss))
         if action == "halt":
             # save the newest KNOWN-GOOD state, not the possibly-corrupt
             # live one, then stop: the anomaly outlived every budget
@@ -359,22 +497,53 @@ def main():
                   f"(loss {float(loss):.4f})")
         else:
             mgr.observe_good(step_i + 1, state)
-        if step_i % 5 == 0 or step_i == args.steps - 1:
-            print(
-                f"step {step_i:5d} loss {float(loss):8.4f} "
-                f"scale {float(scaler_state.scale):9.1f}"
+        if step_i % args.log_interval == 0 or step_i == args.steps - 1:
+            # ONE device-to-host metrics fetch per interval (the packed
+            # MetricBag vector); everything else in the record is host math
+            vals = monitor.read_bag(bag)
+            secs = max(time.perf_counter() - last_emit_t, 1e-9)
+            sec_per_step = secs / steps_since_emit
+            router.metrics(
+                step_i,
+                **vals,
+                tokens_per_s=monitor.tokens_per_second(
+                    tokens_per_step * steps_since_emit, secs
+                ),
+                mfu=monitor.mfu(
+                    monitor.training_flops_per_step(
+                        flops_per_token, tokens_per_step
+                    ),
+                    sec_per_step,
+                    num_devices=len(jax.devices()),
+                    peak_flops=peak_flops,
+                ),
+                step_ms=1000.0 * sec_per_step,
             )
+            # interval-mean step timer as a kind='timer' record; reset=True
+            # (the write-parity fix) so each write covers ITS interval only
+            timers.write(["step"], step_i, normalizer=steps_since_emit)
+            bag = jax.device_put(monitor.reset_bag(bag), replicated)
+            steps_since_emit = 0
+            last_emit_t = time.perf_counter()
         plan.maybe_sigterm(step_i)
         if ar is not None and ar.step(step_i + 1, state):
             print(f"termination checkpoint at step {step_i + 1}; exiting")
             break
         step_i += 1
-    timers.log(["step"], normalizer=max(1, steps_run))
     if mgr.events:
         print(f"anomalies this run: {len(mgr.events)} "
               f"(rollbacks {mgr.rollbacks_used}, lr_scale {mgr.lr_scale:.3f})")
+    router.event(
+        "summary", step_i, steps_run=steps_run, anomalies=len(mgr.events),
+        rollbacks=mgr.rollbacks_used, lr_scale=mgr.lr_scale,
+        profiles=len(trigger.captures),
+    )
+    if watchdog is not None:
+        watchdog.stop()
+    trigger.close()  # abort any capture still open (end of run)
     if ar is not None:
         ar.close()  # finalize any in-flight interval save (manifest commit)
+    router.close()
 
 
 if __name__ == "__main__":

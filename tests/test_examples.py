@@ -16,11 +16,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(script, args):
+def _run(script, args, extra_env=None):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        **(extra_env or {}),
     )
     # examples force the CPU backend themselves is NOT guaranteed — do it
     # the way a user on this box must (tests/conftest.py pattern)
@@ -53,14 +54,31 @@ def test_imagenet_example():
     assert "done: 3 steps" in out
 
 
-def test_gpt_pretrain_example():
+def test_gpt_pretrain_example(tmp_path):
     # conftest's XLA_FLAGS gives the subprocess 8 virtual devices => dp=8;
-    # micro-batch 1 x dp 8 must divide the global batch
+    # micro-batch 1 x dp 8 must divide the global batch. The telemetry
+    # flags ride along: the jsonl sink must produce parseable records
+    # carrying the full acceptance set (loss, grad-norm, loss-scale,
+    # tokens/s, MFU) per interval; the peak-FLOPs pin makes MFU a real
+    # number on the CPU mesh instead of null.
+    import json
+
+    jsonl = tmp_path / "metrics.jsonl"
     out = _run("examples/gpt/pretrain_gpt.py",
                ["--steps", "3", "--layers", "2", "--hidden", "64",
                 "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
-                "--global-batch", "16"])
+                "--global-batch", "16", "--log-interval", "2",
+                "--metrics-jsonl", str(jsonl)],
+               extra_env={"APEX_TPU_PEAK_FLOPS": "1e12"})
     assert "step " in out
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    metrics = [r for r in records if r["kind"] == "metrics"]
+    assert len(metrics) == 2  # steps 0..2, interval 2 -> steps 0 and 2
+    for rec in metrics:
+        for key in ("loss", "grad_norm", "loss_scale", "tokens_per_s", "mfu"):
+            assert isinstance(rec[key], float), (key, rec)
+    assert any(r["kind"] == "timer" for r in records)
+    assert any(r["kind"] == "summary" for r in records)
 
 
 def test_gpt_pretrain_resume(tmp_path):
@@ -81,15 +99,23 @@ def test_gpt_pretrain_chaos(tmp_path):
     an injected NaN step (rollback) and a SIGTERM (durable termination
     checkpoint); run B starts with that newest checkpoint bit-flipped
     and must fall back to the previous verified step, then finish."""
+    import json
+
     base = ["--layers", "2", "--hidden", "64", "--heads", "4",
             "--seq-len", "32", "--micro-batch", "1", "--global-batch", "16",
             "--save", str(tmp_path), "--save-interval", "4",
             "--snapshot-interval", "2", "--skip-budget", "0"]
+    jsonl = tmp_path / "metrics.jsonl"
     out = _run("examples/gpt/pretrain_gpt.py",
                ["--steps", "12", "--chaos-nan-steps", "6",
-                "--chaos-sigterm-step", "9"] + base)
+                "--chaos-sigterm-step", "9",
+                "--metrics-jsonl", str(jsonl)] + base)
     assert "rolled back to step 6" in out
     assert "termination checkpoint at step 10; exiting" in out
+    # anomalies and metrics share one record schema in ONE stream: the
+    # rollback events land in the same jsonl as the interval metrics
+    kinds = {json.loads(l)["kind"] for l in jsonl.read_text().splitlines()}
+    assert {"metrics", "rollback", "rollback_restore"} <= kinds
 
     out = _run("examples/gpt/pretrain_gpt.py",
                ["--steps", "12", "--chaos-corrupt-latest", "bitflip"] + base)

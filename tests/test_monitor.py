@@ -1,0 +1,625 @@
+"""Telemetry subsystem (apex_tpu.monitor): in-step MetricBag, router
+fan-out, FLOPs/MFU arithmetic, stall watchdog, profiler trigger, and the
+registered-taps lint that keeps ``sow`` names from drifting.
+
+The load-bearing contract is the fetch cadence: metrics cross
+device->host ONCE per log interval (through the relay each crossing is a
+~73 ms round-trip, utils/benchmarking.py), so the bag tests count actual
+fetches via ``monitor.host_fetch_count`` instead of trusting comments.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.compat import shard_map
+from jax.sharding import PartitionSpec as P
+
+APEX_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "apex_tpu"
+)
+
+
+class TestMetricBag:
+    SPEC = {"loss": "mean", "skips": "sum", "scale": "last", "peak": "max"}
+
+    def _filled(self):
+        bag = monitor.metric_bag(self.SPEC)
+        for v in (1.0, 2.0, 6.0):
+            bag = bag.add(
+                loss=v, skips=float(v > 1), scale=2 * v, peak=v
+            )
+        return bag
+
+    def test_mode_math(self):
+        vals = monitor.read_bag(self._filled())
+        assert vals == {"loss": 3.0, "skips": 2.0, "scale": 12.0, "peak": 6.0}
+
+    def test_unknown_metric_raises(self):
+        bag = monitor.metric_bag(self.SPEC)
+        with pytest.raises(KeyError, match="lss"):
+            bag.add(lss=1.0)
+
+    def test_non_scalar_raises(self):
+        bag = monitor.metric_bag(self.SPEC)
+        with pytest.raises(ValueError, match="scalar"):
+            bag.add(loss=jnp.ones((2,)))
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="modes"):
+            monitor.metric_bag({"x": "median"})
+
+    def test_empty_bag_reads_none(self):
+        # mean of zero add() calls is 0/0 and max of none is -inf: both
+        # must surface as None (json null), never as a fake number
+        vals = monitor.read_bag(monitor.metric_bag(self.SPEC))
+        assert vals["loss"] is None and vals["peak"] is None
+
+    def test_omitted_metric_semantics(self):
+        bag = monitor.metric_bag(self.SPEC).add(scale=4.0)
+        vals = monitor.read_bag(bag)
+        assert vals["scale"] == 4.0
+        # per-metric fold counts: metrics this add() omitted read None
+        # (no folds), not a diluted or fake number
+        assert vals["loss"] is None
+        assert vals["peak"] is None
+
+    def test_non_finite_values_excluded(self):
+        """A NaN-poisoned step must not null the whole interval: the
+        non-finite fold is dropped and the mean covers the finite steps
+        (the anomaly itself is the sentinel's/skip-counter's story)."""
+        bag = monitor.metric_bag(self.SPEC)
+        bag = bag.add(loss=1.0, scale=2.0, peak=1.0, skips=0.0)
+        bag = bag.add(loss=jnp.float32(jnp.nan), scale=jnp.float32(jnp.inf),
+                      peak=jnp.float32(jnp.nan), skips=1.0)
+        bag = bag.add(loss=3.0, scale=4.0, peak=2.0, skips=0.0)
+        vals = monitor.read_bag(bag)
+        assert vals["loss"] == 2.0      # mean of the two finite folds
+        assert vals["scale"] == 4.0     # inf did not overwrite the gauge
+        assert vals["peak"] == 2.0
+        assert vals["skips"] == 1.0
+        # all-non-finite still reads None, not 0
+        nan_only = monitor.metric_bag(self.SPEC).add(
+            loss=jnp.float32(jnp.nan)
+        )
+        assert monitor.read_bag(nan_only)["loss"] is None
+
+    def test_reset_and_reuse(self):
+        bag = monitor.reset_bag(self._filled())
+        assert int(bag.count) == 0
+        vals = monitor.read_bag(bag.add(loss=5.0))
+        assert vals["loss"] == 5.0  # no leakage from before the reset
+
+    def test_merge(self):
+        a = monitor.metric_bag(self.SPEC).add(loss=1.0, peak=1.0)
+        b = monitor.metric_bag(self.SPEC).add(loss=3.0, peak=9.0, scale=7.0)
+        vals = monitor.read_bag(a.merge(b))
+        # skips got zero folds in either bag -> None, same as unmerged
+        assert vals == {"loss": 2.0, "skips": None, "scale": 7.0, "peak": 9.0}
+
+    def test_merge_spec_mismatch_raises(self):
+        a = monitor.metric_bag({"x": "mean"})
+        b = monitor.metric_bag({"y": "mean"})
+        with pytest.raises(ValueError, match="specs"):
+            a.merge(b)
+
+    def test_one_fetch_per_interval_under_jit(self):
+        """The acceptance contract: a donated bag threads through a jitted
+        step for N steps with exactly N/interval host fetches."""
+
+        @jax.jit
+        def step(bag, x):
+            return bag.add(loss=x, skips=0.0, scale=1.0, peak=x)
+
+        bag = monitor.metric_bag(self.SPEC)
+        interval, steps, reads = 4, 12, []
+        before = monitor.host_fetch_count()
+        for i in range(steps):
+            bag = step(bag, jnp.float32(i))
+            if (i + 1) % interval == 0:
+                reads.append(monitor.read_bag(bag))
+                bag = monitor.reset_bag(bag)
+        assert monitor.host_fetch_count() - before == steps // interval
+        assert [r["loss"] for r in reads] == [1.5, 5.5, 9.5]
+
+    def test_fresh_bag_survives_donation(self):
+        """Regression: metric_bag/reset_bag must create DISTINCT buffers
+        per metric — a shared zero leaf donated under jit trips XLA's
+        'donate the same buffer twice' check (and wedged collectives in
+        the GPT example before the fix)."""
+        import functools
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+        replicated = jax.sharding.NamedSharding(mesh, P())
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(bag, x):
+            return bag.add(loss=x, skips=0.0, scale=1.0, peak=x)
+
+        bag = jax.device_put(monitor.metric_bag(self.SPEC), replicated)
+        bag = step(bag, jnp.float32(1.0))  # raised before the fix
+        bag = jax.device_put(monitor.reset_bag(bag), replicated)
+        bag = step(bag, jnp.float32(3.0))
+        assert monitor.read_bag(bag)["loss"] == 3.0
+
+    def test_bag_inside_shard_map(self):
+        """The example wiring: the bag crosses a compat.shard_map boundary
+        with replicated specs while the data is dp-sharded."""
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("dp",))
+
+        @jax.jit
+        @lambda f: shard_map(
+            f, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
+            check_vma=False,
+        )
+        def step(bag, xs):
+            loss = jax.lax.pmean(jnp.mean(xs), "dp")
+            return bag.add(loss=loss, skips=0.0, scale=1.0, peak=loss)
+
+        bag = monitor.metric_bag(self.SPEC)
+        xs = jnp.arange(16, dtype=jnp.float32)
+        bag = step(bag, xs)
+        assert monitor.read_bag(bag)["loss"] == pytest.approx(7.5)
+
+
+class TestGradNormTaps:
+    def test_global_grad_norm_matches_numpy(self):
+        grads = {"a": jnp.asarray([3.0, 4.0]), "b": {"c": jnp.full((2, 2), 1.0)}}
+        flat = np.concatenate([np.array([3.0, 4.0]), np.ones(4)])
+        assert float(monitor.global_grad_norm(grads)) == pytest.approx(
+            np.linalg.norm(flat)
+        )
+
+    def test_empty_tree_is_zero(self):
+        assert float(monitor.global_grad_norm({})) == 0.0
+
+    def test_per_layer_norms_key_per_top_level_entry(self):
+        grads = {
+            "params": {
+                "layer_0": {"w": jnp.asarray([3.0, 4.0])},
+                "layer_1": {"w": jnp.asarray([6.0, 8.0])},
+            }
+        }
+        norms = monitor.per_layer_grad_norms(grads)
+        assert set(norms) == {"grad_norm/layer_0", "grad_norm/layer_1"}
+        assert float(norms["grad_norm/layer_0"]) == pytest.approx(5.0)
+        assert float(norms["grad_norm/layer_1"]) == pytest.approx(10.0)
+
+
+class TestRouter:
+    def test_fan_out_one_schema(self, tmp_path, capsys):
+        jsonl = str(tmp_path / "m.jsonl")
+        csvp = str(tmp_path / "m.csv")
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter(
+            [monitor.JsonlSink(jsonl), monitor.CsvSink(csvp),
+             monitor.StdoutSink(), mem]
+        )
+        router.metrics(4, loss=1.2345678, grad_norm=0.5)
+        router.event("skip", 5, loss=99.0, lr_scale=1.0)
+        router.close()
+
+        lines = [json.loads(l) for l in open(jsonl)]
+        assert [l["kind"] for l in lines] == ["metrics", "skip"]
+        assert all({"t", "step", "kind"} <= set(l) for l in lines)
+        assert lines == mem.records
+        csv_rows = open(csvp).read().splitlines()
+        assert csv_rows[0].startswith("t,step,kind")
+        out = capsys.readouterr().out
+        assert "step     4 loss   1.2346" in out
+        assert "[skip] step 5" in out
+
+    def test_sink_failure_is_isolated(self, caplog):
+        class Bomb(monitor.Sink):
+            def emit(self, record):
+                raise OSError("disk full")
+
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([Bomb(), mem])
+        router.metrics(1, loss=1.0)  # must not raise
+        assert len(mem.records) == 1  # later sinks still served
+
+    def test_csv_header_is_frozen(self, tmp_path):
+        csvp = str(tmp_path / "m.csv")
+        router = monitor.MetricRouter([monitor.CsvSink(csvp)])
+        router.metrics(0, loss=1.0)
+        router.metrics(1, loss=2.0, surprise=3.0)  # new column: dropped row
+        router.metrics(2, loss=4.0)
+        router.close()
+        rows = open(csvp).read().splitlines()
+        assert len(rows) == 3  # header + steps 0 and 2
+        assert "surprise" not in rows[0]
+
+    def test_csv_filters_to_metrics_kind(self, tmp_path):
+        csvp = str(tmp_path / "m.csv")
+        router = monitor.MetricRouter([monitor.CsvSink(csvp)])
+        router.event("timer", 0, name="step-time", seconds=0.1)
+        router.metrics(0, loss=1.0)
+        router.event("skip", 1, loss=9.0)  # anomaly kinds jsonl-only
+        router.metrics(2, loss=2.0)
+        router.close()
+        rows = open(csvp).read().splitlines()
+        # header froze on the first METRICS record, not the timer event
+        assert rows[0] == "t,step,kind,loss" and len(rows) == 3
+
+    def test_csv_resume_keeps_single_header(self, tmp_path):
+        csvp = str(tmp_path / "m.csv")
+        first = monitor.CsvSink(csvp)
+        first.emit(monitor.make_record("metrics", 0, loss=1.0))
+        first.close()
+        second = monitor.CsvSink(csvp)  # process restart, same path
+        second.emit(monitor.make_record("metrics", 1, loss=2.0))
+        second.close()
+        rows = open(csvp).read().splitlines()
+        assert len(rows) == 3  # ONE header + two data rows
+        assert sum(r.startswith("t,step,kind") for r in rows) == 1
+
+    def test_timers_plug_into_router(self):
+        from apex_tpu.utils import Timers
+
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        timers = Timers(write_fn=router.timer_write_fn)
+        timers("fwd").start()
+        timers("fwd").stop()
+        timers.write(["fwd"], iteration=3)
+        (rec,) = mem.records
+        assert rec["kind"] == "timer" and rec["step"] == 3
+        assert rec["name"] == "fwd-time" and rec["seconds"] >= 0.0
+
+    def test_tensorboard_sink_gated_not_raising(self, tmp_path):
+        # whichever way the import probe goes on this box, the gate must
+        # return (sink or None) rather than raise
+        sink = monitor.try_tensorboard_sink(str(tmp_path / "tb"))
+        if sink is not None:
+            sink.emit(monitor.make_record("metrics", 1, loss=2.0))
+            sink.close()
+
+
+class TestTimersWriteParity:
+    """The reference-parity fix: ``Timers.write`` resets by default, so
+    successive writes report per-interval times, not a growing total."""
+
+    def _timer_with(self, timers, name, seconds):
+        t = timers(name)
+        t.start()
+        t.elapsed_ += seconds  # deterministic elapsed; stop() adds ~0
+        t.stop()
+
+    def test_write_resets_by_default(self):
+        from apex_tpu.utils import Timers
+
+        seen = []
+        timers = Timers(write_fn=lambda n, v, it: seen.append(v))
+        self._timer_with(timers, "x", 1.0)
+        timers.write(["x"], iteration=0)
+        self._timer_with(timers, "x", 1.0)
+        timers.write(["x"], iteration=1)
+        assert seen[0] == pytest.approx(1.0, abs=0.05)
+        # the old hard-coded reset=False accumulated: ~2.0 here
+        assert seen[1] == pytest.approx(1.0, abs=0.05)
+
+    def test_write_reset_false_accumulates(self):
+        from apex_tpu.utils import Timers
+
+        seen = []
+        timers = Timers(write_fn=lambda n, v, it: seen.append(v))
+        self._timer_with(timers, "x", 1.0)
+        timers.write(["x"], iteration=0, reset=False)
+        self._timer_with(timers, "x", 1.0)
+        timers.write(["x"], iteration=1, reset=False)
+        assert seen[1] == pytest.approx(2.0, abs=0.1)
+
+    def test_write_normalizer(self):
+        from apex_tpu.utils import Timers
+
+        seen = []
+        timers = Timers(write_fn=lambda n, v, it: seen.append(v))
+        self._timer_with(timers, "x", 1.0)
+        timers.write(["x"], iteration=0, normalizer=4.0)
+        assert seen[0] == pytest.approx(0.25, abs=0.05)
+
+
+def _tiny_cfg(**kw):
+    from apex_tpu.transformer import TransformerConfig
+
+    base = dict(
+        num_layers=1, hidden_size=4, num_attention_heads=2, vocab_size=8,
+        max_position_embeddings=6, ffn_hidden_size=8,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+class TestFlops:
+    """MFU math against FULLY hand-counted tiny configs (2*m*n*k per
+    matmul, per token): any change to the counters must re-derive these
+    numbers, not nudge them until green."""
+
+    def test_layer_flops_hand_counted(self):
+        cfg = _tiny_cfg()
+        # h=4, heads=2, head_dim=2, q=kv=4, ffn=8, s=6:
+        #   qkv   2*4*(4+2*4) = 96
+        #   attn  2*6*4 + 2*6*4 = 96   (scores + context)
+        #   out   2*4*4 = 32
+        #   mlp   2*(2*4*8) = 128
+        assert monitor.transformer_layer_flops_per_token(cfg, 6) == 352.0
+
+    def test_gqa_shrinks_kv_projection(self):
+        cfg = _tiny_cfg(num_query_groups=1)
+        # kv = 1 group * head_dim 2 = 2: qkv = 2*4*(4+2*2) = 64 (was 96)
+        assert monitor.transformer_layer_flops_per_token(cfg, 6) == 320.0
+
+    def test_gated_mlp_costs_third_matmul(self):
+        cfg = _tiny_cfg(activation="swiglu", add_bias_linear=False)
+        # mlp 2 mats -> 3 mats: 128 -> 192
+        assert monitor.transformer_layer_flops_per_token(cfg, 6) == 416.0
+
+    def test_gpt_adds_logit_head(self):
+        cfg = _tiny_cfg()
+        # layers + 2*h*vocab = 352 + 2*4*8 = 416
+        assert monitor.gpt_flops_per_token(cfg, 6) == 416.0
+        # seq_len defaults to max_position_embeddings
+        assert monitor.gpt_flops_per_token(cfg) == 416.0
+
+    def test_bert_adds_lm_head(self):
+        cfg = _tiny_cfg()
+        # layers + dense h*h + vocab proj = 352 + 32 + 64 = 448
+        assert monitor.bert_flops_per_token(cfg, 6) == 448.0
+
+    def test_training_is_3x_forward(self):
+        assert monitor.training_flops_per_step(416.0, 10) == 3 * 4160.0
+
+    def test_tokens_per_second(self):
+        assert monitor.tokens_per_second(100, 2.0) == 50.0
+        with pytest.raises(ValueError):
+            monitor.tokens_per_second(100, 0.0)
+
+    def test_mfu_math_and_unknown_peak(self, monkeypatch):
+        monkeypatch.delenv("APEX_TPU_PEAK_FLOPS", raising=False)
+        assert monitor.mfu(1e12, 1.0, 1, peak_flops=2e12) == pytest.approx(0.5)
+        assert monitor.mfu(1e12, 0.5, 4, peak_flops=1e12) == pytest.approx(0.5)
+        # CPU devices have no peak entry: None, never a made-up number
+        assert monitor.mfu(1e12, 1.0, 1) is None
+
+    def test_peak_env_override(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_PEAK_FLOPS", "123e9")
+        assert monitor.peak_flops_per_device() == pytest.approx(123e9)
+        assert monitor.mfu(123e9, 1.0, 1) == pytest.approx(1.0)
+
+
+class TestStallWatchdog:
+    def test_fires_once_and_rearms_on_beat(self):
+        fired = []
+        dog = monitor.StallWatchdog(
+            0.1, on_stall=fired.append, poll_s=0.02
+        ).start()
+        try:
+            dog.beat(7)
+            time.sleep(0.35)
+            assert len(fired) == 1  # one stall, not one per poll
+            assert fired[0]["step"] == 7
+            assert fired[0]["overdue_s"] > 0.1
+            dog.beat(8)  # recovery re-arms
+            time.sleep(0.35)
+            assert len(fired) == 2 and fired[1]["step"] == 8
+        finally:
+            dog.stop()
+
+    def test_no_fire_while_beating(self):
+        dog = monitor.StallWatchdog(0.3, poll_s=0.02)
+        with dog:
+            for i in range(8):
+                dog.beat(i)
+                time.sleep(0.05)
+        assert dog.stalls == []
+
+    def test_handler_exception_does_not_kill_dog(self):
+        def boom(info):
+            raise RuntimeError("handler bug")
+
+        dog = monitor.StallWatchdog(0.05, on_stall=boom, poll_s=0.02).start()
+        try:
+            time.sleep(0.15)
+            dog.beat(1)
+            time.sleep(0.15)
+            assert len(dog.stalls) == 2  # survived the first handler crash
+        finally:
+            dog.stop()
+
+    def test_bad_deadline_rejected(self):
+        with pytest.raises(ValueError):
+            monitor.StallWatchdog(0.0)
+
+    def test_restart_after_stop(self):
+        """Regression: stop() left the _stop event set, so a restarted
+        watchdog's thread exited immediately and stalls went unflagged."""
+        dog = monitor.StallWatchdog(0.08, poll_s=0.02)
+        dog.start()
+        dog.stop()
+        dog.start()  # e.g. pause around a known-slow restore, then resume
+        try:
+            time.sleep(0.3)
+            assert dog.stalls  # the restarted dog is actually alive
+        finally:
+            dog.stop()
+
+
+class TestProfilerTrigger:
+    def _drive(self, trigger, steps, verdicts=None):
+        @jax.jit
+        def work(x):
+            return (x @ x).sum()
+
+        for i in range(steps):
+            trigger.maybe_start(i)
+            out = work(jnp.ones((8, 8)))
+            jax.block_until_ready(out)
+            if verdicts and i in verdicts:
+                trigger.on_verdict(i, verdicts[i])
+            trigger.maybe_stop(i)
+        trigger.close()
+
+    def test_requested_step_writes_capture_dir(self, tmp_path):
+        trigger = monitor.ProfilerTrigger(str(tmp_path), window_steps=2)
+        trigger.request(step=2, reason="requested")
+        self._drive(trigger, 6)
+        (cap,) = trigger.captures
+        assert cap["start_step"] == 2 and cap["end_step"] == 3
+        assert os.path.isdir(cap["path"])
+        # a real capture lands files under the dir (plugins/profile/...)
+        assert any(files for _, _, files in os.walk(cap["path"]))
+
+    def test_verdict_escalation_triggers_capture(self, tmp_path):
+        from apex_tpu.resilience.sentinel import VERDICT_ROLLBACK, VERDICT_SKIP
+
+        trigger = monitor.ProfilerTrigger(str(tmp_path), window_steps=1)
+        self._drive(trigger, 6, verdicts={1: VERDICT_SKIP, 3: VERDICT_ROLLBACK})
+        (cap,) = trigger.captures  # SKIP must not trigger; ROLLBACK must
+        assert cap["start_step"] == 4 and "verdict" in cap["reason"]
+
+    def test_one_capture_at_a_time(self, tmp_path):
+        trigger = monitor.ProfilerTrigger(str(tmp_path), window_steps=4)
+        trigger.request(step=0)
+        trigger.request(step=1)  # ignored: a request is already pending
+        self._drive(trigger, 6)
+        assert len(trigger.captures) == 1
+
+    def test_anomaly_outranks_scheduled_request(self, tmp_path):
+        """Regression: a far-future --profile-step request must not block
+        the on-anomaly capture — the blowup happening NOW wins."""
+        from apex_tpu.resilience.sentinel import VERDICT_ROLLBACK
+
+        trigger = monitor.ProfilerTrigger(str(tmp_path), window_steps=1)
+        trigger.request(step=1000, reason="requested")
+        self._drive(trigger, 5, verdicts={2: VERDICT_ROLLBACK})
+        (cap,) = trigger.captures
+        assert cap["start_step"] == 3 and "verdict" in cap["reason"]
+
+
+class TestResilienceRouting:
+    def test_anomaly_stream_shares_schema_and_old_path(self, tmp_path):
+        from apex_tpu import resilience
+
+        log = str(tmp_path / "anomalies.jsonl")
+        mem = monitor.MemorySink()
+        mgr = resilience.ResilienceManager(
+            log_path=log, router=monitor.MetricRouter([mem])
+        )
+        mgr.resolve(3, resilience.VERDICT_SKIP, loss=9.9)
+        mgr.resolve(4, resilience.VERDICT_HALT, loss=11.0)
+
+        # the legacy jsonl path still works, byte-for-byte schema
+        lines = [json.loads(l) for l in open(log)]
+        assert lines == mem.records == mgr.events
+        assert [l["kind"] for l in lines] == ["skip", "halt"]
+        assert all({"t", "step", "kind"} <= set(l) for l in lines)
+
+
+class TestAmpOptimizerMetrics:
+    def test_collect_metrics_exposes_grad_norm(self):
+        import optax
+
+        from apex_tpu import amp
+
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        params, amp_opt, _ = amp.initialize(
+            params, optax.sgd(0.1), opt_level="O2"
+        )
+        state = amp_opt.init(params)
+        scale = float(state.scaler.scale)
+        grads = {"w": jnp.full((4,), 3.0 * scale, jnp.float16)}
+        _, _, info = amp_opt.step(
+            grads, state, params, collect_metrics=True
+        )
+        # norm of the UNSCALED fp32 grads: ||(3,3,3,3)|| = 6
+        assert float(info["grad_norm"]) == pytest.approx(6.0, rel=1e-3)
+
+    def test_metrics_off_by_default(self):
+        import optax
+
+        from apex_tpu import amp
+
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        params, amp_opt, _ = amp.initialize(
+            params, optax.sgd(0.1), opt_level="O2"
+        )
+        state = amp_opt.init(params)
+        _, _, info = amp_opt.step(
+            {"w": jnp.ones((4,), jnp.float16)}, state, params
+        )
+        assert "grad_norm" not in info
+
+
+class TestLayerMetricsTap:
+    def test_layer_out_rms_sown_and_readable(self, rng):
+        from apex_tpu.transformer.layer import ParallelTransformer
+
+        cfg = _tiny_cfg(num_layers=2, collect_layer_metrics=True)
+        model = ParallelTransformer(config=cfg)
+        x = jnp.ones((6, 2, 4), cfg.compute_dtype)  # (s, b, h)
+        params = model.init(rng, x)
+        y, col = model.apply(params, x, mutable=["intermediates"])
+        taps = monitor.taps_from_intermediates(col["intermediates"])
+        assert "layer_out_rms" in taps
+        assert np.isfinite(float(taps["layer_out_rms"]))
+        assert float(taps["layer_out_rms"]) > 0.0
+
+    def test_tap_off_by_default(self, rng):
+        from apex_tpu.transformer.layer import ParallelTransformer
+
+        cfg = _tiny_cfg(num_layers=1)
+        model = ParallelTransformer(config=cfg)
+        x = jnp.ones((6, 2, 4), cfg.compute_dtype)
+        params = model.init(rng, x)
+        _, col = model.apply(params, x, mutable=["intermediates"])
+        assert monitor.taps_from_intermediates(col.get("intermediates", {})) == {}
+
+
+SOW_RE = re.compile(
+    r"""\.sow\(\s*['"]intermediates['"]\s*,\s*['"](?P<name>\w+)['"]"""
+)
+
+
+class TestRegisteredTapsLint:
+    """Tier-1 drift guard: every ``sow("intermediates", <name>, ...)`` in
+    apex_tpu/ must be registered in monitor/taps.py, and every registry
+    row must still have a live sow site (no stale registry either)."""
+
+    def _sown_names(self):
+        names = {}
+        for dirpath, _, files in os.walk(APEX_ROOT):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    for m in SOW_RE.finditer(f.read()):
+                        names.setdefault(m.group("name"), []).append(path)
+        return names
+
+    def test_every_sown_tap_is_registered(self):
+        sown = self._sown_names()
+        assert sown, "no sow taps found — the regex or layout changed"
+        unregistered = set(sown) - set(monitor.REGISTERED_TAPS)
+        assert not unregistered, (
+            f"sow taps {sorted(unregistered)} missing from "
+            f"monitor/taps.py REGISTERED_TAPS (sown at "
+            f"{ {n: sown[n] for n in unregistered} })"
+        )
+
+    def test_every_registered_tap_is_still_sown(self):
+        stale = set(monitor.REGISTERED_TAPS) - set(self._sown_names())
+        assert not stale, (
+            f"REGISTERED_TAPS entries {sorted(stale)} have no sow site "
+            f"left in apex_tpu/ — remove them or restore the tap"
+        )
