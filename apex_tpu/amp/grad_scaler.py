@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.monitor.xray import ledger as xlax
 
 
 def _axis_in_scope(name: str) -> bool:
@@ -41,7 +42,7 @@ class GradScaler(LossScaler):
         f = jnp.asarray(found_inf, jnp.float32)
         for ax in self.model_parallel_axes:
             if _axis_in_scope(ax):
-                f = jax.lax.psum(f, ax)
+                f = xlax.psum(f, ax)
         return f > 0
 
     def unscale(self, state: LossScalerState, grads) -> Tuple[jax.Array, jax.Array]:

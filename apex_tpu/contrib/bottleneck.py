@@ -36,6 +36,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
 
 
@@ -45,12 +46,12 @@ def halo_exchange_1d(x, axis_name: str, halo: int = 1, dim: int = 1):
     (ref: PeerHaloExchanger1d.__call__ / nccl_p2p left_right_halo_exchange.)
     x: (N, H_local, W, C) when dim=1. Edge shards get zero halos.
     """
-    n = jax.lax.psum(1, axis_name)
+    n = xlax.axis_size(axis_name)
     lo = jax.lax.slice_in_dim(x, 0, halo, axis=dim)
     hi = jax.lax.slice_in_dim(x, x.shape[dim] - halo, x.shape[dim], axis=dim)
     # my bottom rows become the NEXT rank's top halo, and vice versa
-    from_prev = jax.lax.ppermute(hi, axis_name, [(i, i + 1) for i in range(n - 1)])
-    from_next = jax.lax.ppermute(lo, axis_name, [(i + 1, i) for i in range(n - 1)])
+    from_prev = xlax.ppermute(hi, axis_name, [(i, i + 1) for i in range(n - 1)])
+    from_next = xlax.ppermute(lo, axis_name, [(i + 1, i) for i in range(n - 1)])
     return jnp.concatenate([from_prev, x, from_next], axis=dim)
 
 

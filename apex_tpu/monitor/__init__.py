@@ -25,6 +25,12 @@ profiling subsystem (PAPERS.md). Four cooperating pieces:
 - ``taps``     — the registered-taps table every ``sow`` name used in
   ``apex_tpu/`` must appear in (lint-tested, so a layer refactor cannot
   silently drop a metric).
+- ``xray``     — execution introspection of the compiled step itself:
+  the collective-traffic ledger (instrumented ``lax`` collective
+  wrappers + per-axis byte totals + ICI roofline), XLA memory reports
+  (args/outputs/temps vs device headroom), and the recompile sentinel
+  (:class:`~apex_tpu.monitor.xray.CompileWatcher`) — all emitting
+  ``kind="comms"/"memory"/"compile"`` records through the router.
 
 See docs/observability.md for the end-to-end wiring.
 """
@@ -60,6 +66,7 @@ from apex_tpu.monitor.flops import (
 )
 from apex_tpu.monitor.watchdog import ProfilerTrigger, StallWatchdog
 from apex_tpu.monitor.taps import REGISTERED_TAPS
+from apex_tpu.monitor import xray
 
 __all__ = [
     "MetricBag",
@@ -88,4 +95,5 @@ __all__ = [
     "StallWatchdog",
     "ProfilerTrigger",
     "REGISTERED_TAPS",
+    "xray",
 ]

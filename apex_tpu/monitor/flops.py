@@ -89,11 +89,21 @@ def transformer_layer_flops_per_token(cfg, seq_len: int) -> float:
       halves the REACHABLE area, but the dense kernels here compute the
       full s x s product, and MFU counts the math the model runs);
     - output projection: ``2*q*h``;
-    - MLP: ``2*h*ffn + 2*ffn*h``, plus ``2*h*ffn`` more for the extra
-      gate matmul of geglu/swiglu.
+    - dense MLP: ``2*h*ffn + 2*ffn*h``, plus ``2*h*ffn`` more for the
+      extra gate matmul of geglu/swiglu;
+    - MoE MLP (``cfg.num_moe_experts`` set — the MLP block is MoEMLP):
+      router ``2*h*E`` plus ``moe_top_k`` expert-FFN passes of
+      ``2*h*ffn + 2*ffn*h`` each (MoEMLP experts are ungated two-matmul
+      FFNs). Each token mathematically runs top_k experts, so a top-2
+      MoE spends ~2x the dense MLP FLOPs — the dense formula both
+      under-counts top-2 and ignores the router, which is exactly how
+      MoE MFU went wrong before. Capacity-dropped tokens still count
+      (the convention counts the model's assignment math; drops are a
+      lossy implementation detail, and counting them would make MFU
+      improve when the router overflows).
 
-    Element-wise work (norms, softmax, residuals) is O(h) per token and
-    omitted, per the standard model-FLOPs convention.
+    Element-wise work (norms, softmax, residuals, gating combines) is
+    O(h) per token and omitted, per the standard model-FLOPs convention.
     """
     h, heads, kv_heads, head_dim, ffn = _cfg_dims(cfg)
     q = heads * head_dim
@@ -101,8 +111,14 @@ def transformer_layer_flops_per_token(cfg, seq_len: int) -> float:
     qkv_proj = 2 * h * (q + 2 * kv)
     attn = 2 * seq_len * q + 2 * seq_len * q
     out_proj = 2 * q * h
-    n_mats = 3 if cfg.activation in ("geglu", "swiglu") else 2
-    mlp = n_mats * 2 * h * ffn
+    num_experts = getattr(cfg, "num_moe_experts", None)
+    if num_experts:
+        top_k = getattr(cfg, "moe_top_k", 1) or 1
+        router = 2 * h * num_experts
+        mlp = router + top_k * (2 * h * ffn + 2 * ffn * h)
+    else:
+        n_mats = 3 if cfg.activation in ("geglu", "swiglu") else 2
+        mlp = n_mats * 2 * h * ffn
     return float(qkv_proj + attn + out_proj + mlp)
 
 

@@ -18,6 +18,7 @@ and failure isolation (one broken sink must not take down training — a
 metrics pipeline that can kill the run is worse than no metrics).
 """
 
+import collections
 import csv
 import json
 import logging
@@ -25,7 +26,7 @@ import os
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence
 
 logger = logging.getLogger("apex_tpu.monitor")
 
@@ -46,10 +47,24 @@ class Sink:
 
 
 class MemorySink(Sink):
-    """Records kept in a list — tests and programmatic consumers."""
+    """Records kept in memory — tests and programmatic consumers.
 
-    def __init__(self):
-        self.records: List[dict] = []
+    ``records`` is a bounded deque: a week-long run emitting every few
+    seconds must not grow host memory without limit, so the oldest
+    records evict once ``max_records`` is reached (the file sinks are
+    the durable record; this one is a window). ``max_records=None``
+    removes the cap — opt into the leak explicitly.
+    """
+
+    DEFAULT_MAX_RECORDS = 100_000
+
+    def __init__(self, max_records: Optional[int] = DEFAULT_MAX_RECORDS):
+        if max_records is not None and max_records < 1:
+            raise ValueError(
+                f"max_records must be >= 1 or None, got {max_records}"
+            )
+        self.max_records = max_records
+        self.records: Deque[dict] = collections.deque(maxlen=max_records)
 
     def emit(self, record: dict) -> None:
         self.records.append(record)

@@ -1,0 +1,59 @@
+"""X-ray: static + runtime execution introspection for compiled steps.
+
+PR 2's telemetry (monitor/) sees what the step RETURNS — loss, MFU,
+timings. X-ray sees what the step IS: three probes over the compiled
+program itself, all emitting through the same MetricRouter record schema
+(docs/observability.md):
+
+- ``ledger``        — the collective-traffic ledger: instrumented
+  ``lax`` collective wrappers every apex_tpu call site routes through
+  (lint-enforced), recording op/axis/dtype/bytes from avals at trace
+  time under :func:`comms_ledger`, with per-axis totals and an ICI
+  roofline estimate (``kind="comms"`` records). TorchTitan treats
+  per-dimension comms accounting as a production feature; EQuARX shows
+  XLA collective cost is the dominant scaling lever — this measures ours
+  before anyone optimizes it.
+- ``memory``        — :func:`memory_report`: XLA's own HBM breakdown
+  (args / outputs / temps / generated code) of a jitted step vs device
+  capacity (``kind="memory"`` records) — the OOM that kills the run, on
+  the startup banner instead.
+- ``compile_watch`` — :class:`CompileWatcher`: compiles and
+  compile-seconds per step (``kind="compile"`` records), warning loudly
+  on a post-warmup recompile — the classic silent 10x throughput killer.
+"""
+
+from apex_tpu.monitor.xray import ledger
+from apex_tpu.monitor.xray.ledger import (
+    CollectiveEntry,
+    CommsLedger,
+    axis_size,
+    comms_ledger,
+    ici_bandwidth_per_device,
+    muted,
+    predict_comms,
+    record,
+    scaled,
+)
+from apex_tpu.monitor.xray.memory import (
+    MemoryReport,
+    device_memory_limit,
+    memory_report,
+)
+from apex_tpu.monitor.xray.compile_watch import CompileWatcher
+
+__all__ = [
+    "ledger",
+    "CollectiveEntry",
+    "CommsLedger",
+    "comms_ledger",
+    "predict_comms",
+    "scaled",
+    "muted",
+    "axis_size",
+    "record",
+    "ici_bandwidth_per_device",
+    "MemoryReport",
+    "memory_report",
+    "device_memory_limit",
+    "CompileWatcher",
+]

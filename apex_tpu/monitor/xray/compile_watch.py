@@ -1,0 +1,151 @@
+"""Recompile sentinel: count compiles and compile-seconds per step.
+
+The classic silent throughput killer: a shape-polymorphic input (a batch
+remainder, a growing cache, an int that should have been static) makes
+jit retrace+recompile EVERY step, and the run "works" at 10x the step
+time with nothing in the loss curve to show why. XLA tells nobody —
+except ``jax.monitoring``, whose ``backend_compile_duration`` event fires
+on every backend compile in the process.
+
+:class:`CompileWatcher` snapshots a process-global listener-backed
+counter once per step: any compile burst lands in a ``kind="compile"``
+record (compiles, compile-seconds, running totals), and a burst AFTER
+the first completed step — by then every shape should be warm — is
+flagged ``recompile=True`` and logged loudly, once per offending step.
+"""
+
+import collections
+import logging
+import threading
+from typing import Deque, Optional
+
+logger = logging.getLogger("apex_tpu.monitor")
+
+_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class _CompileCounter:
+    """Process-global compile count/seconds fed by a jax.monitoring
+    listener. Registered lazily and exactly once — jax.monitoring offers
+    no per-listener unregistration, so watchers snapshot deltas off this
+    singleton instead of owning listeners."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.count = 0
+        self.seconds = 0.0
+        self.available = False
+
+    def _on_event(self, event: str, duration: float, **_kw) -> None:
+        if event != _EVENT:
+            return
+        with self.lock:
+            self.count += 1
+            self.seconds += float(duration)
+
+    def snapshot(self):
+        with self.lock:
+            return self.count, self.seconds
+
+
+_COUNTER: Optional[_CompileCounter] = None
+_COUNTER_LOCK = threading.Lock()
+
+
+def _global_counter() -> _CompileCounter:
+    global _COUNTER
+    with _COUNTER_LOCK:
+        if _COUNTER is None:
+            c = _CompileCounter()
+            try:
+                import jax.monitoring
+
+                jax.monitoring.register_event_duration_secs_listener(
+                    c._on_event
+                )
+                c.available = True
+            except Exception as e:  # pragma: no cover - jax API drift
+                logger.warning(
+                    "jax.monitoring unavailable (%s); CompileWatcher will "
+                    "report zero compiles", e,
+                )
+            _COUNTER = c
+    return _COUNTER
+
+
+class CompileWatcher:
+    """Per-step compile accounting over the process-global counter.
+
+    Drive it from the step loop::
+
+        watcher = CompileWatcher(router=router)
+        while ...:
+            ... run step ...
+            watcher.on_step(step)   # AFTER the step completes
+
+    Each ``on_step`` with new compiles since the last one emits ONE
+    ``kind="compile"`` record (a step's burst of sub-compiles — jit
+    helpers, donation variants — aggregates; the interesting unit is
+    "this step compiled", not XLA's internal count). The first completed
+    step is warmup: its record carries ``recompile=False``. Any burst
+    after it is the sentinel firing — ``recompile=True`` plus a loud
+    log line naming the step (once per offending step: ``on_step`` runs
+    once per step, so burst == offender).
+
+    Note the counter is process-wide: ANY post-warmup compile is flagged,
+    including host-side helper jits someone added to the loop. That is
+    deliberate — whoever owns the compile, it is stealing step time.
+    """
+
+    #: records kept on the instance (a WINDOW, like MemorySink — the
+    #: pathological every-step-recompiles run this class exists to catch
+    #: must not also leak host memory; router sinks hold the full stream)
+    MAX_RECORDS = 10_000
+
+    def __init__(self, router=None, warn: bool = True):
+        self._counter = _global_counter()
+        self._last = self._counter.snapshot()
+        self._baseline = self._last
+        self.router = router
+        self.warn = warn
+        self.steps_completed = 0
+        self.records: Deque[dict] = collections.deque(maxlen=self.MAX_RECORDS)
+
+    @property
+    def available(self) -> bool:
+        return self._counter.available
+
+    def on_step(self, step: int) -> Optional[dict]:
+        """Account compiles since the previous call; returns the emitted
+        record (also kept in ``records``) or None when nothing compiled."""
+        now = self._counter.snapshot()
+        d_count = now[0] - self._last[0]
+        d_seconds = now[1] - self._last[1]
+        self._last = now
+        record = None
+        if d_count > 0:
+            recompile = self.steps_completed >= 1
+            fields = {
+                "compiles": d_count,
+                "compile_seconds": d_seconds,
+                "total_compiles": now[0] - self._baseline[0],
+                "total_compile_seconds": now[1] - self._baseline[1],
+                "recompile": recompile,
+            }
+            if recompile and self.warn:
+                logger.warning(
+                    "RECOMPILE at step %d: %d compile(s), %.2fs — a "
+                    "post-warmup recompile usually means a shape or "
+                    "static-arg changed and EVERY such step pays it; see "
+                    "docs/observability.md (X-ray)",
+                    step, d_count, d_seconds,
+                )
+            if self.router is not None:
+                record = self.router.event("compile", step, **fields)
+            else:
+                from apex_tpu.monitor.router import make_record
+
+                record = make_record("compile", step, **fields)
+            self.records.append(record)
+        self.steps_completed += 1
+        return record

@@ -1,0 +1,430 @@
+"""Collective-traffic ledger: instrumented `lax` collectives + byte accounting.
+
+Every collective apex_tpu itself issues (TP mappings, pipeline p2p edges,
+ring/Ulysses attention, MoE dispatch, ZeRO optimizers, DDP, grad-scaler
+sync, ...) is routed through the thin wrappers here instead of raw
+``jax.lax.*`` — a tier-1 lint (tests/test_monitor.py) enforces that no
+call site bypasses them. The wrappers are free when no ledger is active:
+one thread-local check at TRACE time (zero compiled-code difference —
+they emit the exact same primitive).
+
+Under an active :func:`comms_ledger` context each wrapper records, per
+array leaf, at trace time: op kind, mesh axis, axis size, shape, dtype,
+payload bytes from the operand's aval, and an ICI-bytes estimate from the
+standard ring-algorithm cost (see ``_ici_bytes``). Byte conventions —
+chosen so tests can hand-compute totals digit for digit:
+
+- ``bytes``     — the operand payload: ``prod(shape) * itemsize`` of the
+  PER-DEVICE input aval (for all_gather that is the local shard; for
+  psum_scatter the full pre-scatter array).
+- ``ici_bytes`` — per-chip wire traffic of the bandwidth-optimal ring
+  algorithm: psum/pmean/pmax/pmin ``2(n-1)/n * bytes`` (reduce-scatter +
+  all-gather phases), all_gather ``(n-1) * bytes``, psum_scatter and
+  all_to_all ``(n-1)/n * bytes``, ppermute ``bytes`` (the busiest chip
+  ships its payload once; an empty perm ships nothing).
+- ``count``     — how many times the traced occurrence executes per step:
+  1, multiplied by every enclosing :func:`scaled` region (pipeline tick
+  scans, vmapped microbatch loops). Totals weigh by it.
+
+WHAT IS AND IS NOT CAPTURED (the honest contract): recording happens when
+the wrapper's *Python* runs, i.e. while jax traces. Tracing ``jax.grad``
+of a step under the ledger therefore captures forward collectives AND
+every ``custom_vjp`` backward rule (all of parallel/mappings.py, so TP
+fwd/bwd pairs are complete), but NOT collectives that jax's transpose
+rules synthesize from non-custom_vjp code — chiefly the reversed
+``ppermute`` edges of differentiating a pipeline scan, which mirror the
+forward edges one-for-one (double the pp numbers by hand for fwd+bwd).
+A jit-CACHED call traces nothing: trace under the ledger via
+:func:`predict_comms` (eval_shape — no compute, no devices needed) or
+call the un-cached function once inside the context.
+
+Static axis-size queries (``psum(1, axis)``) move no bytes — XLA folds
+them to a constant — and are NOT recorded; call sites use
+:func:`axis_size` for those.
+"""
+
+import contextlib
+import dataclasses
+import math
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+__all__ = [
+    "CollectiveEntry",
+    "CommsLedger",
+    "comms_ledger",
+    "predict_comms",
+    "scaled",
+    "muted",
+    "axis_size",
+    "record",
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "psum_scatter",
+    "all_to_all",
+    "ppermute",
+    "ici_bandwidth_per_device",
+]
+
+#: Aggregate inter-chip-interconnect bandwidth per chip (bytes/s, all
+#: links), by device-kind substring — published Google Cloud TPU system
+#: architecture figures (v3 656 Gbps, v4 2400, v5e 1600, v5p 4800,
+#: v6e/Trillium 3584), divided by 8 to bytes. CPU/unknown kinds return
+#: None: a roofline against a made-up link speed is worse than none
+#: (same contract as monitor.flops.peak_flops_per_device).
+_ICI_BW = (
+    ("v6 lite", 448e9),  # libtpu reports v6e as "TPU v6 lite"
+    ("v6e", 448e9),
+    ("v5p", 600e9),
+    ("v5 lite", 200e9),  # ... and v5e as "TPU v5 lite"
+    ("v5e", 200e9),
+    ("v4", 300e9),
+    ("v3", 82e9),
+)
+
+
+def ici_bandwidth_per_device(device=None) -> Optional[float]:
+    """Per-chip ICI bandwidth in bytes/s, or None when unknown.
+
+    ``APEX_TPU_ICI_BANDWIDTH`` (bytes/s) overrides — benchmarks pinning a
+    number, tests, and fabrics missing from the table (the
+    ``APEX_TPU_PEAK_FLOPS`` pattern).
+    """
+    env = os.environ.get("APEX_TPU_ICI_BANDWIDTH")
+    if env:
+        return float(env)
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return None
+        device = devices[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, bw in _ICI_BW:
+        if sub in kind:
+            return bw
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveEntry:
+    """One traced collective occurrence (see module docstring for the
+    byte conventions)."""
+
+    op: str
+    axis: str
+    axis_size: int
+    shape: Tuple[int, ...]
+    dtype: str
+    bytes: int
+    ici_bytes: int
+    count: int = 1
+
+    @property
+    def total_bytes(self) -> int:
+        return self.bytes * self.count
+
+    @property
+    def total_ici_bytes(self) -> int:
+        return self.ici_bytes * self.count
+
+
+class CommsLedger:
+    """Collectives recorded under one :func:`comms_ledger` context."""
+
+    def __init__(self):
+        self.entries: List[CollectiveEntry] = []
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def filter(self, op: Optional[str] = None, axis: Optional[str] = None):
+        """Entries matching ``op`` and/or ``axis`` (None = any)."""
+        return [
+            e for e in self.entries
+            if (op is None or e.op == op) and (axis is None or e.axis == axis)
+        ]
+
+    def total_bytes(self, op=None, axis=None) -> int:
+        return sum(e.total_bytes for e in self.filter(op, axis))
+
+    def total_ici_bytes(self, op=None, axis=None) -> int:
+        return sum(e.total_ici_bytes for e in self.filter(op, axis))
+
+    def per_axis(self) -> Dict[str, Dict[str, int]]:
+        """``{axis: {bytes, ici_bytes, calls, axis_size}}`` aggregates."""
+        out: Dict[str, Dict[str, int]] = {}
+        for e in self.entries:
+            d = out.setdefault(
+                e.axis,
+                {"bytes": 0, "ici_bytes": 0, "calls": 0,
+                 "axis_size": e.axis_size},
+            )
+            d["bytes"] += e.total_bytes
+            d["ici_bytes"] += e.total_ici_bytes
+            d["calls"] += e.count
+        return out
+
+    def per_op(self, axis: Optional[str] = None) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for e in self.filter(axis=axis):
+            d = out.setdefault(e.op, {"bytes": 0, "ici_bytes": 0, "calls": 0})
+            d["bytes"] += e.total_bytes
+            d["ici_bytes"] += e.total_ici_bytes
+            d["calls"] += e.count
+        return out
+
+    def roofline_seconds(
+        self, bandwidth: Optional[float] = None
+    ) -> Dict[str, Optional[float]]:
+        """Per-axis lower-bound seconds: ici_bytes / per-chip bandwidth.
+
+        None per axis when the bandwidth is unknown (no table match, no
+        ``APEX_TPU_ICI_BANDWIDTH``) — never a fake number.
+        """
+        if bandwidth is None:
+            bandwidth = ici_bandwidth_per_device()
+        return {
+            axis: (d["ici_bytes"] / bandwidth if bandwidth else None)
+            for axis, d in self.per_axis().items()
+        }
+
+    def to_records(self, step: int = 0) -> List[dict]:
+        """One ``kind="comms"`` record per mesh axis (the MetricRouter
+        schema — route with ``router.emit``)."""
+        from apex_tpu.monitor.router import make_record
+
+        bw = ici_bandwidth_per_device()
+        records = []
+        for axis, d in sorted(self.per_axis().items()):
+            records.append(make_record(
+                "comms", step, axis=axis, axis_size=d["axis_size"],
+                bytes=d["bytes"], ici_bytes=d["ici_bytes"],
+                calls=d["calls"],
+                ici_seconds=(d["ici_bytes"] / bw) if bw else None,
+            ))
+        return records
+
+    def summary(self) -> str:
+        """Human-readable per-axis/per-op breakdown (the startup banner)."""
+        if not self.entries:
+            return "comms ledger: no collectives recorded"
+        bw = ici_bandwidth_per_device()
+        lines = ["comms ledger (per step):"]
+        for axis, d in sorted(self.per_axis().items()):
+            roof = (
+                f" ici>={d['ici_bytes'] / bw * 1e3:.3f} ms"
+                if bw else " ici=? (no bandwidth table entry; set "
+                "APEX_TPU_ICI_BANDWIDTH)"
+            )
+            lines.append(
+                f"  axis {axis!r} (n={d['axis_size']}): "
+                f"{d['bytes'] / 2**20:.2f} MiB payload, "
+                f"{d['ici_bytes'] / 2**20:.2f} MiB wire, "
+                f"{d['calls']} calls{roof}"
+            )
+            for op, od in sorted(self.per_op(axis).items()):
+                lines.append(
+                    f"    {op:12s} {od['calls']:5d} calls "
+                    f"{od['bytes'] / 2**20:9.2f} MiB"
+                )
+        return "\n".join(lines)
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.ledgers: List[CommsLedger] = []
+        self.multiplier = 1
+
+
+_STATE = _State()
+
+
+@contextlib.contextmanager
+def comms_ledger():
+    """Activate a :class:`CommsLedger` for collectives TRACED within.
+
+    Nesting is supported (each active ledger records). Remember the jit
+    cache: a function compiled before the context opened records nothing
+    (see module docstring; use :func:`predict_comms`).
+    """
+    led = CommsLedger()
+    _STATE.ledgers.append(led)
+    try:
+        yield led
+    finally:
+        _STATE.ledgers.remove(led)
+
+
+@contextlib.contextmanager
+def muted():
+    """Suppress recording within: for internal shape-probe traces that
+    are NOT part of the compiled program (the ``jax.eval_shape`` calls
+    schedule construction and ``vma_cond`` use to inspect output types
+    trace the same Python — and would double-count its collectives)."""
+    with scaled(0):
+        yield
+
+
+@contextlib.contextmanager
+def scaled(n: int):
+    """Mark a region whose collectives execute ``n`` times per step for
+    one traced occurrence — scan bodies (pipeline tick loops: the body is
+    traced once, run T times) and vmapped microbatch loops (the trace
+    sees the per-microbatch aval; the batched collective moves n x the
+    bytes). Entries recorded within get ``count`` multiplied by ``n``;
+    nested regions multiply.
+    """
+    n = int(n)
+    if n < 0:
+        raise ValueError(f"scaled() multiplier must be >= 0, got {n}")
+    prev = _STATE.multiplier
+    _STATE.multiplier = prev * n
+    try:
+        yield
+    finally:
+        _STATE.multiplier = prev
+
+
+def predict_comms(fn, *args, **kwargs) -> CommsLedger:
+    """Trace ``fn(*args)`` abstractly under a fresh ledger and return it.
+
+    ``jax.eval_shape`` runs the trace (every wrapper's Python fires)
+    without compiling or touching devices — static comms analysis of a
+    full train step costs milliseconds. Two cache-defeats make this work
+    on a step that already compiled: a jit-wrapped ``fn`` is unwrapped
+    one level (a compiled jit answers eval_shape from its trace cache
+    without re-running Python), and the trace goes through a fresh
+    wrapper function (jax keys trace caches on function identity).
+    INNER jit functions that already traced still answer from cache —
+    trace before the first real call when the step nests jits. Args may
+    be arrays or ShapeDtypeStructs.
+    """
+    if hasattr(fn, "lower"):  # jit-wrapped (only jit stages carry .lower)
+        fn = getattr(fn, "__wrapped__", fn)
+    inner = fn
+    with comms_ledger() as led:
+        jax.eval_shape(lambda *a, **k: inner(*a, **k), *args, **kwargs)
+    return led
+
+
+# -- recording core ---------------------------------------------------------
+
+
+def _axis_key_and_size(axis_name) -> Tuple[str, int]:
+    names = axis_name if isinstance(axis_name, (tuple, list)) else (axis_name,)
+    size = 1
+    for a in names:
+        size *= int(jax.lax.psum(1, a))
+    return ",".join(str(a) for a in names), size
+
+
+def _ici_bytes(op: str, nbytes: int, n: int, nonempty: bool = True) -> int:
+    if n <= 1 or not nonempty:
+        return 0
+    if op in ("psum", "pmean", "pmax", "pmin"):
+        return math.ceil(2 * (n - 1) * nbytes / n)
+    if op == "all_gather":
+        return (n - 1) * nbytes
+    if op in ("psum_scatter", "all_to_all"):
+        return math.ceil((n - 1) * nbytes / n)
+    if op == "ppermute":
+        return nbytes
+    return nbytes
+
+
+def record(op: str, x: Any, axis_name, *, nonempty: bool = True) -> None:
+    """Record ``x``'s leaves as one ``op`` occurrence over ``axis_name``.
+
+    The public hook for collectives with no wrapper here (e.g. the
+    private invariant all_gather in parallel/mappings.py). No-op when no
+    ledger is active or the axis environment cannot resolve (the real
+    collective then raises its own, better error).
+    """
+    if not _STATE.ledgers or _STATE.multiplier == 0:
+        return
+    try:
+        axis, n = _axis_key_and_size(axis_name)
+    except Exception:
+        return  # unbound axis: the wrapped call itself will surface it
+    if n <= 1:
+        # a collective over a size-1 axis moves nothing (XLA elides it);
+        # recording it would put phantom bytes in the report
+        return
+    mult = _STATE.multiplier
+    for leaf in jax.tree_util.tree_leaves(x):
+        aval = getattr(leaf, "aval", None)
+        if aval is None:
+            if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+                continue  # python scalar: folded statically, no traffic
+            aval = leaf
+        nbytes = int(np.prod(aval.shape, dtype=np.int64)) * np.dtype(
+            aval.dtype
+        ).itemsize
+        entry = CollectiveEntry(
+            op=op,
+            axis=axis,
+            axis_size=n,
+            shape=tuple(aval.shape),
+            dtype=str(aval.dtype),
+            bytes=nbytes,
+            ici_bytes=_ici_bytes(op, nbytes, n, nonempty),
+            count=mult,
+        )
+        for led in _STATE.ledgers:
+            led.entries.append(entry)
+
+
+# -- instrumented wrappers (same primitives, plus trace-time recording) -----
+
+
+def axis_size(axis_name) -> Any:
+    """Static mesh-axis size (``psum`` of the literal 1 — folded by XLA,
+    no communication, hence never recorded)."""
+    return jax.lax.psum(1, axis_name)
+
+
+def psum(x, axis_name, **kwargs):
+    record("psum", x, axis_name)
+    return jax.lax.psum(x, axis_name, **kwargs)
+
+
+def pmean(x, axis_name, **kwargs):
+    record("pmean", x, axis_name)
+    return jax.lax.pmean(x, axis_name, **kwargs)
+
+
+def pmax(x, axis_name, **kwargs):
+    record("pmax", x, axis_name)
+    return jax.lax.pmax(x, axis_name, **kwargs)
+
+
+def pmin(x, axis_name, **kwargs):
+    record("pmin", x, axis_name)
+    return jax.lax.pmin(x, axis_name, **kwargs)
+
+
+def all_gather(x, axis_name, **kwargs):
+    record("all_gather", x, axis_name)
+    return jax.lax.all_gather(x, axis_name, **kwargs)
+
+
+def psum_scatter(x, axis_name, **kwargs):
+    record("psum_scatter", x, axis_name)
+    return jax.lax.psum_scatter(x, axis_name, **kwargs)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, **kwargs):
+    record("all_to_all", x, axis_name)
+    return jax.lax.all_to_all(x, axis_name, split_axis, concat_axis, **kwargs)
+
+
+def ppermute(x, axis_name, perm):
+    record("ppermute", x, axis_name, nonempty=bool(len(perm)))
+    return jax.lax.ppermute(x, axis_name, perm)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.ops.multi_tensor import FlatSpec, flatten_pytree, unflatten_pytree
 
 
@@ -147,12 +148,12 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
     if not any(reduced):
         # classic regime: one fused reduce-scatter over the flat buffer
         gflat, spec = _padded_flatten(grads, axis_size)
-        gshard = jax.lax.psum_scatter(gflat, axis_name, tiled=True)
+        gshard = xlax.psum_scatter(gflat, axis_name, tiled=True)
     else:
         # normalize every leaf to "cross-rank sum" BEFORE flattening
         # (psum the stragglers), then the collective is a local slice
         flat_leaves = [
-            l if r else jax.lax.psum(l, axis_name)
+            l if r else xlax.psum(l, axis_name)
             for l, r in zip(leaves, reduced)
         ]
         grads = jax.tree_util.tree_unflatten(
@@ -170,7 +171,7 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
 def zero_gather_updates(new_master, params, spec, axis_name: str):
     """Shared ZeRO epilogue: all-gather the updated master shard and return
     optax-style updates (new - old) in the params' dtypes."""
-    new_flat = jax.lax.all_gather(new_master, axis_name, tiled=True)
+    new_flat = xlax.all_gather(new_master, axis_name, tiled=True)
     new_params = unflatten_pytree(new_flat, spec_like(spec, params), cast_back=True)
     return jax.tree_util.tree_map(
         lambda n, o: (
@@ -251,7 +252,7 @@ def distributed_fused_adam(
         if max_grad_norm is not None:
             from apex_tpu.optimizers._fused_kernels import sumsq_flat
 
-            total = jax.lax.psum(sumsq_flat(gshard), axis_name)
+            total = xlax.psum(sumsq_flat(gshard), axis_name)
             clip = jnp.minimum(1.0, max_grad_norm / (jnp.sqrt(total) + 1e-6))
             gshard = gshard * clip
 
@@ -283,7 +284,7 @@ def distributed_fused_adam(
 
         if store_param_remainders:
             hi, lo = _split_master(new_master)
-            new_flat = jax.lax.all_gather(hi, axis_name, tiled=True)
+            new_flat = xlax.all_gather(hi, axis_name, tiled=True)
             new_params = unflatten_pytree(
                 new_flat, spec_like(spec, params), cast_back=True
             )

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.ops.multi_tensor import FlatSpec
 from apex_tpu.optimizers.distributed_fused_adam import (
     zero_gather_updates,
@@ -95,7 +96,7 @@ def distributed_fused_lamb(
         # one flat buffer — the case where flat wins, BENCH.md), then psum
         from apex_tpu.optimizers._fused_kernels import sumsq_flat
 
-        sq = jax.lax.psum(sumsq_flat(gshard), axis_name)
+        sq = xlax.psum(sumsq_flat(gshard), axis_name)
         global_norm = jnp.sqrt(sq)
         clip = jnp.where(
             (max_grad_norm > 0) & (global_norm > max_grad_norm),
@@ -118,10 +119,10 @@ def distributed_fused_lamb(
 
         # per-TENSOR trust ratios across the flat shard: segment sums of
         # squares, combined over dp ranks
-        w_norm_sq = jax.lax.psum(
+        w_norm_sq = xlax.psum(
             jax.ops.segment_sum(p * p, seg, num_segments=nseg), axis_name
         )
-        u_norm_sq = jax.lax.psum(
+        u_norm_sq = xlax.psum(
             jax.ops.segment_sum(u * u, seg, num_segments=nseg), axis_name
         )
         w_norm = jnp.sqrt(w_norm_sq)

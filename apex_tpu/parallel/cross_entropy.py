@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.parallel import parallel_state
 
 
@@ -73,17 +74,17 @@ def _vp_ce_fwd(logits_local, target, label_smoothing, axis_name):
         rank = jax.lax.axis_index(axis_name)
         start = rank * vocab_local
         # global max for stability (ref: allreduce MAX, cross_entropy.py:38)
-        gmax = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
+        gmax = xlax.pmax(jnp.max(lf, axis=-1), axis_name)
         shifted = lf - gmax[..., None]
-        sum_exp = jax.lax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
+        sum_exp = xlax.psum(jnp.sum(jnp.exp(shifted), axis=-1), axis_name)
         lse = jnp.log(sum_exp) + gmax
         # target logit: only the owning rank contributes (ref: masked gather
         # + allreduce, cross_entropy.py:55-77)
         in_range = (target >= start) & (target < start + vocab_local)
         local_ids = jnp.clip(target - start, 0, vocab_local - 1)
         partial = jnp.take_along_axis(lf, local_ids[..., None], axis=-1)[..., 0]
-        tlogit = jax.lax.psum(jnp.where(in_range, partial, 0.0), axis_name)
-        mean_logit = jax.lax.psum(jnp.sum(lf, axis=-1), axis_name) / (
+        tlogit = xlax.psum(jnp.where(in_range, partial, 0.0), axis_name)
+        mean_logit = xlax.psum(jnp.sum(lf, axis=-1), axis_name) / (
             vocab_local * tp
         )
 

@@ -20,6 +20,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
+
 
 def vma_tracking_live(axis_name: str) -> bool:
     """Trace-time: is varying-manual-axes tracking active for this axis?
@@ -99,7 +101,7 @@ def all_reduce_gradients(
     grads of a PER-RANK (shard-local) loss; tests/test_ddp.py pins both
     regimes.
     """
-    n = jax.lax.psum(1, axis_name)
+    n = xlax.axis_size(axis_name)
     tracking = vma_tracking_live(axis_name)
 
     def _one(g):
@@ -118,7 +120,7 @@ def all_reduce_gradients(
             return g.astype(orig)
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
-        g = jax.lax.psum(g, axis_name)
+        g = xlax.psum(g, axis_name)
         if gradient_average:
             g = g * (gradient_predivide_factor / n)
         return g.astype(orig)
@@ -133,7 +135,7 @@ def broadcast_params(params: Any, axis_name: str = "dp") -> Any:
     replicated and this is identity."""
 
     def _one(p):
-        gathered = jax.lax.all_gather(p, axis_name, axis=0)
+        gathered = xlax.all_gather(p, axis_name, axis=0)
         return gathered[0]
 
     return jax.tree_util.tree_map(_one, params)
@@ -196,7 +198,7 @@ class Reducer:
         self.axis_name = axis_name
 
     def reduce(self, tree: Any) -> Any:
-        n = jax.lax.psum(1, self.axis_name)
+        n = xlax.axis_size(self.axis_name)
         tracking = vma_tracking_live(self.axis_name)
 
         def _one(x):
@@ -205,6 +207,6 @@ class Reducer:
                 # Reducer's contract is a MEAN of per-rank values, and a
                 # replicated leaf's mean is itself
                 return x
-            return jax.lax.psum(x, self.axis_name) / n
+            return xlax.psum(x, self.axis_name) / n
 
         return jax.tree_util.tree_map(_one, tree)

@@ -24,21 +24,26 @@ import functools
 
 import jax
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.parallel.utils import pcast_varying
 
 # -- raw collectives (axis-name-parameterized) ------------------------------
+# All collectives go through the xray ledger wrappers (monitor/xray/
+# ledger.py) — same primitives, plus trace-time comms accounting. Because
+# every op here is a custom_vjp fwd OR bwd rule, a ledger trace of
+# jax.grad captures the full TP fwd+bwd collective traffic.
 
 
 def _split_along_axis(x, axis_name: str, dim: int):
     """Keep this rank's slice of dim (ref: utils.py split_tensor_along_last_dim)."""
-    n = jax.lax.psum(1, axis_name)
+    n = xlax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     size = x.shape[dim] // n
     return jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis=dim)
 
 
 def _all_gather_dim(x, axis_name: str, dim: int):
-    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+    return xlax.all_gather(x, axis_name, axis=dim, tiled=True)
 
 
 def _all_gather_invariant_dim(x, axis_name: str, dim: int):
@@ -56,6 +61,9 @@ def _all_gather_invariant_dim(x, axis_name: str, dim: int):
     except ImportError:  # older jax: unchecked semantics, plain gather
         return _all_gather_dim(x, axis_name, dim)
     try:
+        # no wrapper for the private invariant gather: record it under
+        # the same op kind (identical bytes on the wire)
+        xlax.record("all_gather", x, axis_name)
         return all_gather_invariant(x, axis_name, axis=dim, tiled=True)
     except TypeError as e:  # signature drift in a future jax release
         raise TypeError(
@@ -68,7 +76,7 @@ def _all_gather_invariant_dim(x, axis_name: str, dim: int):
 
 
 def _reduce_scatter_dim(x, axis_name: str, dim: int):
-    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+    return xlax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
 
 
 def _typed_gather(g, primal_probe, axis_name: str, dim: int):
@@ -104,7 +112,7 @@ def _copy_fwd(x, axis_name):
 
 
 def _copy_bwd(axis_name, _, g):
-    return (jax.lax.psum(g, axis_name),)
+    return (xlax.psum(g, axis_name),)
 
 
 copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
@@ -112,11 +120,11 @@ copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def reduce_from_tensor_model_parallel_region(x, axis_name="tp"):
-    return jax.lax.psum(x, axis_name)
+    return xlax.psum(x, axis_name)
 
 
 def _reduce_fwd(x, axis_name):
-    return jax.lax.psum(x, axis_name), None
+    return xlax.psum(x, axis_name), None
 
 
 def _reduce_bwd(axis_name, _, g):

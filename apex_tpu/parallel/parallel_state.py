@@ -241,7 +241,9 @@ def amax_reduction(local_amax):
     for ax in get_amax_reduction_axes():
         if _MESH is not None and int(get_mesh().shape[ax]) > 1:
             try:
-                out = jax.lax.pmax(out, ax)
+                from apex_tpu.monitor.xray import ledger as xlax
+
+                out = xlax.pmax(out, ax)
             except NameError as e:
                 # outside shard_map the statistic would be silently
                 # UNREDUCED over a >1 axis — surface the misuse instead

@@ -31,15 +31,19 @@ from typing import Any
 
 import jax
 
+from apex_tpu.monitor.xray import ledger as xlax
+
 
 def _permute(x: Any, axis_name: str, perm) -> Any:
+    # the xray wrapper records each edge's bytes when a comms ledger is
+    # tracing (same primitive either way)
     return jax.tree_util.tree_map(
-        lambda leaf: jax.lax.ppermute(leaf, axis_name, perm), x
+        lambda leaf: xlax.ppermute(leaf, axis_name, perm), x
     )
 
 
 def _pp_size(axis_name: str):
-    return jax.lax.psum(1, axis_name)
+    return xlax.axis_size(axis_name)
 
 
 def send_forward_recv_forward(x: Any, axis_name: str = "pp") -> Any:

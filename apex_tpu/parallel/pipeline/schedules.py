@@ -44,6 +44,7 @@ from typing import Any, Callable, List, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.parallel.pipeline import p2p
 
 
@@ -105,13 +106,18 @@ def _scan_ticks(tick, state0, num_ticks: int, tick_block_remat: int):
             return jax.lax.scan(tick, carry, tblock)
 
         ticks = jnp.arange(nblocks * B).reshape(nblocks, B)
-        state, ys = jax.lax.scan(block, state0, ticks)
+        # the tick body traces ONCE but runs nblocks*B times (padding
+        # ticks included — they ship real edges); the xray comms ledger
+        # weighs its collectives accordingly
+        with xlax.scaled(nblocks * B):
+            state, ys = jax.lax.scan(block, state0, ticks)
         # un-block the stacked outputs: (nblocks, B, ...) -> (nblocks*B, ...)
         ys = jax.tree_util.tree_map(
             lambda a: a.reshape((-1,) + a.shape[2:]), ys
         )
         return state, ys
-    return jax.lax.scan(tick, state0, jnp.arange(num_ticks))
+    with xlax.scaled(num_ticks):
+        return jax.lax.scan(tick, state0, jnp.arange(num_ticks))
 
 
 def pipeline_forward(
@@ -138,13 +144,14 @@ def pipeline_forward(
     ``tick_block_remat`` bounds the per-tick residuals for large M
     (_scan_ticks).
     """
-    num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
+    num_stages = xlax.axis_size(axis_name)  # static inside shard_map
     rank = jax.lax.axis_index(axis_name)
     num_micro = _leading_dim(microbatches)
     body = jax.checkpoint(stage_fn) if remat else stage_fn
 
     mb0 = _index(microbatches, 0)
-    out_shape = jax.eval_shape(stage_fn, params, mb0)
+    with xlax.muted():  # shape probe, not part of the compiled program
+        out_shape = jax.eval_shape(stage_fn, params, mb0)
     state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
@@ -208,7 +215,7 @@ def pipeline_forward_interleaved(
     stage at the statically-known tick k*V*P + (V-1)*P + i + (P-1), so the
     gather indices are a host-side constant.
     """
-    num_stages = jax.lax.psum(1, axis_name)  # static inside shard_map
+    num_stages = xlax.axis_size(axis_name)  # static inside shard_map
     rank = jax.lax.axis_index(axis_name)
     num_micro = _leading_dim(microbatches)
     V = num_model_chunks
@@ -231,7 +238,8 @@ def pipeline_forward_interleaved(
     body = jax.checkpoint(chunk_fn) if remat else chunk_fn
 
     mb0 = _index(microbatches, 0)
-    out_shape = jax.eval_shape(body, params_chunks, 0, mb0)
+    with xlax.muted():  # shape probe, not part of the compiled program
+        out_shape = jax.eval_shape(body, params_chunks, 0, mb0)
     state0 = _varying_zeros(out_shape, axis_name)
 
     def tick(state, t):
@@ -287,18 +295,18 @@ def _stages_forward(
 def _publish_losses(per_microbatch_losses, axis_name: str):
     """Mask bubble garbage off non-final stages, publish the mean loss and
     the per-microbatch losses from the last stage to every stage."""
-    num_stages = jax.lax.psum(1, axis_name)
+    num_stages = xlax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     losses = jnp.where(rank == num_stages - 1, per_microbatch_losses, 0.0)
     loss = _last_stage_mean_loss(losses, axis_name)
-    return loss, jax.lax.psum(losses, axis_name)
+    return loss, xlax.psum(losses, axis_name)
 
 
 def _last_stage_mean_loss(per_microbatch_losses, axis_name: str):
     """Average per-microbatch losses and publish from the last stage to all
     stages (ref: losses divided by num_microbatches on the last stage,
     common.py:305-309; other stages return nothing)."""
-    num_stages = jax.lax.psum(1, axis_name)
+    num_stages = xlax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     mean = jnp.mean(per_microbatch_losses)
     local = jnp.where(rank == num_stages - 1, mean, 0.0)
@@ -308,7 +316,7 @@ def _last_stage_mean_loss(per_microbatch_losses, axis_name: str):
     # graph once (on the last stage) and the ppermute transposes carry it
     # back through every stage exactly as the reference's backward phases.
     return local + jax.lax.stop_gradient(
-        jax.lax.psum(local, axis_name) - local
+        xlax.psum(local, axis_name) - local
     )
 
 
@@ -478,7 +486,7 @@ def forward_backward_with_pre_post(
     def _combine(g):
         if grads_already_reduced(g, axis_name, tracking):
             return g
-        return jax.lax.psum(g, axis_name)
+        return xlax.psum(g, axis_name)
 
     grads = dict(grads)
     grads["pre"] = jax.tree_util.tree_map(_combine, grads["pre"])

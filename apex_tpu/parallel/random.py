@@ -23,6 +23,7 @@ from typing import Callable
 
 import jax
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.parallel import parallel_state
 
 _MODEL_PARALLEL_OFFSET = 2718  # matches the reference's seed offset constant
@@ -135,7 +136,7 @@ def checkpoint_distributed(fn: Callable, axis_name: str = "tp"):
 
     @functools.wraps(fn)
     def wrapped(x, *args):
-        n = jax.lax.psum(1, axis_name)
+        n = xlax.axis_size(axis_name)
         if x.shape[0] % n != 0:
             raise ValueError(
                 f"checkpoint_distributed: leading dim ({x.shape[0]}) not "

@@ -51,15 +51,17 @@ import math
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
+
 _NEG_INF = -1e30
 
 
 def _rotate(tree, axis_name: str):
     """Move every leaf one rank down the ring (rank r -> r+1 mod P)."""
-    n = jax.lax.psum(1, axis_name)
+    n = xlax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     return jax.tree_util.tree_map(
-        lambda x: jax.lax.ppermute(x, axis_name, perm), tree
+        lambda x: xlax.ppermute(x, axis_name, perm), tree
     )
 
 
@@ -229,7 +231,7 @@ def _keep_from_bias(kbias):
 
 def _ring_fwd_res(q, k, v, kbias, axis_name, causal, scale, block_size,
                   window, zigzag):
-    num_ranks = jax.lax.psum(1, axis_name)
+    num_ranks = xlax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     h_kv = k.shape[1]
@@ -283,9 +285,11 @@ def _ring_fwd_res(q, k, v, kbias, axis_name, causal, scale, block_size,
         from apex_tpu.parallel.utils import pvary_params
 
         carry0 = pvary_params(((k, v, bias_carry), state), axis_name)
-        ((_, _, _), state), _ = jax.lax.scan(
-            step, carry0, jnp.arange(1, num_ranks)
-        )
+        # the rotation traces once but runs P-1 times (comms accounting)
+        with xlax.scaled(num_ranks - 1):
+            ((_, _, _), state), _ = jax.lax.scan(
+                step, carry0, jnp.arange(1, num_ranks)
+            )
     acc, m, l = state
     l = jnp.maximum(l, 1e-30)
     o = (acc / l[..., None]).reshape(b, h, sq, d).astype(q.dtype)
@@ -362,7 +366,7 @@ def _chunk_bwd_update(q, do, delta, lse, kc, vc, dkc, dvc, dq, scale, rows,
 
 def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
     q, k, v, kbias, o, lse = res
-    num_ranks = jax.lax.psum(1, axis_name)
+    num_ranks = xlax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, h, sq, d = q.shape
     h_kv = k.shape[1]
@@ -416,7 +420,8 @@ def _ring_bwd(axis_name, causal, scale, block_size, window, zigzag, res, do):
         from apex_tpu.parallel.utils import pvary_params
 
         carry = pvary_params(carry, axis_name)  # see fwd: carry fixed point
-        carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))
+        with xlax.scaled(num_ranks - 1):  # see fwd: P-1 rotations
+            carry, _ = jax.lax.scan(step, carry, jnp.arange(1, num_ranks))
     (kc, vc, _, dk, dv), dq = carry
     # one homing rotation: after P-1 rotations the accumulators sit one rank
     # short of their owners
@@ -556,7 +561,7 @@ def ulysses_attention(
         from apex_tpu.ops.attention import flash_attention
 
         attn_fn = flash_attention
-    num_ranks = jax.lax.psum(1, axis_name)  # static inside shard_map
+    num_ranks = xlax.axis_size(axis_name)  # static inside shard_map
     assert q.shape[1] % num_ranks == 0, (
         f"heads ({q.shape[1]}) not divisible by cp size ({num_ranks}); "
         "use ring_attention for head counts below the cp degree"
@@ -569,17 +574,17 @@ def ulysses_attention(
     # With cp=1 this degrades to plain attention.
     def to_heads(x):
         # (b, h, s_loc, d) -> (b, h/P, s_glob, d)
-        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        return xlax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
     def to_seq(x):
-        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        return xlax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     # heads are sharded but each rank sees the FULL sequence, so the local
     # attention supports windows natively
     kw = {} if window is None else {"window": window}
     if key_padding_mask is not None:
-        kw["key_padding_mask"] = jax.lax.all_gather(
+        kw["key_padding_mask"] = xlax.all_gather(
             key_padding_mask, axis_name, axis=1, tiled=True
         )
     oh = attn_fn(qh, kh, vh, causal=causal, scale=scale, **kw)
@@ -626,8 +631,8 @@ def cp_decode_attention(q, k, v, padded, axis_name: str, scale=None):
     p = jnp.where(pad, 0.0, jnp.exp(s - m))  # all-padded shard: p == 0
     l = jnp.sum(p, axis=-1, keepdims=True)
     o = jnp.einsum("bhgl,bhld->bhgd", p, v.astype(jnp.float32))
-    m_g = jax.lax.pmax(m, axis_name)
+    m_g = xlax.pmax(m, axis_name)
     alpha = jnp.exp(m - m_g)  # -> 0 for shards far below the global max
-    l_g = jax.lax.psum(l * alpha, axis_name)
-    o_g = jax.lax.psum(o * alpha, axis_name) / l_g
+    l_g = xlax.psum(l * alpha, axis_name)
+    o_g = xlax.psum(o * alpha, axis_name) / l_g
     return o_g.reshape(b, h, 1, d).astype(q.dtype)

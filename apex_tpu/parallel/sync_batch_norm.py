@@ -24,6 +24,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
+
 
 class SyncBatchNorm(nn.Module):
     """flax BatchNorm drop-in that reduces statistics over mesh axes.
@@ -73,9 +75,9 @@ class SyncBatchNorm(nn.Module):
             ) * n_local
             for ax in self.axis_names:
                 try:
-                    n = jax.lax.psum(n, ax)
-                    m_sum = jax.lax.psum(m_sum, ax)
-                    s_sum = jax.lax.psum(s_sum, ax)
+                    n = xlax.psum(n, ax)
+                    m_sum = xlax.psum(m_sum, ax)
+                    s_sum = xlax.psum(s_sum, ax)
                 except NameError:  # axis not in scope -> local BN
                     pass
             mean = m_sum / n

@@ -138,8 +138,14 @@ def vma_cond(pred, true_fn, false_fn, *operands):
     ``shard_map``, where it is exactly ``jax.lax.cond``.
     """
     try:
-        t_shape = jax.eval_shape(true_fn, *operands)
-        f_shape = jax.eval_shape(false_fn, *operands)
+        # muted: these shape probes re-trace branch Python (possibly
+        # containing collectives) without becoming part of the program —
+        # the xray comms ledger must not double-count them
+        from apex_tpu.monitor.xray import ledger as _xlax
+
+        with _xlax.muted():
+            t_shape = jax.eval_shape(true_fn, *operands)
+            f_shape = jax.eval_shape(false_fn, *operands)
         t_leaves, t_def = jax.tree_util.tree_flatten(t_shape)
         f_leaves, f_def = jax.tree_util.tree_flatten(f_shape)
         if t_def != f_def or len(t_leaves) != len(f_leaves):
@@ -202,8 +208,11 @@ def scan_carry_fixed_point(body, carry, x0, max_iters: int = 3):
     # max_iters + 1 evals: a round whose widening REACHES the fixed point
     # must not raise — convergence means some eval produced no widening,
     # so the last allowed widening gets one extra verification eval
+    from apex_tpu.monitor.xray import ledger as _xlax
+
     for _ in range(max_iters + 1):
-        out_carry = jax.eval_shape(lambda c: body(c, x0)[0], carry)
+        with _xlax.muted():  # shape probe — see vma_cond
+            out_carry = jax.eval_shape(lambda c: body(c, x0)[0], carry)
         changed = False
 
         def widen(c, o):

@@ -26,6 +26,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.transformer.config import TransformerConfig
 
 
@@ -33,7 +34,7 @@ def _axis_size_or_1(axis_name: Optional[str]) -> int:
     if axis_name is None:
         return 1
     try:
-        return jax.lax.psum(1, axis_name)
+        return xlax.axis_size(axis_name)
     except NameError:
         return 1
 
@@ -161,7 +162,7 @@ class MoEMLP(nn.Module):
                 # shards so each rank receives ITS experts' slots from all
                 # ranks: result (ep_src, local_e, C, h)
                 d = dispatch.reshape(ep, local_e, capacity, h)
-                d = jax.lax.all_to_all(
+                d = xlax.all_to_all(
                     d, self.expert_axis, split_axis=0, concat_axis=0,
                     tiled=False,
                 )
@@ -180,7 +181,7 @@ class MoEMLP(nn.Module):
             ).astype(x.dtype)
 
             if ep > 1:
-                y = jax.lax.all_to_all(
+                y = xlax.all_to_all(
                     y, self.expert_axis, split_axis=0, concat_axis=0,
                     tiled=False,
                 )

@@ -540,6 +540,16 @@ def _add_logging_args(parser):
     group.add_argument("--metrics-jsonl", type=str, default=None,
                        help="write kind='metrics' jsonl records here "
                             "(apex_tpu.monitor schema)")
+    # apex_tpu.monitor.xray extension: startup introspection of the
+    # compiled step (docs/observability.md, X-ray section)
+    group.add_argument("--xray-report", action="store_true",
+                       help="print the XLA memory breakdown of the "
+                            "compiled step (and emit a kind='memory' "
+                            "record) before training")
+    group.add_argument("--xray-comms", action="store_true",
+                       help="trace the step under the collective ledger "
+                            "and print/emit per-axis comms volume + ICI "
+                            "roofline (kind='comms' records)")
     group.add_argument("--log-params-norm", action="store_true")
     group.add_argument("--log-num-zeros-in-grad", action="store_true")
     group.add_argument("--tensorboard-log-interval", type=int, default=1)

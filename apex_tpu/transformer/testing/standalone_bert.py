@@ -26,6 +26,7 @@ from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.bert import BertModel
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.parallel import parallel_state
 from apex_tpu.parallel.ddp import all_reduce_gradients
@@ -93,7 +94,7 @@ def run_bert(args=None, log=print):
             )
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), jax.lax.pmean(loss, "dp")
+            return (params, opt_state), xlax.pmean(loss, "dp")
 
         _, losses = jax.lax.scan(one_step, (params, opt_state), (tokens, labels))
         return losses

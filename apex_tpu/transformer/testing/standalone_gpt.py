@@ -26,6 +26,7 @@ from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.optimizers import fused_adam
 from apex_tpu.parallel import parallel_state
 from apex_tpu.parallel.ddp import all_reduce_gradients
@@ -118,19 +119,24 @@ def run_gpt(args=None, log=print):
         check_vma=False,
     )
     def train(tokens, labels):
-        init_key = jax.random.PRNGKey(args.seed)
-        pre = parts.embed.init(init_key, tokens[0, 0])["params"]
-        h0 = parts.pre_fn(pre, tokens[0, 0])
-        r = jax.lax.axis_index("pp")
-        stage = parts.chunk.init(
-            jax.random.fold_in(jax.random.fold_in(init_key, 7), r), h0
-        )["params"]
-        params = {
-            "pre": pre,
-            "stages": stage,
-            "post": parts.init_post(jax.random.fold_in(init_key, 9)),
-        }
-        opt_state = opt.init(params)
+        # muted: this init block runs ONCE PER RUN, not once per step —
+        # its collectives (the vocab-parallel embedding's psum, the
+        # stage-init forward's RowParallel psums) must not inflate the
+        # ledger's per-step comms totals
+        with xlax.muted():
+            init_key = jax.random.PRNGKey(args.seed)
+            pre = parts.embed.init(init_key, tokens[0, 0])["params"]
+            h0 = parts.pre_fn(pre, tokens[0, 0])
+            r = jax.lax.axis_index("pp")
+            stage = parts.chunk.init(
+                jax.random.fold_in(jax.random.fold_in(init_key, 7), r), h0
+            )["params"]
+            params = {
+                "pre": pre,
+                "stages": stage,
+                "post": parts.init_post(jax.random.fold_in(init_key, 9)),
+            }
+            opt_state = opt.init(params)
 
         def one_step(carry, batch):
             params, opt_state = carry
@@ -148,12 +154,38 @@ def run_gpt(args=None, log=print):
             # post_loss_fn) so psum completes the token mean; without SP
             # the loss is already tp-replicated and a psum would scale by tp
             if cfg.sequence_parallel and tp > 1:
-                loss = jax.lax.psum(loss, "tp")
-            loss = jax.lax.pmean(loss, "dp")
+                loss = xlax.psum(loss, "tp")
+            loss = xlax.pmean(loss, "dp")
             return (params, opt_state), loss
 
         _, losses = jax.lax.scan(one_step, (params, opt_state), (tokens, labels))
         return losses
+
+    router = _make_router(args)
+
+    # X-ray startup banner (docs/observability.md): static introspection
+    # of the compiled run BEFORE it executes — per-step comms volume from
+    # a ledger trace (the whole run is one scan over steps, so the traced
+    # step body IS one step's traffic; the once-per-run init block is
+    # muted), and XLA's memory breakdown (NOTE: one extra compile — on
+    # jax 0.4.x the AOT compile does not share the jit dispatch cache,
+    # see xray.memory_report). Records join the same jsonl stream as
+    # metrics when a sink is configured.
+    if getattr(args, "xray_comms", False):
+        from apex_tpu.monitor import xray
+
+        led = xray.predict_comms(train, tokens, labels)
+        log(led.summary())
+        if router is not None:
+            for rec in led.to_records(step=0):
+                router.emit(rec)
+    if getattr(args, "xray_report", False):
+        from apex_tpu.monitor import xray
+
+        report = xray.memory_report(train, tokens, labels)
+        log(report.format())
+        if router is not None:
+            router.event("memory", 0, **report.fields())
 
     import time
 
@@ -163,7 +195,6 @@ def run_gpt(args=None, log=print):
     for i, l in enumerate(losses):
         log(f"iteration {i:4d} | lm loss {float(l):.4f}")
 
-    router = _make_router(args)
     if router is not None:
         from apex_tpu import monitor
 

@@ -13,11 +13,13 @@ from typing import Any, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.monitor.xray import ledger as xlax
+
 
 def average_losses_across_data_parallel_group(losses, axis_name: str = "dp"):
     """(ref :242) — call inside shard_map; stacks then dp-means."""
     stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
-    return jax.lax.pmean(stacked, axis_name)
+    return xlax.pmean(stacked, axis_name)
 
 
 def calc_params_l2_norm(
@@ -49,7 +51,7 @@ def calc_params_l2_norm(
         )
     )
     if axis_name:
-        total = jax.lax.psum(total, axis_name)
+        total = xlax.psum(total, axis_name)
     return jnp.sqrt(total)
 
 

@@ -9,10 +9,11 @@ cost at real microbatch counts, and does ``tick_block_remat`` (nested-scan
 rematerialization, schedules._scan_ticks) restore the bound?
 
 Method: compile the full fwd+bwd step on a P-rank mesh (virtual CPU
-devices) and read XLA's ``memory_analysis().temp_size_in_bytes`` — the
-compiled live-buffer high-water mark, the same quantity a TPU HBM OOM is
-about.  Sweep M with tick_block_remat in {0 (off), 8, sqrt-ish} for both
-schedules.  Results recorded in BENCH.md.
+devices) and read XLA's live-temporary high-water mark via
+``apex_tpu.monitor.xray.memory_report`` (the one home of the
+lower/compile/memory_analysis dance) — the same quantity a TPU HBM OOM
+is about.  Sweep M with tick_block_remat in {0 (off), 8, sqrt-ish} for
+both schedules.  Results recorded in BENCH.md.
 
 Usage: python benchmarks/bench_pipeline_memory.py  (forces CPU; the axon
 sitecustomize pins jax_platforms, so the script must config.update —
@@ -38,6 +39,7 @@ import numpy as np
 from apex_tpu.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from apex_tpu.monitor.xray import memory_report
 from apex_tpu.parallel.pipeline import (
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
@@ -94,8 +96,7 @@ def temp_bytes(num_micro, block, vpp=1):
             )
         return loss, grads
 
-    compiled = jax.jit(run).lower(params, mbs, targets).compile()
-    return compiled.memory_analysis().temp_size_in_bytes
+    return memory_report(run, params, mbs, targets).temp_bytes
 
 
 def main():

@@ -110,6 +110,16 @@ def parse_args():
     p.add_argument("--step-deadline", type=float, default=None,
                    help="stall watchdog: flag a step exceeding this many "
                         "seconds (default: off)")
+    # X-ray (apex_tpu.monitor.xray; docs/observability.md): static +
+    # runtime introspection of the compiled step itself
+    p.add_argument("--xray-report", action="store_true",
+                   help="startup banner: XLA memory breakdown of the "
+                        "compiled step vs device headroom (kind='memory' "
+                        "record)")
+    p.add_argument("--xray-comms", action="store_true",
+                   help="startup banner + periodic kind='comms' records: "
+                        "per-axis collective bytes/step and ICI roofline "
+                        "from a ledger trace of the step")
     # fault injection (apex_tpu.resilience.chaos) — for tests and drills
     p.add_argument("--chaos-nan-steps", default="",
                    help="comma/range list of steps whose loss is NaN-poisoned")
@@ -238,7 +248,11 @@ def main():
                 scaler.scale(scaler_state, jnp.mean(losses)), inject_nan
             )
 
-        loss, grads = jax.value_and_grad(scaled_total)(params)
+        # comms-ledger weighting: collectives inside the vmapped model
+        # (fwd AND the custom_vjp bwds) trace with per-MICROBATCH avals
+        # while the batched collective ships num_micro x the bytes
+        with monitor.xray.scaled(num_micro):
+            loss, grads = jax.value_and_grad(scaled_total)(params)
         grads = all_reduce_gradients(grads, axis_name="dp")
         grads, found_inf = scaler.unscale(scaler_state, grads)
         # the scaler's dynamic schedule reacts to true overflow only; the
@@ -250,7 +264,7 @@ def main():
         # sequence before the head and vocab_parallel_cross_entropy psums
         # over tp internally — only the dp average is needed (verified
         # empirically: tp=2 SP and non-SP local losses are identical)
-        unscaled = jax.lax.pmean(loss / scaler_state.scale, "dp")
+        unscaled = monitor.xray.ledger.pmean(loss / scaler_state.scale, "dp")
         gate = jnp.logical_or(
             found_inf, sentinel.is_anomalous_loss(sent_state, unscaled)
         )
@@ -396,6 +410,40 @@ def main():
         if step0:
             print(f"resumed from step {step0}")
 
+    # X-ray startup banners (apex_tpu.monitor.xray, docs/observability.md):
+    # what the compiled step IS — collective traffic and HBM footprint —
+    # before the first batch runs. The ledger trace is abstract
+    # (eval_shape: milliseconds, no devices); the memory report pays a
+    # real compile (see the NOTE below).
+    batch_struct = jax.ShapeDtypeStruct(
+        (num_micro, args.micro_batch * dp, args.seq_len), jnp.int32
+    )
+    scalar_struct = jax.ShapeDtypeStruct((), jnp.float32)
+    step_args = (params, opt_state, scaler_state, sent_state, bag,
+                 batch_struct, batch_struct, scalar_struct, scalar_struct)
+    comms_led = None
+    if args.xray_comms:
+        comms_led = monitor.xray.predict_comms(train_step, *step_args)
+        print(comms_led.summary(), flush=True)
+        for rec in comms_led.to_records(step=step0):
+            router.emit(rec)
+    if args.xray_report:
+        # NOTE: this pays one extra compile of the step at startup — on
+        # jax 0.4.x the AOT compile does not share the jit dispatch
+        # cache (see xray.memory_report's docstring)
+        report = monitor.xray.memory_report(train_step, *step_args)
+        print(report.format(), flush=True)
+        router.event("memory", step0, **report.fields())
+    # warm the interval-emission path's eager host ops (bag pack/reset)
+    # NOW: their one-off compiles must land before the recompile
+    # sentinel arms, and on a RESUMED run the first interval boundary
+    # can be many steps past step0 — well after warmup
+    monitor.read_bag(bag)
+    bag = jax.device_put(monitor.reset_bag(bag), replicated)
+    # recompile sentinel: always on — a silent post-warmup recompile is
+    # the classic 10x step-time killer and costs nothing to watch for
+    compile_watcher = monitor.xray.CompileWatcher(router=router)
+
     # host half of the resilience loop: snapshot ring + escalation policy
     # (skip -> rollback + LR dampen -> halt) + per-run anomaly log
     mgr = resilience.ResilienceManager(
@@ -522,6 +570,12 @@ def main():
             # interval-mean step timer as a kind='timer' record; reset=True
             # (the write-parity fix) so each write covers ITS interval only
             timers.write(["step"], step_i, normalizer=steps_since_emit)
+            if comms_led is not None:
+                # periodic comms records: the traced-step totals restamped
+                # at this step, so a jsonl tailer can join comms with
+                # metrics without replaying the startup banner
+                for rec in comms_led.to_records(step=step_i):
+                    router.emit(rec)
             bag = jax.device_put(monitor.reset_bag(bag), replicated)
             steps_since_emit = 0
             last_emit_t = time.perf_counter()
@@ -529,6 +583,12 @@ def main():
         if ar is not None and ar.step(step_i + 1, state):
             print(f"termination checkpoint at step {step_i + 1}; exiting")
             break
+        # compile accounting LAST in the iteration, so every first-use
+        # host-side compile (the interval path is warmed before the
+        # loop; AutoResume's consensus reduce builds lazily on its first
+        # ar.step) lands in the FIRST iteration's bucket — warmup, not a
+        # recompile warning
+        compile_watcher.on_step(step_i)
         step_i += 1
     if mgr.events:
         print(f"anomalies this run: {len(mgr.events)} "

@@ -81,6 +81,39 @@ def test_gpt_pretrain_example(tmp_path):
     assert any(r["kind"] == "summary" for r in records)
 
 
+def test_gpt_pretrain_xray(tmp_path):
+    """The X-ray flags through the real example: startup banners (memory
+    breakdown + predicted comms/step) on stdout, and kind='comms'/
+    'memory'/'compile' records in the SAME jsonl stream as metrics and
+    anomalies — the one-tailer contract."""
+    import json
+
+    jsonl = tmp_path / "metrics.jsonl"
+    out = _run("examples/gpt/pretrain_gpt.py",
+               ["--steps", "3", "--layers", "2", "--hidden", "64",
+                "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
+                "--global-batch", "16", "--log-interval", "2", "--tp", "2",
+                "--metrics-jsonl", str(jsonl),
+                "--xray-report", "--xray-comms"])
+    assert "comms ledger (per step):" in out
+    assert "memory report (per device):" in out
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    by_kind = {}
+    for r in records:
+        by_kind.setdefault(r["kind"], []).append(r)
+    comms = by_kind["comms"]
+    # startup emission at step 0 plus one re-emission per log interval
+    assert {r["axis"] for r in comms} == {"dp", "tp"}
+    assert all(r["bytes"] > 0 for r in comms)
+    assert len(comms) > 2  # periodic re-emission happened
+    (mem,) = by_kind["memory"]
+    assert mem["argument_bytes"] > 0 and mem["temp_bytes"] > 0
+    # warmup compile of the jitted step is accounted, not flagged
+    assert any(r["recompile"] is False for r in by_kind["compile"])
+    assert not any(r["recompile"] for r in by_kind["compile"])
+    assert "metrics" in by_kind
+
+
 def test_gpt_pretrain_resume(tmp_path):
     """Checkpoint-then-resume through the example's AutoResume wiring: the
     second invocation must pick up at the saved step, not step 0 (the

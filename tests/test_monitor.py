@@ -210,7 +210,7 @@ class TestRouter:
         lines = [json.loads(l) for l in open(jsonl)]
         assert [l["kind"] for l in lines] == ["metrics", "skip"]
         assert all({"t", "step", "kind"} <= set(l) for l in lines)
-        assert lines == mem.records
+        assert lines == list(mem.records)  # deque-backed (bounded) sink
         csv_rows = open(csvp).read().splitlines()
         assert csv_rows[0].startswith("t,step,kind")
         out = capsys.readouterr().out
@@ -520,7 +520,7 @@ class TestResilienceRouting:
 
         # the legacy jsonl path still works, byte-for-byte schema
         lines = [json.loads(l) for l in open(log)]
-        assert lines == mem.records == mgr.events
+        assert lines == list(mem.records) == mgr.events
         assert [l["kind"] for l in lines] == ["skip", "halt"]
         assert all({"t", "step", "kind"} <= set(l) for l in lines)
 
@@ -623,3 +623,84 @@ class TestRegisteredTapsLint:
             f"REGISTERED_TAPS entries {sorted(stale)} have no sow site "
             f"left in apex_tpu/ — remove them or restore the tap"
         )
+
+
+#: collectives the xray ledger instruments (monitor/xray/ledger.py)
+LEDGERED_OPS = frozenset({
+    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "pmean", "pmax", "pmin",
+})
+
+#: the only files allowed to call raw jax.lax collectives: the ledger's
+#: own wrappers. Everything else must route through them, or the comms
+#: report silently loses that traffic the next time someone adds an op.
+RAW_COLLECTIVE_ALLOWLIST = frozenset({
+    os.path.join("monitor", "xray", "ledger.py"),
+})
+
+
+class TestRawCollectiveLint:
+    """Tier-1 drift guard (the REGISTERED_TAPS pattern, for comms): no
+    call site in apex_tpu/ may invoke ``lax.{psum,all_gather,...}``
+    directly — every collective goes through the xray ledger wrappers so
+    the comms ledger sees ALL of apex_tpu's traffic. Token-based (via
+    tokenize), so docstrings and comments mentioning ``jax.lax.psum``
+    don't false-positive."""
+
+    def _raw_call_sites(self):
+        import tokenize
+
+        offenders = {}
+        for dirpath, _, files in os.walk(APEX_ROOT):
+            for fn in files:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, APEX_ROOT)
+                if rel in RAW_COLLECTIVE_ALLOWLIST:
+                    continue
+                with open(path, "rb") as f:
+                    toks = [
+                        t for t in tokenize.tokenize(f.readline)
+                        if t.type in (tokenize.NAME, tokenize.OP)
+                    ]
+                for i in range(len(toks) - 2):
+                    if (
+                        toks[i].type == tokenize.NAME
+                        and toks[i].string == "lax"
+                        and toks[i + 1].string == "."
+                        and toks[i + 2].string in LEDGERED_OPS
+                    ):
+                        offenders.setdefault(rel, []).append(
+                            f"line {toks[i].start[0]}: "
+                            f"lax.{toks[i + 2].string}"
+                        )
+        return offenders
+
+    def test_no_raw_collective_bypasses_the_ledger(self):
+        offenders = self._raw_call_sites()
+        assert not offenders, (
+            "raw jax.lax collective call sites bypass the xray comms "
+            "ledger (use apex_tpu.monitor.xray.ledger wrappers, or add "
+            f"the file to RAW_COLLECTIVE_ALLOWLIST with a reason): "
+            f"{offenders}"
+        )
+
+    def test_allowlist_is_not_stale(self):
+        """Every allowlisted file must still exist and still contain a
+        raw collective — otherwise remove it from the allowlist."""
+        import tokenize
+
+        for rel in RAW_COLLECTIVE_ALLOWLIST:
+            path = os.path.join(APEX_ROOT, rel)
+            assert os.path.exists(path), f"allowlisted {rel} is gone"
+            with open(path, "rb") as f:
+                toks = [
+                    t.string for t in tokenize.tokenize(f.readline)
+                    if t.type in (tokenize.NAME, tokenize.OP)
+                ]
+            assert any(
+                toks[i] == "lax" and toks[i + 1] == "."
+                and toks[i + 2] in LEDGERED_OPS
+                for i in range(len(toks) - 2)
+            ), f"allowlisted {rel} no longer calls any raw collective"

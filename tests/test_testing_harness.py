@@ -197,3 +197,43 @@ class TestStandaloneModels:
             "--tensor-model-parallel-size", "2",
         ])
         assert len(losses) == 3 and all(l == l for l in losses)
+
+    @pytest.mark.slow
+    def test_standalone_gpt_xray_flags(self, tmp_path):
+        """--xray-comms / --xray-report: the startup banners print and
+        the kind='comms'/'memory' records join the same jsonl stream as
+        the metrics (one schema, one tailer)."""
+        import json
+
+        from apex_tpu.transformer.testing.standalone_gpt import main
+
+        jsonl = tmp_path / "m.jsonl"
+        lines = []
+        # tiny single-step config keeps this in the fast tier
+        args = [
+            "--num-layers", "1", "--hidden-size", "32",
+            "--num-attention-heads", "2", "--seq-length", "16",
+            "--max-position-embeddings", "16", "--micro-batch-size", "1",
+            "--global-batch-size", "8", "--train-iters", "1",
+            "--tensor-model-parallel-size", "2",
+            "--metrics-jsonl", str(jsonl),
+            "--xray-comms", "--xray-report",
+        ]
+        from apex_tpu.transformer.testing import standalone_gpt
+
+        losses = standalone_gpt.run_gpt(
+            standalone_gpt.parse_args(args=args), log=lines.append
+        )
+        assert len(losses) == 1
+        text = "\n".join(str(l) for l in lines)
+        assert "comms ledger (per step):" in text
+        assert "axis 'tp'" in text
+        assert "memory report (per device):" in text
+        records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+        kinds = {r["kind"] for r in records}
+        assert {"comms", "memory", "metrics"} <= kinds
+        comms = [r for r in records if r["kind"] == "comms"]
+        assert all(r["bytes"] > 0 for r in comms)
+        assert {"tp", "dp"} <= {r["axis"] for r in comms}
+        (mem_rec,) = [r for r in records if r["kind"] == "memory"]
+        assert mem_rec["temp_bytes"] > 0 and mem_rec["argument_bytes"] > 0
