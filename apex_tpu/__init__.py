@@ -29,6 +29,11 @@ Subpackage map (reference parity noted per module):
                               throughput, stall watchdog, on-anomaly profiler
                               capture (no reference equivalent; see
                               docs/observability.md)
+- ``apex_tpu.analysis``     — trace-time static analysis: jaxpr auditors
+                              (precision / donation / collective-safety /
+                              host-sync) + a unified AST lint framework and
+                              the ``python -m apex_tpu.analysis`` gate (no
+                              reference equivalent; see docs/analysis.md)
 """
 
 import logging

@@ -41,6 +41,14 @@ class GradScaler(LossScaler):
     def sync_found_inf(self, found_inf) -> jax.Array:
         f = jnp.asarray(found_inf, jnp.float32)
         for ax in self.model_parallel_axes:
+            # the psum runs even when the axis has size 1: it moves no
+            # bytes (XLA elides size-1 reduces; the xray ledger doesn't
+            # record them) but it DOES establish replication over the
+            # axis, which checked shard_map (check_rep/check_vma=True)
+            # needs to type a P() out_spec — skipping it on degenerate
+            # tp=1/pp=1 meshes breaks out_specs inference (verified).
+            # The analysis collective.dead-traffic warning for this site
+            # is allowlisted with this reason (analysis/allowlist.py).
             if _axis_in_scope(ax):
                 f = xlax.psum(f, ax)
         return f > 0

@@ -1,0 +1,88 @@
+"""Trace-time static analysis: jaxpr auditors + AST lint, one framework.
+
+The pre-flight validation layer (the TorchTitan-style "fail before the
+first step" discipline, PAPERS.md): apex's correctness-by-construction
+claims — mixed precision with chosen f32 islands, donation-friendly
+state threading, hand-wired collectives — checked STATICALLY, at trace
+time, on CPU, without executing a step. Four jaxpr passes over any step
+function plus a unified source-lint framework, all reporting structured
+:class:`Finding` records through a reason-carrying allowlist and the
+shared telemetry schema (``kind="analysis"`` via monitor.MetricRouter):
+
+- ``precision``   — unintended low->f32/f64 promotions (precision.py)
+- ``donation``    — donate_argnums vs XLA's realized input/output
+  aliasing, missed large donations (donation.py)
+- ``collective``  — mesh-axis existence, ppermute permutation validity,
+  pipeline-edge pairing (static deadlock), size-1 dead traffic
+  (collectives.py)
+- ``host-sync``   — callbacks / device->host transfers inside the
+  compiled step (host_sync.py)
+- ``lint``        — raw-collective + registered-taps (migrated from the
+  tier-1 tests) + jit-donate + float64 source rules (lint.py)
+
+CLI: ``python -m apex_tpu.analysis`` runs the AST rules over the tree
+and the jaxpr passes over the in-repo GPT/BERT step builders on a CPU
+dp2xtp2 mesh, exiting non-zero on unallowlisted findings. See
+docs/analysis.md for the pass catalog and how to add a rule.
+
+Attribute access is lazy (PEP 562): importing this package must not
+initialize jax, so the CLI can force the 8-device CPU topology first.
+"""
+
+_EXPORTS = {
+    # findings / allowlist (jax-free)
+    "Finding": "findings",
+    "AllowlistEntry": "findings",
+    "Allowlist": "findings",
+    "AnalysisResult": "findings",
+    "SEV_ERROR": "findings",
+    "SEV_WARNING": "findings",
+    "SEV_INFO": "findings",
+    "merge_findings": "findings",
+    # jaxpr-pass framework
+    "JAXPR_PASSES": "passes",
+    "jaxpr_pass": "passes",
+    "StepTarget": "passes",
+    "StepContext": "passes",
+    "iter_eqns": "passes",
+    "eqn_site": "passes",
+    "run_passes": "passes",
+    # individual auditors
+    "audit_donation": "donation",
+    # lint framework (jax-free)
+    "LINT_RULES": "lint",
+    "lint_rule": "lint",
+    "LintContext": "lint",
+    "run_lint": "lint",
+    "collect_sources": "lint",
+    "LEDGERED_OPS": "lint",
+    # repo allowlist + CLI targets
+    "REPO_ALLOWLIST": "allowlist",
+    "repo_allowlist": "allowlist",
+    "dp2tp2_mesh": "targets",
+    "gpt_step_target": "targets",
+    "bert_step_target": "targets",
+    "all_targets": "targets",
+}
+
+__all__ = sorted(_EXPORTS) + [
+    "findings", "passes", "precision", "donation", "collectives",
+    "host_sync", "lint", "allowlist", "targets",
+]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"apex_tpu.analysis.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apex_tpu.analysis.{name}")
+    raise AttributeError(f"module 'apex_tpu.analysis' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,101 @@
+"""``python -m apex_tpu.analysis`` — the repo's static-analysis gate.
+
+Runs the AST lint rules over apex_tpu/ + examples/ and the four jaxpr
+passes (precision / donation / collective-safety / host-sync) over the
+in-repo GPT and BERT step builders on a CPU dp2xtp2 mesh, then applies
+the documented allowlist (analysis/allowlist.py). Exit status:
+
+- 0 — clean: every finding suppressed by a reason-carrying entry and no
+  entry gone stale;
+- 1 — unallowlisted findings (or stale allowlist entries) — the report
+  lists each with rule, site, and message.
+
+No step executes: precision/collective/host-sync work on abstract
+traces; only the donation auditor pays a compile (seconds for the tiny
+targets). The tier-1 self-check (tests/test_analysis.py) runs this exact
+entry point and asserts exit 0, so a PR introducing a silent promotion,
+a broken donation, or a stray ``debug.print`` in a step fails fast.
+
+Flags: ``--verbose`` also prints suppressed findings with their reasons;
+``--json PATH`` appends every finding as a ``kind="analysis"`` record to
+a jsonl (the shared MetricRouter schema); ``--skip-jaxpr`` /
+``--skip-lint`` run half the gate; ``--target gpt|bert`` restricts the
+jaxpr half.
+"""
+
+import argparse
+import os
+import sys
+
+
+def _ensure_cpu_mesh_env():
+    """Force the 8-virtual-device CPU topology BEFORE jax initializes its
+    backends (the tests/conftest.py pattern): the audit mesh is dp2xtp2
+    and must exist on any box, TPU attached or not."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.analysis",
+        description="static analysis: jaxpr auditors + AST lint",
+    )
+    parser.add_argument("--verbose", "-v", action="store_true",
+                        help="also print allowlisted findings with reasons")
+    parser.add_argument("--json", default=None,
+                        help="append kind='analysis' records to this jsonl")
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="skip the AST lint rules")
+    parser.add_argument("--skip-jaxpr", action="store_true",
+                        help="skip the jaxpr passes over the step targets")
+    parser.add_argument("--target", choices=("gpt", "bert"), default=None,
+                        help="audit only one step builder")
+    args = parser.parse_args(argv)
+
+    _ensure_cpu_mesh_env()
+
+    from apex_tpu.analysis import allowlist as allowlist_mod
+    from apex_tpu.analysis import lint as lint_mod
+
+    findings = []
+    if not args.skip_lint:
+        findings.extend(lint_mod.run_lint())
+    if not args.skip_jaxpr:
+        from apex_tpu.analysis import passes as passes_mod
+        from apex_tpu.analysis import targets as targets_mod
+
+        mesh = targets_mod.dp2tp2_mesh()
+        builders = {
+            "gpt": targets_mod.gpt_step_target,
+            "bert": targets_mod.bert_step_target,
+        }
+        names = [args.target] if args.target else list(builders)
+        for name in names:
+            target = builders[name](mesh)
+            print(f"auditing step target {target.name!r} "
+                  f"(mesh {dict(mesh.shape)})", flush=True)
+            findings.extend(passes_mod.run_passes(target))
+
+    # stale-entry detection needs the full lint scan (a require_hit entry
+    # trivially suppresses nothing when its rule never ran)
+    result = allowlist_mod.repo_allowlist().apply(
+        findings, check_stale=not args.skip_lint
+    )
+    print(result.format(verbose=args.verbose), flush=True)
+    if args.json:
+        from apex_tpu.monitor.router import JsonlSink
+
+        sink = JsonlSink(args.json)
+        for rec in result.to_records():
+            sink.emit(rec)
+        sink.close()
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

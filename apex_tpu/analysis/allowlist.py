@@ -1,0 +1,251 @@
+"""The repo's documented allowlist: every intentional flagged construct.
+
+This file is the single home of "yes, we mean it" for the static
+auditors. EVERY entry carries its numerical/engineering reason — the
+:class:`~apex_tpu.analysis.findings.AllowlistEntry` constructor rejects
+bare entries — and lint-scope entries (``require_hit=True``) go stale
+loudly when the construct they document disappears.
+
+Organization: precision entries first (why each wide-dtype island in a
+bf16 step is intentional), then donation, then the source-lint entries.
+When the precision auditor flags a NEW site, the choice is binary: fix
+the promotion, or add an entry HERE with the reason a reviewer can
+check. See docs/analysis.md.
+"""
+
+from apex_tpu.analysis.findings import Allowlist, AllowlistEntry
+
+__all__ = ["REPO_ALLOWLIST", "repo_allowlist"]
+
+_PRECISION = [
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/ops/layer_norm.py",
+        reason=(
+            "norm statistics in f32: mean/variance of bf16 activations "
+            "(~1e-3 squared terms) lose all significance in an 8-bit "
+            "mantissa; the kernel reduces in f32 and casts back (the "
+            "reference's AffineMixedDtypes contract)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/transformer/layer.py",
+        reason=(
+            "norm affine params cast to f32 for the f32 norm kernels, "
+            "and their grad transposes back into low-precision masters "
+            "when params_dtype is bf16 — the activation upcast that used "
+            "to live in _activate was a REAL finding and was fixed, not "
+            "allowlisted"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/ops/attention.py",
+        reason=(
+            "softmax statistics in f32: bf16 exp/sum over long rows "
+            "overflows and loses the max-subtraction guard; scores and "
+            "probabilities are f32, the context matmul returns to bf16 "
+            "(flash-attention's accumulator contract)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/ops/softmax.py",
+        reason=(
+            "same softmax-statistics-in-f32 contract as ops/attention.py "
+            "for the standalone fused softmax"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/parallel/layers.py",
+        reason=(
+            "master-weight casts: kernels/biases/embeddings are stored "
+            "f32 (params_dtype) and cast to the compute dtype per use; "
+            "the flagged bf16->f32 converts are the TRANSPOSES of those "
+            "casts — gradients accumulating back into f32 masters, the "
+            "whole point of O2 mixed precision"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/models/gpt.py",
+        reason=(
+            "embedding-output cast to compute dtype: its transpose "
+            "accumulates embedding gradients in f32 — same master-weight "
+            "contract as parallel/layers.py"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/models/bert.py",
+        reason=(
+            "BERT head/pooler params are f32 masters cast to compute "
+            "dtype; flagged converts are the f32 gradient transposes"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/parallel/cross_entropy.py",
+        reason=(
+            "vocab-parallel CE computes logits stats (max, sum-exp, "
+            "target logit) in f32: bf16 logsumexp over a 32k-vocab row "
+            "is catastrophically lossy and the psum'ed partials must "
+            "not saturate"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/parallel/ddp.py",
+        reason=(
+            "gradient allreduce in f32: summing N bf16 gradient replicas "
+            "in bf16 loses low-order contributions exactly when N is "
+            "large; the psum runs on f32 and casts back"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/parallel/ring_attention.py",
+        reason=(
+            "ring/blockwise attention carries f32 running max/sum/output "
+            "accumulators across ring steps (the online-softmax "
+            "recurrence is unstable in bf16)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/parallel/sync_batch_norm.py",
+        reason=(
+            "cross-replica batch-norm statistics in f32 (variance via "
+            "E[x^2]-E[x]^2 cancels catastrophically in bf16)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/transformer/moe.py",
+        reason=(
+            "router math in f32: expert logits/softmax/aux-loss need "
+            "exact tie-breaking and the load-balancing loss is a mean of "
+            "tiny products; dispatched expert outputs re-enter bf16"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/transformer/utils.py",
+        reason=(
+            "grad-norm / param-norm sums of squares in f32 (sum of many "
+            "small squares underflows bf16), and average_losses stacks "
+            "scalars in f32"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/optimizers/",
+        reason=(
+            "master-weight f32 accumulations: fused/distributed "
+            "optimizers keep moments and master params in f32 and "
+            "unscale bf16/f16 grads into f32 before the update (O2 "
+            "semantics; ref apex FusedAdam master path)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/amp/",
+        reason=(
+            "the amp machinery's own unscale/master casts: grads are "
+            "promoted to f32 exactly once at the optimizer boundary "
+            "(grad_scaler.unscale, cast_engine master params)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/ops/xentropy.py",
+        reason=(
+            "fused cross-entropy logsumexp statistics in f32 (same "
+            "contract as parallel/cross_entropy.py)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/resilience/sentinel.py",
+        reason=(
+            "anomaly-sentinel EMA/variance state is f32 by construction; "
+            "a bf16 loss entering the z-score math is promoted once per "
+            "step (a scalar)"
+        ),
+    ),
+    AllowlistEntry(
+        rule="precision.promotion",
+        match="apex_tpu/monitor/metrics.py",
+        reason=(
+            "MetricBag folds scalars in f32 (interval means of bf16 "
+            "losses would quantize visibly); one scalar per metric per "
+            "step"
+        ),
+    ),
+]
+
+_COLLECTIVE = [
+    AllowlistEntry(
+        rule="collective.dead-traffic",
+        match="apex_tpu/amp/grad_scaler.py",
+        reason=(
+            "found_inf psum over a possibly-size-1 model-parallel axis "
+            "is replication-ESTABLISHING, not traffic: XLA elides the "
+            "size-1 reduce (zero bytes) but checked shard_map "
+            "(check_rep/check_vma=True) relies on the psum to type the "
+            "result replicated — gating it on axis size breaks "
+            "out_specs inference on degenerate tp=1/pp=1 meshes "
+            "(verified by repro)"
+        ),
+    ),
+]
+
+_LINT = [
+    AllowlistEntry(
+        rule="lint.raw-collective",
+        match="apex_tpu/monitor/xray/ledger.py",
+        reason=(
+            "the ledger's wrappers ARE the instrumented call sites — the "
+            "one place raw lax collectives are allowed to live"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
+        rule="lint.jit-donate",
+        match="examples/gpt/pretrain_gpt.py",
+        reason=(
+            "audited entrypoint: the GPT train_step's donation is "
+            "verified by the donation auditor (--audit-donation and the "
+            "example test)"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
+        rule="lint.jit-donate",
+        match="examples/llama/finetune_llama.py",
+        reason=(
+            "audited entrypoint: the llama train step's params+opt-state "
+            "donation is verified by the donation auditor "
+            "(--audit-donation and the example test)"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
+        rule="lint.jit-donate",
+        match="apex_tpu/analysis/donation.py",
+        reason=(
+            "the donation auditor itself constructs the donating jit in "
+            "order to introspect XLA's realized aliasing"
+        ),
+        require_hit=True,
+    ),
+]
+
+REPO_ALLOWLIST = Allowlist(_PRECISION + _COLLECTIVE + _LINT)
+
+
+def repo_allowlist() -> Allowlist:
+    """A fresh copy of the repo allowlist (callers may extend)."""
+    return Allowlist(list(REPO_ALLOWLIST.entries))

@@ -1,0 +1,173 @@
+"""Collective-safety validator: axes, permutations, pipeline edges.
+
+Every collective apex_tpu issues goes through the xray ledger wrappers
+(tier-1 lint), so the traced step's collective equations ARE the
+library's communication program. This pass checks that program against
+the ambient mesh and against the pipeline edge grammar
+(``parallel/pipeline/p2p.py``), statically:
+
+- ``collective.unknown-axis`` — the collective names a mesh axis the
+  ambient mesh does not carry. Inside one ``shard_map`` this is caught
+  at trace time by jax itself; across refactors (a step traced under
+  yesterday's mesh, run under today's) the jaxpr is the only place the
+  mismatch is visible before devices are involved.
+- ``collective.dead-traffic`` — a collective over a size-1 mesh axis.
+  XLA elides it, so it is not a correctness bug, but it IS a sign the
+  call site should be gated (the reduce is dead code that re-appears as
+  real traffic the day the axis grows) — warning severity.
+- ``collective.non-permutation`` — a ``ppermute`` whose edge list is not
+  a partial permutation: duplicate sources, duplicate destinations,
+  self-edges, or out-of-range ranks. jax does not validate this at trace
+  time (verified: a duplicate-source perm traces fine) and XLA's
+  behavior on it is undefined-to-hostile.
+- ``collective.mismatched-edge`` — the static deadlock check for
+  pipeline schedules. A linear chain shift (the p2p
+  ``forward_edges``/``backward_edges`` grammar) with a missing interior
+  link means some stage's input edge never fires while downstream
+  stages still expect the stream: microbatches silently stop flowing at
+  the gap (the SPMD analogue of a hung send/recv pair). Full rings and
+  the single last->first wrap edge are valid by construction; edge sets
+  that are not chain-shaped at all get only the permutation check.
+"""
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.findings import Finding, SEV_ERROR, SEV_WARNING
+from apex_tpu.analysis.passes import eqn_site, jaxpr_pass
+
+__all__ = ["collective_pass", "check_perm", "chain_gaps"]
+
+#: jaxpr primitives that move bytes over a named mesh axis, with the
+#: params key holding the axis name(s). pmean lowers to psum+div and
+#: pmin to pmax of the negation, so the traced set is smaller than the
+#: API set.
+_COLLECTIVE_AXIS_KEYS = {
+    "psum": "axes",
+    "pmax": "axes",
+    "pmin": "axes",
+    "all_gather": "axis_name",
+    "reduce_scatter": "axis_name",
+    "all_to_all": "axis_name",
+    "ppermute": "axis_name",
+}
+
+
+def _axes_of(eqn) -> Tuple:
+    key = _COLLECTIVE_AXIS_KEYS[eqn.primitive.name]
+    axes = eqn.params.get(key, ())
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    # positional (vmap) axes appear as ints; only named mesh axes are
+    # auditable against a mesh
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def check_perm(
+    perm: Sequence[Tuple[int, int]], axis_size: Optional[int]
+) -> List[str]:
+    """Problems making ``perm`` not a partial permutation (empty = ok)."""
+    problems = []
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    dup_src = sorted({s for s in srcs if srcs.count(s) > 1})
+    dup_dst = sorted({d for d in dsts if dsts.count(d) > 1})
+    if dup_src:
+        problems.append(f"duplicate source rank(s) {dup_src}")
+    if dup_dst:
+        problems.append(f"duplicate destination rank(s) {dup_dst}")
+    selfed = sorted({s for s, d in perm if s == d})
+    if selfed:
+        problems.append(f"self-edge(s) at rank(s) {selfed}")
+    if axis_size is not None:
+        oob = sorted({r for r in srcs + dsts if not 0 <= r < axis_size})
+        if oob:
+            problems.append(
+                f"rank(s) {oob} outside the axis (size {axis_size})"
+            )
+    return problems
+
+
+def chain_gaps(
+    perm: Sequence[Tuple[int, int]], axis_size: int
+) -> Optional[List[int]]:
+    """Interior gaps of a linear pipeline chain, or None when ``perm`` is
+    not a linear chain (ring, wrap edge, arbitrary shuffle — no chain
+    semantics to check).
+
+    A linear chain is a uniform +-1 shift with NO wrap edge: the
+    ``p2p.forward_edges``/``backward_edges`` grammar. A gap is a stage
+    strictly inside the chain's span whose outgoing edge is missing —
+    everything past it waits on data that never crosses the gap.
+    """
+    if not perm or axis_size < 3:
+        return None
+    for sig in (1, -1):
+        if all(d - s == sig for s, d in perm):
+            srcs = sorted(s for s, _ in perm)
+            return [
+                r for r in range(srcs[0] + 1, srcs[-1])
+                if r not in set(srcs)
+            ]
+    return None
+
+
+@jaxpr_pass("collective")
+def collective_pass(ctx) -> Iterable[Finding]:
+    mesh = ctx.mesh
+    axis_names = tuple(mesh.axis_names) if mesh is not None else None
+    for eqn in ctx.iter_eqns():
+        name = eqn.primitive.name
+        if name not in _COLLECTIVE_AXIS_KEYS:
+            continue
+        site = eqn_site(eqn)
+        axes = _axes_of(eqn)
+        axis_size = None
+        for ax in axes:
+            if axis_names is not None and ax not in axis_names:
+                yield ctx.finding(
+                    "collective.unknown-axis",
+                    f"'{name}' over axis {ax!r} which the ambient mesh "
+                    f"{axis_names} does not carry",
+                    site=site, severity=SEV_ERROR,
+                    data={"op": name, "axis": ax},
+                )
+                continue
+            if mesh is not None:
+                size = int(mesh.shape[ax])
+                axis_size = size if len(axes) == 1 else axis_size
+                if size == 1:
+                    yield ctx.finding(
+                        "collective.dead-traffic",
+                        f"'{name}' over size-1 axis {ax!r} is dead traffic "
+                        f"— XLA elides it today; gate the call site so it "
+                        f"does not become real bytes when the axis grows",
+                        site=site, severity=SEV_WARNING,
+                        data={"op": name, "axis": ax},
+                    )
+        if name != "ppermute":
+            continue
+        perm = tuple(tuple(e) for e in eqn.params.get("perm", ()))
+        ax = axes[0] if axes else "?"
+        problems = check_perm(perm, axis_size)
+        if problems:
+            yield ctx.finding(
+                "collective.non-permutation",
+                f"ppermute over axis {ax!r} with invalid edges "
+                f"{list(perm)}: " + "; ".join(problems),
+                site=site, severity=SEV_ERROR,
+                data={"axis": ax, "perm": str(list(perm))},
+            )
+            continue
+        if axis_size is not None:
+            gaps = chain_gaps(perm, axis_size)
+            if gaps:
+                yield ctx.finding(
+                    "collective.mismatched-edge",
+                    f"pipeline chain over axis {ax!r} has no edge out of "
+                    f"stage(s) {gaps}: downstream stages' recv edges fire "
+                    f"but the stream never crosses the gap (static "
+                    f"deadlock) — edges {list(perm)}",
+                    site=site, severity=SEV_ERROR,
+                    data={"axis": ax, "gaps": str(gaps),
+                          "perm": str(list(perm))},
+                )

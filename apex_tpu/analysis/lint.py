@@ -1,0 +1,301 @@
+"""Unified AST/token lint framework + the repo's source-level rules.
+
+The source-scan half of ``apex_tpu.analysis``: rules that read the tree
+instead of a trace. Same registry shape as the jaxpr passes and the
+same :class:`~apex_tpu.analysis.findings.Finding`/allowlist machinery,
+so the CLI and tests drive both identically:
+
+    @lint_rule("lint.raw-collective", scopes=("apex_tpu/",))
+    def raw_collective(ctx): yield Finding(...)
+
+Rules see a :class:`LintContext` holding every scanned file (repo-
+relative path -> source) and filter to their scope; cross-file rules
+(registered-taps) see the whole set at once. Tests inject synthetic
+``files`` to seed violations without touching disk.
+
+The two tier-1 lints that predate this framework migrated here from
+tests/test_monitor.py (which keeps thin wrappers so the test names and
+their history stay legible):
+
+- ``lint.raw-collective``  — no call site in apex_tpu/ may invoke
+  ``lax.{psum,all_gather,...}`` directly; everything routes through the
+  xray ledger wrappers or the comms report silently loses traffic.
+  Token-based so docstrings mentioning ``jax.lax.psum`` don't trip it.
+- ``lint.registered-taps`` — every ``sow("intermediates", <name>, ...)``
+  must be registered in monitor/taps.py, and every registry row must
+  still have a live sow site.
+
+Plus the new rules this framework exists to host:
+
+- ``lint.jit-donate`` — no raw ``jax.jit(donate_argnums=...)`` outside
+  the audited entrypoints. Donation bugs are silent (see donation.py);
+  keeping every donating jit on the audited list is what makes the
+  donation auditor's coverage claim true.
+- ``lint.float64``    — no ``jnp.float64`` in library code: TPUs emulate
+  f64 at a fraction of rate, and a single f64 literal poisons every
+  dtype downstream of it. (Host-side ``np.float64`` index math is fine
+  and not flagged.)
+"""
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.findings import Finding, SEV_ERROR
+
+__all__ = [
+    "LINT_RULES",
+    "lint_rule",
+    "LintContext",
+    "run_lint",
+    "collect_sources",
+    "LEDGERED_OPS",
+    "SOW_RE",
+]
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: directories scanned by default, relative to the repo root
+DEFAULT_SCAN_DIRS = ("apex_tpu", "examples")
+
+#: registered rules: name -> (fn, scopes)
+LINT_RULES: Dict[str, Tuple[Callable, Tuple[str, ...]]] = {}
+
+#: collectives the xray ledger instruments (monitor/xray/ledger.py) — the
+#: ops the raw-collective rule polices
+LEDGERED_OPS = frozenset({
+    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "pmean", "pmax", "pmin",
+})
+
+SOW_RE = re.compile(
+    r"""\.sow\(\s*['"]intermediates['"]\s*,\s*['"](?P<name>\w+)['"]"""
+)
+
+
+def lint_rule(name: str, scopes: Tuple[str, ...] = ("apex_tpu/",)):
+    """Register a rule (decorator). ``scopes`` are path prefixes the rule
+    applies to — the single source of truth: ``run_lint`` hands the rule
+    a context containing ONLY files under them, so rule bodies iterate
+    ``ctx.files`` without re-filtering."""
+
+    def register(fn):
+        LINT_RULES[name] = (fn, scopes)
+        return fn
+
+    return register
+
+
+class LintContext:
+    """The scanned file set a rule reads."""
+
+    def __init__(self, files: Dict[str, str]):
+        #: repo-relative posix path -> source text
+        self.files = files
+
+    def files_in(self, *prefixes: str) -> Iterator[Tuple[str, str]]:
+        for rel in sorted(self.files):
+            if any(rel.startswith(p) for p in prefixes):
+                yield rel, self.files[rel]
+
+    @staticmethod
+    def tokens(source: str):
+        """NAME/OP tokens of ``source`` (the docstring-safe scan basis)."""
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        return [t for t in toks if t.type in (tokenize.NAME, tokenize.OP)]
+
+
+def collect_sources(
+    root: Optional[str] = None,
+    scan_dirs: Sequence[str] = DEFAULT_SCAN_DIRS,
+) -> Dict[str, str]:
+    """All ``.py`` sources under ``root``'s scan dirs, as repo-relative
+    posix paths."""
+    root = root or _REPO_ROOT
+    files: Dict[str, str] = {}
+    for sub in scan_dirs:
+        base = os.path.join(root, sub)
+        for dirpath, _, names in os.walk(base):
+            for fn in names:
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                with open(path, encoding="utf-8") as f:
+                    files[rel] = f.read()
+    return files
+
+
+def run_lint(
+    root: Optional[str] = None,
+    rules: Optional[Sequence[str]] = None,
+    files: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Run ``rules`` (default all) over ``files`` (default: scan the repo)
+    and return raw findings — apply an Allowlist afterwards, exactly like
+    the jaxpr passes."""
+    names = list(rules) if rules is not None else sorted(LINT_RULES)
+    unknown = [n for n in names if n not in LINT_RULES]
+    if unknown:
+        raise KeyError(
+            f"unknown lint rule(s) {unknown}; registered: "
+            f"{sorted(LINT_RULES)}"
+        )
+    all_files = files if files is not None else collect_sources(root)
+    findings: List[Finding] = []
+    for name in names:
+        fn, scopes = LINT_RULES[name]
+        # the registry's scopes are the single source of truth: each rule
+        # sees ONLY its scoped slice of the tree (rules don't re-filter)
+        ctx = LintContext({
+            rel: src for rel, src in all_files.items()
+            if any(rel.startswith(p) for p in scopes)
+        })
+        findings.extend(fn(ctx))
+    return findings
+
+
+# -- rules -------------------------------------------------------------------
+
+
+@lint_rule("lint.raw-collective", scopes=("apex_tpu/",))
+def raw_collective(ctx: LintContext) -> Iterable[Finding]:
+    """``lax.<collective>`` call sites that bypass the xray ledger."""
+    for rel, src in sorted(ctx.files.items()):
+        toks = ctx.tokens(src)
+        for i in range(len(toks) - 2):
+            if (
+                toks[i].type == tokenize.NAME
+                and toks[i].string == "lax"
+                and toks[i + 1].string == "."
+                and toks[i + 2].string in LEDGERED_OPS
+            ):
+                yield Finding(
+                    rule="lint.raw-collective",
+                    message=(
+                        f"raw lax.{toks[i + 2].string} bypasses the xray "
+                        f"comms ledger — use the "
+                        f"apex_tpu.monitor.xray.ledger wrapper (or "
+                        f"allowlist with a reason)"
+                    ),
+                    site=f"{rel}:{toks[i].start[0]}",
+                    severity=SEV_ERROR,
+                    data={"op": toks[i + 2].string},
+                )
+
+
+@lint_rule("lint.registered-taps", scopes=("apex_tpu/",))
+def registered_taps(ctx: LintContext) -> Iterable[Finding]:
+    """sow("intermediates", ...) names vs monitor.REGISTERED_TAPS, both
+    directions (unregistered tap / stale registry row)."""
+    from apex_tpu.monitor import REGISTERED_TAPS
+
+    sown: Dict[str, str] = {}
+    for rel, src in sorted(ctx.files.items()):
+        for m in SOW_RE.finditer(src):
+            line = src.count("\n", 0, m.start()) + 1
+            sown.setdefault(m.group("name"), f"{rel}:{line}")
+    for name in sorted(set(sown) - set(REGISTERED_TAPS)):
+        yield Finding(
+            rule="lint.registered-taps",
+            message=(
+                f"sow tap {name!r} is not registered in monitor/taps.py "
+                f"REGISTERED_TAPS — a layer refactor could silently drop "
+                f"the metric"
+            ),
+            site=sown[name], severity=SEV_ERROR, data={"tap": name},
+        )
+    for name in sorted(set(REGISTERED_TAPS) - set(sown)):
+        yield Finding(
+            rule="lint.registered-taps",
+            message=(
+                f"REGISTERED_TAPS entry {name!r} has no sow site left in "
+                f"apex_tpu/ — remove it or restore the tap"
+            ),
+            site="apex_tpu/monitor/taps.py:1", severity=SEV_ERROR,
+            data={"tap": name, "stale": True},
+        )
+
+
+@lint_rule("lint.jit-donate", scopes=("apex_tpu/", "examples/"))
+def jit_donate(ctx: LintContext) -> Iterable[Finding]:
+    """Any call passing donate_argnums/donate_argnames outside the audited
+    entrypoints (allowlist). AST-based: keyword position is what matters,
+    whether spelled ``jax.jit(...)`` or ``functools.partial(jax.jit,
+    ...)``."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.jit-donate",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # only jit-shaped calls: jax.jit(...)/pjit(...) directly or
+            # through functools.partial(jax.jit, ...) — plain data calls
+            # carrying a donate_argnums field (StepTarget, audit_donation)
+            # DECLARE donation for auditing rather than performing it
+            jit_call = "jit" in ast.unparse(node.func) or any(
+                "jit" in ast.unparse(a) for a in node.args
+            )
+            if not jit_call:
+                continue
+            for kw in node.keywords:
+                if kw.arg in ("donate_argnums", "donate_argnames"):
+                    yield Finding(
+                        rule="lint.jit-donate",
+                        message=(
+                            f"{kw.arg} on a jit outside the audited "
+                            f"entrypoints — donation failures are silent "
+                            f"(donation.py); add the step to the audited "
+                            f"list (and allowlist it here with that "
+                            f"reason) or drop the donation"
+                        ),
+                        site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                        data={"keyword": kw.arg},
+                    )
+
+
+@lint_rule("lint.float64", scopes=("apex_tpu/",))
+def float64_literals(ctx: LintContext) -> Iterable[Finding]:
+    """``jnp.float64`` (and ``jax.numpy.float64``) in library code.
+
+    Only the jax spellings: a bare ``numpy.float64`` is host-side index
+    math and exempt, exactly as the module docstring promises — so
+    ``numpy`` only matches when preceded by ``jax.``."""
+    for rel, src in sorted(ctx.files.items()):
+        toks = ctx.tokens(src)
+        for i in range(len(toks) - 2):
+            if (
+                toks[i].type == tokenize.NAME
+                and toks[i + 1].string == "."
+                and toks[i + 2].string == "float64"
+                and (
+                    toks[i].string == "jnp"
+                    or (
+                        toks[i].string == "numpy"
+                        and i >= 2
+                        and toks[i - 2].string == "jax"
+                        and toks[i - 1].string == "."
+                    )
+                )
+            ):
+                yield Finding(
+                    rule="lint.float64",
+                    message=(
+                        "jnp.float64 in library code: TPUs emulate f64 at "
+                        "a fraction of native rate and one f64 value "
+                        "poisons every dtype downstream"
+                    ),
+                    site=f"{rel}:{toks[i].start[0]}", severity=SEV_ERROR,
+                )

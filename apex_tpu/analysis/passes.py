@@ -1,0 +1,247 @@
+"""Jaxpr-pass framework: trace a step function, walk it, audit it.
+
+The trace-time half of ``apex_tpu.analysis``. A *pass* receives a
+:class:`StepContext` — the closed jaxpr of a step function obtained via
+``jax.make_jaxpr`` (abstract tracing: CPU-safe, no execution, args may be
+``ShapeDtypeStruct``) plus the ambient mesh and donation intent — and
+yields :class:`~apex_tpu.analysis.findings.Finding` records. Passes
+register into :data:`JAXPR_PASSES` with :func:`jaxpr_pass`, the same
+shape as the AST rule registry in ``lint.py``:
+
+    @jaxpr_pass("precision")
+    def precision_pass(ctx):
+        for eqn in ctx.iter_eqns():
+            ...
+            yield Finding(rule="precision.promotion", ...)
+
+Walking covers the WHOLE program: :func:`iter_eqns` recurses into every
+sub-jaxpr an equation carries (pjit/shard_map bodies, scan/while bodies,
+cond branches, custom_vjp fwd/bwd, remat) — a promotion inside a
+rematerialized scan body two levels down is still found. Sites resolve
+through the equation's source-info traceback to the first frame that is
+neither jax-internal nor one of our thin wrapper modules (the xray
+ledger, pipeline p2p), so a flagged collective points at the schedule
+that issued it, not at the wrapper that recorded it.
+
+Run everything over a :class:`StepTarget` with :func:`run_passes`; the
+CLI (``python -m apex_tpu.analysis``) does exactly that for the in-repo
+GPT/BERT step builders (``targets.py``).
+"""
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.analysis.findings import Allowlist, Finding, merge_findings
+
+__all__ = [
+    "JAXPR_PASSES",
+    "jaxpr_pass",
+    "StepContext",
+    "StepTarget",
+    "iter_eqns",
+    "eqn_site",
+    "run_passes",
+]
+
+#: registered jaxpr passes, name -> pass fn(StepContext) -> Iterable[Finding]
+JAXPR_PASSES: Dict[str, Callable] = {}
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: wrapper modules whose frames are NOT the interesting call site: the
+#: instrumented collective wrappers and the p2p edge helpers — findings
+#: should name the schedule/layer that called them
+_WRAPPER_FRAGMENTS = (
+    os.path.join("monitor", "xray", "ledger.py"),
+    os.path.join("parallel", "pipeline", "p2p.py"),
+)
+
+
+def jaxpr_pass(name: str):
+    """Register a pass under ``name`` (decorator)."""
+
+    def register(fn):
+        JAXPR_PASSES[name] = fn
+        return fn
+
+    return register
+
+
+def _relsite(path: str, line: int) -> str:
+    """Normalize an absolute source path to a repo-relative site string."""
+    path = path.replace(os.sep, "/")
+    for anchor in ("/apex_tpu/", "/examples/", "/tests/", "/benchmarks/"):
+        idx = path.rfind(anchor)
+        if idx >= 0:
+            return f"{path[idx + 1:]}:{line}"
+    root = _REPO_ROOT.replace(os.sep, "/")
+    if path.startswith(root + "/"):
+        return f"{path[len(root) + 1:]}:{line}"
+    return f"{path}:{line}"
+
+
+def eqn_site(eqn, skip_wrappers: bool = True) -> str:
+    """Repo-relative ``file.py:line`` of the user code that produced an
+    equation, or ``"<unknown>"`` when source info is unavailable.
+
+    Note one honest quirk: equations synthesized by transposition
+    (backward-pass converts, reversed scan edges) inherit the FORWARD
+    equation's source info, so a backward promotion points at the forward
+    cast it transposes — the right line to look at anyway.
+    """
+    try:
+        from jax._src import source_info_util
+
+
+        frames = list(source_info_util.user_frames(eqn.source_info))
+    except Exception:
+        return "<unknown>"
+    chosen = None
+    for fr in frames:
+        chosen = fr
+        if skip_wrappers and any(
+            frag in fr.file_name for frag in _WRAPPER_FRAGMENTS
+        ):
+            continue
+        break
+    if chosen is None:
+        return "<unknown>"
+    return _relsite(chosen.file_name, chosen.start_line)
+
+
+def _subjaxprs(eqn) -> Iterator[Any]:
+    """Every jaxpr nested in an equation's params (pjit/scan/cond/shard_map
+    bodies, custom_vjp rules, remat) — duck-typed on ``.eqns``."""
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            j = getattr(v, "jaxpr", v)  # ClosedJaxpr -> Jaxpr
+            if hasattr(j, "eqns"):
+                yield j
+
+
+def iter_eqns(jaxpr) -> Iterator[Any]:
+    """Depth-first over every equation of ``jaxpr`` (Jaxpr or ClosedJaxpr)
+    including all nested sub-jaxprs."""
+    j = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in j.eqns:
+        yield eqn
+        for sub in _subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+@dataclasses.dataclass
+class StepTarget:
+    """A step function prepared for auditing: what the CLI and tests hand
+    to :func:`run_passes`.
+
+    ``args`` may be concrete arrays or ``ShapeDtypeStruct``s; nothing is
+    executed. ``donate_argnums`` is the donation INTENT the donation
+    auditor verifies against XLA's realized aliasing (None disables that
+    pass for the target — e.g. an inference step with nothing to donate).
+    """
+
+    name: str
+    fn: Callable
+    args: Tuple = ()
+    mesh: Optional[jax.sharding.Mesh] = None
+    donate_argnums: Optional[Tuple[int, ...]] = None
+    #: dtypes considered "low precision" for the precision auditor; a
+    #: promotion OUT of these to f32/f64 is flagged
+    low_dtypes: Tuple = (jnp.bfloat16, jnp.float16)
+
+
+class StepContext:
+    """What a pass sees: the target plus its lazily-traced jaxpr."""
+
+    def __init__(self, target: StepTarget):
+        self.target = target
+        self._jaxpr = None
+
+    @property
+    def name(self) -> str:
+        return self.target.name
+
+    @property
+    def fn(self):
+        return self.target.fn
+
+    @property
+    def args(self):
+        return self.target.args
+
+    @property
+    def mesh(self):
+        return self.target.mesh
+
+    @property
+    def donate_argnums(self):
+        return self.target.donate_argnums
+
+    @property
+    def low_dtypes(self):
+        return tuple(jnp.dtype(d) for d in self.target.low_dtypes)
+
+    @property
+    def jaxpr(self):
+        """The closed jaxpr of the step, traced once and cached. Tracing
+        is abstract (``jax.make_jaxpr``) — no devices are touched, which
+        is what makes the auditors CPU-safe pre-flight checks."""
+        if self._jaxpr is None:
+            fn = self.fn
+            # a jit-wrapped step (only jit stages carry .lower) is
+            # unwrapped one level so the walk starts at the program, not
+            # at a single opaque pjit equation (the predict_comms
+            # pattern); shard_map wrappers must stay on — they carry the
+            # mesh context the body needs
+            if hasattr(fn, "lower"):
+                fn = getattr(fn, "__wrapped__", fn)
+            self._jaxpr = jax.make_jaxpr(fn)(*self.args)
+        return self._jaxpr
+
+    def iter_eqns(self) -> Iterator[Any]:
+        return iter_eqns(self.jaxpr)
+
+    def finding(self, rule: str, message: str, **kw) -> Finding:
+        kw.setdefault("target", self.name)
+        return Finding(rule=rule, message=message, **kw)
+
+
+def run_passes(
+    target: StepTarget,
+    passes: Optional[Sequence[str]] = None,
+    allowlist: Optional[Allowlist] = None,
+) -> List[Finding]:
+    """Run ``passes`` (default: all registered) over one target and return
+    the merged raw findings; apply an allowlist afterwards via
+    ``allowlist.apply`` (kept separate so the CLI can pool findings from
+    several targets before the stale-entry check)."""
+    names = list(passes) if passes is not None else sorted(JAXPR_PASSES)
+    unknown = [n for n in names if n not in JAXPR_PASSES]
+    if unknown:
+        raise KeyError(
+            f"unknown jaxpr pass(es) {unknown}; registered: "
+            f"{sorted(JAXPR_PASSES)}"
+        )
+    ctx = StepContext(target)
+    findings: List[Finding] = []
+    for name in names:
+        findings.extend(JAXPR_PASSES[name](ctx))
+    merged = merge_findings(findings)
+    if allowlist is not None:
+        return allowlist.apply(merged, check_stale=False).findings
+    return merged
+
+
+# importing the pass modules registers them; keep at the bottom so the
+# registry and decorators above exist first
+from apex_tpu.analysis import precision as _precision  # noqa: E402,F401
+from apex_tpu.analysis import donation as _donation  # noqa: E402,F401
+from apex_tpu.analysis import collectives as _collectives  # noqa: E402,F401
+from apex_tpu.analysis import host_sync as _host_sync  # noqa: E402,F401

@@ -1,0 +1,81 @@
+"""Precision auditor: unintended low->high dtype promotions, any f64.
+
+The invariant (PAPER.md / amp design): in a bf16/fp16 step the wide-dtype
+islands are CHOSEN — master weights and optimizer moments, norm and
+softmax statistics, loss/CE math — and everything else stays in the
+compute dtype. A stray ``.astype(jnp.float32)`` (or an op that silently
+promotes) on a hidden-sized tensor doubles that tensor's bandwidth and
+memory; on the (s, b, 4h) MLP activation it is the classic 2x
+activation-memory regression that arXiv:2004.13336 measures. Those casts
+are invisible at runtime — loss curves match — so this pass hunts them
+statically in the traced jaxpr:
+
+- ``precision.promotion``: ``convert_element_type`` from a low dtype
+  (bf16/fp16 by default) to f32/f64. Backward-pass converts synthesized
+  by transposition inherit the forward cast's source line (see
+  ``passes.eqn_site``) — so a kernel cast ``w.astype(bf16)`` whose
+  transpose promotes the gradient to f32 (the master-grad path) is
+  reported AT the forward cast site, and allowlisted there with the
+  master-weight reason.
+- ``precision.f64``: any equation producing an f64 value, promotions or
+  literals — nothing in this library should compute in double precision
+  (TPUs emulate f64 at ~1/10th rate; a single f64 op usually means a
+  Python float leaked into a trace).
+
+Intentional sites are suppressed by documented allowlist entries
+(``apex_tpu/analysis/allowlist.py``), each carrying its numerical
+reason. No bare entries.
+"""
+
+import collections
+from typing import Iterable
+
+import numpy as np
+
+from apex_tpu.analysis.findings import Finding, SEV_ERROR
+from apex_tpu.analysis.passes import eqn_site, jaxpr_pass
+
+__all__ = ["precision_pass"]
+
+_WIDE = (np.dtype(np.float32), np.dtype(np.float64))
+_F64 = np.dtype(np.float64)
+
+
+def _out_dtypes(eqn):
+    for v in eqn.outvars:
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        if dt is not None:
+            yield np.dtype(dt)
+
+
+@jaxpr_pass("precision")
+def precision_pass(ctx) -> Iterable[Finding]:
+    low = set(ctx.low_dtypes)
+    promos = collections.Counter()
+    f64s = collections.Counter()
+    for eqn in ctx.iter_eqns():
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            old = np.dtype(eqn.invars[0].aval.dtype)
+            new = np.dtype(eqn.params["new_dtype"])
+            if old in low and new in _WIDE:
+                promos[(eqn_site(eqn), str(old), str(new))] += 1
+                continue
+        if any(dt == _F64 for dt in _out_dtypes(eqn)):
+            f64s[(eqn_site(eqn), name)] += 1
+    for (site, old, new), count in sorted(promos.items()):
+        yield ctx.finding(
+            "precision.promotion",
+            f"{old} -> {new} promotion in a low-precision step",
+            site=site, severity=SEV_ERROR, count=count,
+            data={"from": old, "to": new},
+        )
+    for (site, prim), count in sorted(f64s.items()):
+        yield ctx.finding(
+            "precision.f64",
+            f"float64 value produced by '{prim}' "
+            f"(double precision is never intentional here)",
+            site=site, severity=SEV_ERROR, count=count,
+            data={"primitive": prim},
+        )

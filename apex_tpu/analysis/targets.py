@@ -1,0 +1,186 @@
+"""In-repo step builders the CLI audits: tiny GPT and BERT train steps.
+
+These are the library's own flagship step shapes (the pretrain_gpt /
+standalone_bert composition) shrunk to trace-and-compile in seconds on
+the CPU test mesh: bf16 compute, tensor parallelism (+ sequence
+parallelism for GPT) over a dp2 x tp2 mesh, dynamic loss scaling, fused
+Adam, dp gradient allreduce, and donated params/opt/scaler state. Every
+auditor has something real to chew on: low-precision regions for the
+precision pass, tp/dp collectives for the collective validator, donation
+intent for the donation auditor, and (deliberately) nothing for the
+host-sync detector to find.
+
+``python -m apex_tpu.analysis`` runs all registered passes over both
+targets and must exit clean — the tier-1 self-check pins that, so a PR
+that introduces a silent promotion, breaks a donation, or leaves a
+``debug.print`` in the step path fails fast.
+"""
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.analysis.passes import StepTarget
+
+__all__ = ["dp2tp2_mesh", "gpt_step_target", "bert_step_target", "all_targets"]
+
+
+def dp2tp2_mesh():
+    """The acceptance mesh: dp=2 x tp=2 over the first four devices (the
+    CPU test topology provides 8 via xla_force_host_platform_device_count;
+    the CLI sets that up before jax initializes)."""
+    from apex_tpu.parallel import parallel_state
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise RuntimeError(
+            f"the dp2xtp2 audit mesh needs >= 4 devices, found "
+            f"{len(devices)} — run via `python -m apex_tpu.analysis` (which "
+            f"forces the 8-device CPU topology) or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    return parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=2, devices=devices[:4]
+    )
+
+
+def _tiny_cfg(**overrides):
+    from apex_tpu.transformer import TransformerConfig
+
+    base = dict(
+        num_layers=2, hidden_size=16, num_attention_heads=2, vocab_size=32,
+        max_position_embeddings=8, hidden_dropout=0.0, attention_dropout=0.0,
+        compute_dtype=jnp.bfloat16,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt_step_target(mesh=None) -> StepTarget:
+    """The GPT dp2xtp2 train step: bf16 + SP over tp, GradScaler, fused
+    Adam, dp grad allreduce, donated (params, opt_state, scaler_state)."""
+    import optax
+
+    from apex_tpu.amp import GradScaler
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models import GPTModel, gpt_loss_fn
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel.ddp import all_reduce_gradients
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or dp2tp2_mesh()
+    cfg = _tiny_cfg(sequence_parallel=True)
+    model = GPTModel(config=cfg)
+    opt = fused_adam(lr=1e-3, weight_decay=0.01)
+    scaler = GradScaler(loss_scale="dynamic")
+    b, s = 2, cfg.max_position_embeddings
+    tokens = jnp.zeros((b, s), jnp.int32)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )
+    def init(tokens):
+        return model.init(jax.random.PRNGKey(0), tokens)
+
+    # abstract state: the auditors only need avals (make_jaxpr and
+    # .lower() both take ShapeDtypeStructs), so nothing here executes —
+    # keeps the CLI/self-check seconds instead of paying real init
+    # compiles on the CPU mesh
+    params = jax.eval_shape(init, tokens)
+    opt_state = jax.eval_shape(opt.init, params)
+    scaler_state = jax.eval_shape(scaler.init)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )
+    def gpt_train_step(params, opt_state, scaler_state, tokens, labels):
+        def scaled_loss(p):
+            return scaler.scale(
+                scaler_state, gpt_loss_fn(model.apply(p, tokens, labels=labels))
+            )
+
+        loss, grads = jax.value_and_grad(scaled_loss)(params)
+        grads = all_reduce_gradients(grads, axis_name="dp")
+        grads, found_inf = scaler.unscale(scaler_state, grads)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        unscaled = xlax.pmean(loss / scaler_state.scale, "dp")
+        return new_params, new_opt_state, new_scaler_state, unscaled
+
+    return StepTarget(
+        name="gpt-dp2tp2",
+        fn=gpt_train_step,
+        args=(params, opt_state, scaler_state, tokens, tokens),
+        mesh=mesh,
+        donate_argnums=(0, 1, 2),
+    )
+
+
+def bert_step_target(mesh=None) -> StepTarget:
+    """The BERT masked-LM step on the same mesh: bf16, tp2 vocab/tensor
+    parallel heads, fused Adam, donated (params, opt_state)."""
+    import optax
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models import BertModel
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel.ddp import all_reduce_gradients
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or dp2tp2_mesh()
+    cfg = _tiny_cfg()
+    model = BertModel(config=cfg, add_binary_head=False)
+    opt = fused_adam(lr=1e-3)
+    b, s = 2, cfg.max_position_embeddings
+    tokens = jnp.zeros((b, s), jnp.int32)
+    mask = jnp.ones((b, s), jnp.int32)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )
+    def init(tokens, mask):
+        return model.init(jax.random.PRNGKey(0), tokens, mask)
+
+    # abstract state, as in gpt_step_target: avals only, no execution
+    params = jax.eval_shape(init, tokens, mask)
+    opt_state = jax.eval_shape(opt.init, params)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    def bert_train_step(params, opt_state, tokens, labels):
+        def loss_fn(p):
+            losses, _ = model.apply(
+                p, tokens, jnp.ones_like(tokens), lm_labels=labels
+            )
+            return jnp.mean(losses)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = all_reduce_gradients(grads, axis_name="dp")
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, xlax.pmean(loss, "dp")
+
+    return StepTarget(
+        name="bert-dp2tp2",
+        fn=bert_train_step,
+        args=(params, opt_state, tokens, tokens),
+        mesh=mesh,
+        donate_argnums=(0, 1),
+    )
+
+
+def all_targets(mesh=None) -> List[StepTarget]:
+    mesh = mesh or dp2tp2_mesh()
+    return [gpt_step_target(mesh), bert_step_target(mesh)]

@@ -9,9 +9,13 @@ producer parsers:
     {"t": <unix time>, "step": <int>, "kind": <str>, ...fields}
 
 ``kind`` partitions the stream: "metrics" (interval scalars), "timer"
-(named timer averages), and the resilience kinds ("skip", "rollback",
+(named timer averages), the resilience kinds ("skip", "rollback",
 "rollback_restore", "halt") which predate this module and keep their
-exact historical shape — the schema was chosen to match them.
+exact historical shape — the schema was chosen to match them — the
+xray kinds ("comms", "memory", "compile"), and "analysis"
+(static-auditor findings from apex_tpu.analysis: rule/site/severity
+plus the allowlist verdict), so pre-flight audit results land in the
+same jsonl a tailer already reads.
 
 Sinks are deliberately dumb append-only writers; the router owns fan-out
 and failure isolation (one broken sink must not take down training — a

@@ -20,6 +20,12 @@ the drop-in class API for users migrating module definitions:
 
 ``normalized_shape`` must be the trailing dimension(s); multi-dim shapes
 are flattened into one trailing axis for the kernel (same reduction set).
+
+Precision note: the kernels compute their statistics (mean / variance /
+rstd) in f32 regardless of the input dtype — intentional wide-dtype
+islands in a bf16 step, documented with their numerical reason in the
+precision-auditor allowlist (apex_tpu/analysis/allowlist.py; the
+``python -m apex_tpu.analysis`` gate flags any NEW promotion).
 """
 
 from typing import Sequence, Union

@@ -49,6 +49,10 @@ def _pick_block_rows(rows: int, hidden: int) -> int:
 
 
 def _ln_ref(x, w, b, eps):
+    # stats-in-f32 contract: mean/variance of bf16 activations lose all
+    # significance in an 8-bit mantissa, so the reduction runs in f32 and
+    # casts back (precision-auditor allowlist entry
+    # "apex_tpu/ops/layer_norm.py", apex_tpu/analysis/allowlist.py)
     xf = x.astype(jnp.float32)
     mean = jnp.mean(xf, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)

@@ -66,6 +66,9 @@ def fused_adam(
         bc1, bc2 = _bias_corrections(step.astype(jnp.float32))
 
         def _g(g, p):
+            # master-accumulation contract: bf16/f16 grads enter the Adam
+            # math in f32 exactly once, here (precision-auditor allowlist
+            # entry "apex_tpu/optimizers/", apex_tpu/analysis/allowlist.py)
             gf = g.astype(jnp.float32)
             if not adam_w_mode and weight_decay != 0.0:
                 gf = gf + weight_decay * p.astype(jnp.float32)  # L2 mode (ADAM_MODE_1)

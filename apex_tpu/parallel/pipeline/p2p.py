@@ -27,11 +27,42 @@ All functions are pytree-polymorphic and must be called inside
 ``shard_map``/``pmap`` over ``axis_name``.
 """
 
-from typing import Any
+from typing import Any, List, Tuple
 
 import jax
 
 from apex_tpu.monitor.xray import ledger as xlax
+
+
+# -- the edge grammar --------------------------------------------------------
+# Every pipeline edge this module ships is built by one of these four
+# constructors. The static collective-safety validator
+# (apex_tpu.analysis.collectives) checks traced ppermute edge sets against
+# exactly this grammar: linear chains with an interior gap are flagged as
+# mismatched send/recv pairs (a stage's input edge fires but the stream
+# never reaches it), and anything that is not a partial permutation is
+# rejected outright. Build edges through these helpers and the validator
+# can never drift from the schedules.
+
+
+def forward_edges(n: int) -> List[Tuple[int, int]]:
+    """Linear +1 chain: rank r sends to r+1; the last rank sends nowhere."""
+    return [(i, i + 1) for i in range(n - 1)]
+
+
+def backward_edges(n: int) -> List[Tuple[int, int]]:
+    """Linear -1 chain: rank r sends to r-1; rank 0 sends nowhere."""
+    return [(i + 1, i) for i in range(n - 1)]
+
+
+def ring_edges(n: int) -> List[Tuple[int, int]]:
+    """Full ring: every rank sends to (r+1) mod n."""
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def last_to_first_edges(n: int) -> List[Tuple[int, int]]:
+    """The single wrap edge closing the ring: rank n-1 to rank 0."""
+    return [(n - 1, 0)]
 
 
 def _permute(x: Any, axis_name: str, perm) -> Any:
@@ -54,16 +85,14 @@ def send_forward_recv_forward(x: Any, axis_name: str = "pp") -> Any:
     the reference's paired isend/irecv collapse into one ppermute.
     """
     n = _pp_size(axis_name)
-    perm = [(i, i + 1) for i in range(n - 1)]
-    return _permute(x, axis_name, perm)
+    return _permute(x, axis_name, forward_edges(n))
 
 
 def send_backward_recv_backward(g: Any, axis_name: str = "pp") -> Any:
     """Ship gradients one stage upstream (ref :450): rank r receives rank
     r+1's ``g``; the last stage receives zeros."""
     n = _pp_size(axis_name)
-    perm = [(i + 1, i) for i in range(n - 1)]
-    return _permute(g, axis_name, perm)
+    return _permute(g, axis_name, backward_edges(n))
 
 
 def ring_forward(x: Any, axis_name: str = "pp") -> Any:
@@ -73,8 +102,7 @@ def ring_forward(x: Any, axis_name: str = "pp") -> Any:
     chunk-advance wrap (P-1 → 0), which carries a microbatch from chunk v
     on the last rank to chunk v+1 on rank 0."""
     n = _pp_size(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    return _permute(x, axis_name, perm)
+    return _permute(x, axis_name, ring_edges(n))
 
 
 def ring_send_last_to_first(x: Any, axis_name: str = "pp") -> Any:
@@ -83,7 +111,7 @@ def ring_send_last_to_first(x: Any, axis_name: str = "pp") -> Any:
     and by embedding-weight sharing between first/last stages (ref:
     parallel_state embedding groups, :319-407)."""
     n = _pp_size(axis_name)
-    return _permute(x, axis_name, [(n - 1, 0)])
+    return _permute(x, axis_name, last_to_first_edges(n))
 
 
 # -- thin API-parity aliases (ref p2p_communication.py:385-690) -------------

@@ -36,6 +36,18 @@ so the schedule becomes a ``lax.scan`` over T = M + P - 1 clock ticks inside
 All schedule functions must run inside ``shard_map`` over ``axis_name``.
 ``stage_fn(params, x) -> y`` must be shape-uniform (y like x); embedding /
 loss heads live outside the scan (pre_process/post_process in build_model).
+
+Static validation: every edge these schedules ship is built from the
+p2p edge grammar (p2p.forward_edges/backward_edges/ring_edges/
+last_to_first_edges), and the trace-time collective-safety validator
+(``apex_tpu.analysis.collectives``) checks traced schedules against it —
+non-permutation edge sets and gapped chains (a stage whose input edge
+fires while its feeder edge is missing: the static deadlock) are
+findings. One honest caveat, as with the comms ledger: the BACKWARD
+schedule's reversed edges are synthesized by jax's transpose rules and
+never appear in a forward trace, so the validator sees them only when
+the traced function includes ``jax.grad`` of the scan (the fwd+bwd
+program), which all ``forward_backward_*`` entry points here do.
 """
 
 import functools

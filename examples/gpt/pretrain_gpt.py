@@ -120,6 +120,11 @@ def parse_args():
                    help="startup banner + periodic kind='comms' records: "
                         "per-axis collective bytes/step and ICI roofline "
                         "from a ledger trace of the step")
+    p.add_argument("--audit-donation", action="store_true",
+                   help="verify the step's donate_argnums against XLA's "
+                        "realized input/output aliasing "
+                        "(apex_tpu.analysis) before training; emits "
+                        "kind='analysis' records")
     # fault injection (apex_tpu.resilience.chaos) — for tests and drills
     p.add_argument("--chaos-nan-steps", default="",
                    help="comma/range list of steps whose loss is NaN-poisoned")
@@ -434,6 +439,35 @@ def main():
         report = monitor.xray.memory_report(train_step, *step_args)
         print(report.format(), flush=True)
         router.event("memory", step0, **report.fields())
+    if args.audit_donation:
+        # static donation audit (apex_tpu.analysis, docs/analysis.md):
+        # the declared donate_argnums vs the aliases XLA actually
+        # realized, plus large buffers that could be donated but aren't.
+        # Pays one extra compile, like --xray-report.
+        from apex_tpu.analysis import repo_allowlist
+        from apex_tpu.analysis.donation import audit_donation
+
+        fins = audit_donation(
+            train_step, *step_args,
+            arg_names=("params", "opt_state", "scaler_state", "sent_state",
+                       "bag", "tokens", "labels", "inject_nan", "lr_scale"),
+            target="gpt-pretrain",
+        )
+        audit = repo_allowlist().apply(fins, check_stale=False)
+        for rec in audit.to_records(step=step0):
+            router.emit(rec)
+        # an 'unverifiable' outcome (auditor could not map HLO params to
+        # input leaves) is info-severity but must NOT print ok: the flag
+        # exists to VERIFY, and a vacuous pass would hide a pruned arg
+        unverifiable = [
+            f for f in fins if f.rule == "donation.unverifiable"
+        ]
+        if audit.ok and not unverifiable:
+            print("donation audit: ok (params/opt/scaler/sentinel alias "
+                  "in place)", flush=True)
+        else:
+            print(audit.format(verbose=True), flush=True)
+            raise SystemExit("donation audit failed")
     # warm the interval-emission path's eager host ops (bag pack/reset)
     # NOW: their one-off compiles must land before the recompile
     # sentinel arms, and on a RESUMED run the first interval boundary

@@ -35,6 +35,9 @@ def parse_args():
     p.add_argument("--clip", type=float, default=1.0)
     p.add_argument("--bf16", action=argparse.BooleanOptionalAction, default=False,
                    help="bf16 compute (TPU-rate; keep off for CPU demos)")
+    p.add_argument("--audit-donation", action="store_true",
+                   help="verify the train step's donation against XLA's "
+                        "realized aliasing (apex_tpu.analysis) before running")
     return p.parse_args()
 
 
@@ -97,21 +100,39 @@ def main():
     )
     labels = jnp.roll(tokens, -1, axis=1)
 
-    # params are donated: the imported HF weights are consumed by the run
-    # and their HBM is reused for the trained result
-    @functools.partial(jax.jit, donate_argnums=(0,))
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.optimizers import zero_state_specs
+
+    # the ZeRO state crosses the shard_map boundary with its canonical
+    # specs (per-rank shards = one dp-sharded global flat array per field)
+    # so it can be initialized ONCE out here and donated like the params
+    opt_specs = zero_state_specs("dp")
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(P(),), out_specs=opt_specs,
+        check_vma=False,
+    )
+    def init_opt(params):
+        return opt.init(params)
+
+    # params AND opt state are donated: the imported HF weights are
+    # consumed by the run (their HBM is reused for the trained result) and
+    # the Adam moments/master shards update in place across the scan —
+    # without the opt-state donation the step double-buffers a second
+    # full copy of the optimizer state (2x params for ZeRO-2's fp32
+    # master+moments). Verified by the donation auditor
+    # (--audit-donation; apex_tpu.analysis).
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     @functools.partial(
         shard_map, mesh=mesh,
         # params replicated in/out (ZeRO all-gathers updates every step);
-        # the batch dim of the (steps, global_batch, seq) data shards on dp;
-        # ZeRO optimizer state lives INSIDE, sharded per rank
-        in_specs=(P(), P("dp"), P("dp")),
-        out_specs=(P(), P()),
+        # ZeRO optimizer state dp-sharded in/out (one shard per rank);
+        # the batch dim of the (global_batch, seq) data shards on dp
+        in_specs=(P(), opt_specs, P("dp"), P("dp")),
+        out_specs=(P(), opt_specs, P()),
         check_vma=False,
     )
-    def train(params, tokens, labels):
-        opt_state = opt.init(params)
-
+    def train(params, opt_state, tokens, labels):
         def step(carry, _):
             params, opt_state = carry
 
@@ -121,15 +142,35 @@ def main():
             loss, grads = jax.value_and_grad(loss_fn)(params)
             updates, opt_state = opt.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
-            return (params, opt_state), jax.lax.pmean(loss, "dp")
+            return (params, opt_state), xlax.pmean(loss, "dp")
 
-        (params, _), losses = jax.lax.scan(
+        (params, opt_state), losses = jax.lax.scan(
             step, (params, opt_state), None, length=args.steps
         )
-        return params, losses
+        return params, opt_state, losses
+
+    opt_state = init_opt(variables)
+    if args.audit_donation:
+        from apex_tpu.analysis import repo_allowlist
+        from apex_tpu.analysis.donation import audit_donation
+
+        fins = audit_donation(
+            train, variables, opt_state, tokens, labels,
+            arg_names=("params", "opt_state", "tokens", "labels"),
+            target="llama-finetune",
+        )
+        res = repo_allowlist().apply(fins, check_stale=False)
+        # 'unverifiable' (info) must not count as ok: the flag promises
+        # verification, not absence of errors
+        unverifiable = [f for f in fins if f.rule == "donation.unverifiable"]
+        if res.ok and not unverifiable:
+            print("donation audit: ok (params + opt_state alias in place)")
+        else:
+            print(res.format(verbose=True))
+            raise SystemExit("donation audit failed")
 
     t0 = time.perf_counter()
-    params, losses = train(variables, tokens, labels)
+    params, opt_state, losses = train(variables, opt_state, tokens, labels)
     losses = np.asarray(losses)
     dt = time.perf_counter() - t0
     for i in range(0, args.steps, max(1, args.steps // 5)):

@@ -46,6 +46,7 @@ _SLOW_TESTS = {
     "test_gpt_pretrain_resume",
     "test_gpt_pretrain_chaos",
     "test_gpt_pretrain_xray",
+    "test_analysis_cli_subprocess",
     "test_sparsity_example",
     "test_llama_finetune_example",
     "test_post_params_stay_replicated_under_sp",

@@ -1,0 +1,592 @@
+"""Static-analysis subsystem (apex_tpu.analysis): jaxpr auditors, AST
+lint framework, allowlist machinery, and the repo self-check.
+
+Every pass gets a hand-built miniature step with ONE known violation
+(bad promotion, rejected donation, non-permutation ppermute, mismatched
+pipeline edge, host callback) asserting exact Finding fields, plus a
+clean-function negative test — the auditors must find exactly what is
+seeded and nothing else. The self-check at the bottom is the acceptance
+gate: ``python -m apex_tpu.analysis`` (lint + GPT/BERT step targets on
+the dp2xtp2 CPU mesh) must exit 0 against the repo as committed.
+"""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.compat import shard_map
+from apex_tpu.monitor.xray import ledger as xlax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.analysis import (
+    Allowlist,
+    AllowlistEntry,
+    Finding,
+    StepTarget,
+    merge_findings,
+    run_passes,
+)
+from apex_tpu.analysis.donation import audit_donation
+from apex_tpu.analysis.lint import run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+THIS_FILE = "tests/test_analysis.py"
+
+
+def mesh1d(n, name):
+    return jax.sharding.Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def mesh2d(a, b, names):
+    return jax.sharding.Mesh(
+        np.array(jax.devices()[: a * b]).reshape(a, b), names
+    )
+
+
+# ---------------------------------------------------------------------------
+# findings + allowlist machinery
+
+
+class TestFindingsAndAllowlist:
+    def test_bare_allowlist_entry_rejected(self):
+        with pytest.raises(ValueError, match="reason"):
+            AllowlistEntry(rule="precision.promotion", match="x.py", reason="  ")
+
+    def test_entry_matching_rule_glob_and_site(self):
+        e = AllowlistEntry(
+            rule="precision.*", match="apex_tpu/ops/", reason="stats in f32"
+        )
+        hit = Finding(rule="precision.promotion", message="m",
+                      site="apex_tpu/ops/layer_norm.py:52")
+        miss_rule = Finding(rule="donation.missed", message="m",
+                            site="apex_tpu/ops/layer_norm.py:52")
+        miss_site = Finding(rule="precision.promotion", message="m",
+                            site="apex_tpu/models/gpt.py:1")
+        assert e.matches(hit)
+        assert not e.matches(miss_rule)
+        assert not e.matches(miss_site)
+
+    def test_merge_findings_sums_counts(self):
+        a = Finding(rule="r", message="m", site="s", count=2)
+        b = Finding(rule="r", message="m", site="s", count=3)
+        c = Finding(rule="r", message="m", site="other")
+        merged = merge_findings([a, b, c])
+        assert sorted(f.count for f in merged) == [1, 5]
+
+    def test_apply_partitions_and_detects_stale(self):
+        al = Allowlist([
+            AllowlistEntry(rule="r", match="ok.py", reason="fine"),
+            AllowlistEntry(rule="r", match="gone.py", reason="was fine",
+                           require_hit=True),
+        ])
+        res = al.apply([Finding(rule="r", message="m", site="ok.py:1"),
+                        Finding(rule="r", message="m", site="bad.py:1")])
+        assert [f.site for f in res.findings] == ["bad.py:1"]
+        assert len(res.suppressed) == 1
+        assert [e.match for e in res.stale_entries] == ["gone.py"]
+        assert not res.ok
+
+    def test_info_findings_do_not_fail(self):
+        res = Allowlist().apply(
+            [Finding(rule="r", message="m", site="s", severity="info")]
+        )
+        assert res.ok
+
+    def test_records_share_router_schema(self):
+        from apex_tpu import monitor
+
+        res = Allowlist([
+            AllowlistEntry(rule="r", match="b.py", reason="documented why"),
+        ]).apply([
+            Finding(rule="r", message="kept", site="a.py:1"),
+            Finding(rule="r", message="hidden", site="b.py:2"),
+        ])
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        for rec in res.to_records(step=7):
+            router.emit(rec)
+        assert len(mem.records) == 2
+        for rec in mem.records:
+            assert {"t", "step", "kind", "rule", "site"} <= set(rec)
+            assert rec["kind"] == "analysis" and rec["step"] == 7
+        allowed = [r for r in mem.records if r["allowed"]]
+        assert len(allowed) == 1 and allowed[0]["reason"] == "documented why"
+
+    def test_repo_allowlist_every_entry_carries_a_reason(self):
+        from apex_tpu.analysis.allowlist import REPO_ALLOWLIST
+
+        assert len(REPO_ALLOWLIST) > 0
+        for e in REPO_ALLOWLIST.entries:
+            # a reason must be a sentence someone can review, not a token
+            assert len(e.reason.split()) >= 5, (e.rule, e.match)
+
+
+# ---------------------------------------------------------------------------
+# precision auditor
+
+
+class TestPrecisionPass:
+    def test_seeded_promotion_exact_fields(self):
+        def step(x):
+            return x.astype(jnp.float32).sum()  # the seeded violation
+
+        tgt = StepTarget(
+            name="seeded", fn=step,
+            args=(jax.ShapeDtypeStruct((4,), jnp.bfloat16),),
+        )
+        (f,) = run_passes(tgt, passes=["precision"])
+        assert f.rule == "precision.promotion"
+        assert f.severity == "error"
+        assert f.target == "seeded"
+        assert f.count == 1
+        assert f.data == {"from": "bfloat16", "to": "float32"}
+        assert f.site.startswith(THIS_FILE + ":")
+
+    def test_promotion_found_inside_nested_scan(self):
+        def step(x):
+            def body(c, _):
+                return c + x.astype(jnp.float32).sum(), None
+
+            out, _ = jax.lax.scan(body, 0.0, None, length=3)
+            return out
+
+        tgt = StepTarget(
+            name="t", fn=step, args=(jax.ShapeDtypeStruct((4,), jnp.bfloat16),)
+        )
+        fins = run_passes(tgt, passes=["precision"])
+        assert [f.rule for f in fins] == ["precision.promotion"]
+
+    def test_f64_flagged(self):
+        from jax.experimental import enable_x64
+
+        def step(x):
+            return x.astype(jnp.float64) * 2
+
+        with enable_x64():
+            tgt = StepTarget(
+                name="t", fn=step,
+                args=(jax.ShapeDtypeStruct((2,), jnp.float32),),
+            )
+            fins = run_passes(tgt, passes=["precision"])
+        rules = {f.rule for f in fins}
+        assert rules == {"precision.f64"}
+        assert all(f.severity == "error" for f in fins)
+        prims = {f.data["primitive"] for f in fins}
+        assert "convert_element_type" in prims
+
+    def test_clean_bf16_step_no_findings(self):
+        # no reduction on purpose: jnp.sum of a bf16 array upcasts its
+        # accumulator to f32 (a REAL promotion the pass would flag)
+        def step(x, w):
+            return jnp.tanh(x @ w) * 2
+
+        tgt = StepTarget(
+            name="t", fn=step,
+            args=(jax.ShapeDtypeStruct((4, 4), jnp.bfloat16),
+                  jax.ShapeDtypeStruct((4, 4), jnp.bfloat16)),
+        )
+        assert run_passes(tgt, passes=["precision"]) == []
+
+
+# ---------------------------------------------------------------------------
+# collective-safety validator
+
+
+class TestCollectivePass:
+    def test_unknown_axis_flagged(self):
+        mesh_dp = mesh1d(2, "dp")
+        mesh_tp = mesh1d(2, "tp")  # the ambient mesh the pass audits against
+
+        @functools.partial(
+            shard_map, mesh=mesh_dp, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            return xlax.psum(x, "dp")
+
+        tgt = StepTarget(name="t", fn=step, args=(jnp.ones((2,)),),
+                         mesh=mesh_tp)
+        fins = run_passes(tgt, passes=["collective"])
+        (f,) = [f for f in fins if f.rule == "collective.unknown-axis"]
+        assert f.severity == "error"
+        assert f.data == {"op": "psum", "axis": "dp"}
+        assert f.site.startswith(THIS_FILE + ":")
+
+    def test_size1_axis_flagged_as_dead_traffic(self):
+        mesh = mesh2d(2, 1, ("dp", "pp"))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            return xlax.psum(x, "pp")  # size-1 axis: dead traffic
+
+        # the ledger elides size-1 axes from RECORDING, but the primitive
+        # is still in the jaxpr — exactly what this pass exists to flag
+        tgt = StepTarget(name="t", fn=step, args=(jnp.ones((2,)),), mesh=mesh)
+        (f,) = run_passes(tgt, passes=["collective"])
+        assert f.rule == "collective.dead-traffic"
+        assert f.severity == "warning"
+        assert f.data == {"op": "psum", "axis": "pp"}
+
+    def test_non_permutation_ppermute_flagged(self):
+        mesh = mesh1d(4, "pp")
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            # rank 0 sends twice: not a permutation (jax traces it fine,
+            # which is why the static check exists)
+            return xlax.ppermute(x, "pp", [(0, 1), (0, 2)])
+
+        (f,) = run_passes(StepTarget(name="t", fn=step, args=(jnp.ones((2,)),),
+                                     mesh=mesh), passes=["collective"])
+        assert f.rule == "collective.non-permutation"
+        assert f.severity == "error"
+        assert "duplicate source" in f.message
+        assert f.data["axis"] == "pp"
+
+    def test_mismatched_pipeline_edge_flagged(self):
+        mesh = mesh1d(4, "pp")
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            # stage 1's outgoing edge is missing: stages 2..3 wait on a
+            # stream that never crosses the gap
+            return xlax.ppermute(x, "pp", [(0, 1), (2, 3)])
+
+        (f,) = run_passes(StepTarget(name="t", fn=step, args=(jnp.ones((2,)),),
+                                     mesh=mesh), passes=["collective"])
+        assert f.rule == "collective.mismatched-edge"
+        assert f.severity == "error"
+        assert f.data["gaps"] == "[1]"
+
+    def test_p2p_edge_grammar_is_clean(self):
+        """Every edge constructor in parallel/pipeline/p2p.py must pass
+        the validator — the schedules build all their edges from these."""
+        from apex_tpu.parallel.pipeline import p2p
+
+        mesh = mesh1d(4, "pp")
+        for edges in (p2p.forward_edges(4), p2p.backward_edges(4),
+                      p2p.ring_edges(4), p2p.last_to_first_edges(4)):
+
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            )
+            def step(x, edges=edges):
+                return xlax.ppermute(x, "pp", edges)
+
+            fins = run_passes(StepTarget(name="t", fn=step,
+                                         args=(jnp.ones((2,)),), mesh=mesh),
+                              passes=["collective"])
+            assert fins == [], (edges, [f.format() for f in fins])
+
+    def test_real_pipeline_schedule_validates_clean(self):
+        """The 1F1B schedule (fwd AND the transposed backward edges jax
+        synthesizes through the scan) contains only valid chains."""
+        from apex_tpu.parallel.pipeline import schedules
+
+        mesh = mesh1d(4, "pp")
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p)
+
+        def loss_fn(y, t):
+            return jnp.mean((y - t) ** 2)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), P(), P()), out_specs=P(),
+            check_vma=False,
+        )
+        def step(p, mb, tg):
+            loss, _, grads = (
+                schedules.forward_backward_pipelining_without_interleaving(
+                    stage_fn, loss_fn, p, mb, tg, axis_name="pp"
+                )
+            )
+            return loss
+
+        p = jnp.ones((4, 4))
+        mb = jnp.ones((4, 2, 4))
+        fins = run_passes(StepTarget(name="pp1f1b", fn=step, args=(p, mb, mb),
+                                     mesh=mesh), passes=["collective"])
+        assert fins == [], [f.format() for f in fins]
+
+    def test_chain_gaps_unit(self):
+        from apex_tpu.analysis.collectives import chain_gaps
+
+        assert chain_gaps([(0, 1), (1, 2), (2, 3)], 4) == []
+        assert chain_gaps([(1, 0), (2, 1), (3, 2)], 4) == []
+        assert chain_gaps([(0, 1), (2, 3)], 4) == [1]
+        assert chain_gaps([(0, 1), (3, 4)], 8) == [1, 2]
+        # rings / wrap edges / shuffles have no linear-chain semantics
+        assert chain_gaps([(0, 1), (1, 2), (2, 3), (3, 0)], 4) is None
+        assert chain_gaps([(3, 0)], 4) is None
+        assert chain_gaps([(0, 2), (2, 0)], 4) is None
+
+
+# ---------------------------------------------------------------------------
+# host-sync detector
+
+
+class TestHostSyncPass:
+    def test_debug_print_flagged(self):
+        def step(x):
+            jax.debug.print("loss={l}", l=x.sum())  # the seeded violation
+            return x * 2
+
+        (f,) = run_passes(
+            StepTarget(name="t", fn=step, args=(jnp.ones((4,)),)),
+            passes=["host-sync"],
+        )
+        assert f.rule == "host-sync.callback"
+        assert f.severity == "error"
+        assert f.data == {"primitive": "debug_callback"}
+        assert f.site.startswith(THIS_FILE + ":")
+
+    def test_pure_callback_flagged(self):
+        def step(x):
+            y = jax.pure_callback(
+                lambda v: np.asarray(v) * 2,
+                jax.ShapeDtypeStruct((4,), jnp.float32), x,
+            )
+            return y.sum()
+
+        (f,) = run_passes(
+            StepTarget(name="t", fn=step, args=(jnp.ones((4,)),)),
+            passes=["host-sync"],
+        )
+        assert f.rule == "host-sync.callback"
+        assert f.data == {"primitive": "pure_callback"}
+
+    def test_clean_step_no_findings(self):
+        def step(x):
+            return (x @ x).sum()
+
+        assert run_passes(
+            StepTarget(name="t", fn=step, args=(jnp.ones((4, 4)),)),
+            passes=["host-sync"],
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# donation auditor
+
+
+class TestDonationAuditor:
+    MiB = 1 << 20
+
+    def test_rejected_donation_exact_fields(self):
+        def step(a, b):
+            return b * 2.0  # 'a' donated but no output matches it
+
+        a = jax.ShapeDtypeStruct((512, 512), jnp.float32)  # 1 MiB
+        b = jax.ShapeDtypeStruct((8,), jnp.float32)
+        fins = audit_donation(step, a, b, donate_argnums=(0,),
+                              arg_names=("a", "b"), target="seeded")
+        (f,) = [f for f in fins if f.rule == "donation.rejected"]
+        assert f.severity == "error"
+        assert f.data["leaf"] == "a"
+        assert f.data["stage"] == "lowering"
+        assert f.data["bytes"] == self.MiB
+        assert f.target == "seeded"
+
+    def test_missed_donation_flagged(self):
+        def step(p, o, x):
+            new_p = jax.tree_util.tree_map(lambda l: l - 0.1 * x.sum(), p)
+            new_o = jax.tree_util.tree_map(lambda l: l + 1.0, o)
+            return new_p, new_o
+
+        p = {"w": jax.ShapeDtypeStruct((512, 512), jnp.float32)}
+        o = {"m": jax.ShapeDtypeStruct((512, 512), jnp.float32)}
+        x = jax.ShapeDtypeStruct((4,), jnp.float32)
+        # p donated, o forgotten: o matches an un-aliased output
+        fins = audit_donation(step, p, o, x, donate_argnums=(0,),
+                              arg_names=("params", "opt_state", "x"))
+        (f,) = [f for f in fins if f.rule == "donation.missed"]
+        assert f.severity == "warning"
+        assert f.data["leaf"] == "opt_state['m']"
+        assert f.data["bytes"] == self.MiB
+
+    def test_clean_donation_no_findings(self):
+        def step(p, o, x):
+            new_p = jax.tree_util.tree_map(lambda l: l - 0.1 * x.sum(), p)
+            new_o = jax.tree_util.tree_map(lambda l: l + 1.0, o)
+            return new_p, new_o
+
+        p = {"w": jax.ShapeDtypeStruct((512, 512), jnp.float32)}
+        o = {"m": jax.ShapeDtypeStruct((512, 512), jnp.float32)}
+        x = jax.ShapeDtypeStruct((4,), jnp.float32)
+        assert audit_donation(step, p, o, x, donate_argnums=(0, 1)) == []
+
+    def test_prejitted_step_uses_its_own_donation(self):
+        def step(p, x):
+            return jax.tree_util.tree_map(lambda l: l - x.sum(), p)
+
+        p = {"w": jnp.ones((512, 512))}
+        x = jnp.ones((4,))
+        jitted = jax.jit(step, donate_argnums=(0,))
+        assert audit_donation(jitted, p, x) == []
+
+    def test_pass_skipped_without_donation_intent(self):
+        tgt = StepTarget(name="t", fn=lambda x: x * 2,
+                         args=(jnp.ones((4,)),), donate_argnums=None)
+        assert run_passes(tgt, passes=["donation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# AST lint framework
+
+
+class TestLintFramework:
+    def test_raw_collective_seeded(self):
+        files = {
+            "apex_tpu/fake.py":
+                "from jax import lax\n\n\ndef f(x):\n"
+                "    return lax.psum(x, 'tp')\n",
+        }
+        (f,) = run_lint(rules=["lint.raw-collective"], files=files)
+        assert f.rule == "lint.raw-collective"
+        assert f.site == "apex_tpu/fake.py:5"
+        assert f.data == {"op": "psum"}
+
+    def test_raw_collective_docstring_mention_not_flagged(self):
+        files = {
+            "apex_tpu/fake.py":
+                '"""docs mention jax.lax.psum freely"""\n'
+                "# and comments: lax.all_gather\n",
+        }
+        assert run_lint(rules=["lint.raw-collective"], files=files) == []
+
+    def test_float64_seeded(self):
+        files = {
+            "apex_tpu/fake.py":
+                "import jax.numpy as jnp\nimport numpy as np\nimport numpy\n"
+                "x = jnp.float64(3.0)\n"
+                "y = np.float64(3.0)  # host-side: fine\n"
+                "z = numpy.float64(3.0)  # host-side too: fine\n"
+                "w = jax.numpy.float64(3.0)\n",
+        }
+        fins = run_lint(rules=["lint.float64"], files=files)
+        # only the jax spellings: jnp.float64 and jax.numpy.float64
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/fake.py:4", "apex_tpu/fake.py:7",
+        ]
+        assert all(f.rule == "lint.float64" for f in fins)
+
+    def test_rule_scopes_enforced_by_registry(self):
+        # raw-collective is scoped to apex_tpu/: the same violation under
+        # examples/ is out of scope and must not be flagged
+        files = {
+            "examples/fake.py":
+                "from jax import lax\n\n\ndef f(x):\n"
+                "    return lax.psum(x, 'tp')\n",
+        }
+        assert run_lint(rules=["lint.raw-collective"], files=files) == []
+
+    def test_jit_donate_seeded_and_data_calls_exempt(self):
+        files = {
+            "examples/fake.py":
+                "import functools, jax\n"
+                "step = jax.jit(lambda x: x, donate_argnums=(0,))\n"
+                "tgt = StepTarget(fn=step, donate_argnums=(0,))\n"
+                "part = functools.partial(jax.jit, donate_argnums=(1,))\n",
+        }
+        fins = run_lint(rules=["lint.jit-donate"], files=files)
+        # the jax.jit call and the partial(jax.jit) are flagged; the
+        # StepTarget DECLARATION (auditing intent, not a jit) is not
+        assert sorted(f.site for f in fins) == [
+            "examples/fake.py:2", "examples/fake.py:4",
+        ]
+
+    def test_registered_taps_seeded(self):
+        files = {
+            "apex_tpu/fake.py":
+                "def mod(self, x):\n"
+                "    self.sow('intermediates', 'not_a_real_tap', x)\n",
+        }
+        fins = run_lint(rules=["lint.registered-taps"], files=files)
+        seeded = [f for f in fins if f.data.get("tap") == "not_a_real_tap"]
+        assert len(seeded) == 1
+        assert seeded[0].site == "apex_tpu/fake.py:2"
+        assert not seeded[0].data.get("stale")
+
+    def test_unknown_rule_raises(self):
+        with pytest.raises(KeyError, match="lint.nope"):
+            run_lint(rules=["lint.nope"], files={})
+
+
+# ---------------------------------------------------------------------------
+# the repo self-check: the CLI gate must pass against the tree as committed
+
+
+class TestRepoSelfCheck:
+    def test_repo_lint_clean(self):
+        """All source rules over the real tree, repo allowlist applied:
+        zero unallowlisted findings and zero stale entries."""
+        from apex_tpu.analysis import Allowlist
+        from apex_tpu.analysis.allowlist import REPO_ALLOWLIST
+
+        fins = run_lint()
+        lint_entries = [
+            e for e in REPO_ALLOWLIST.entries if e.rule.startswith("lint.")
+        ]
+        res = Allowlist(lint_entries).apply(fins, check_stale=True)
+        assert not res.findings, "\n".join(f.format() for f in res.findings)
+        assert not res.stale_entries, res.stale_entries
+
+    def test_cli_main_clean(self):
+        """ACCEPTANCE: the full gate — AST rules + all four jaxpr passes
+        over the GPT dp2xtp2 and BERT step builders — exits 0. Any future
+        silent promotion, broken donation, raw collective, or in-step
+        host callback fails this test."""
+        from apex_tpu.analysis.__main__ import main
+
+        try:
+            assert main([]) == 0
+        finally:
+            # the CLI points parallel_state at a 4-device sub-mesh;
+            # restore the full default mesh for whatever test runs next
+            from apex_tpu.parallel import parallel_state
+
+            parallel_state.initialize_model_parallel()
+
+
+def test_analysis_cli_subprocess(tmp_path):
+    """The real entry point, as CI would run it: ``python -m
+    apex_tpu.analysis`` in a fresh process (its own env setup), exit 0,
+    and every emitted record an allowlisted finding with a reason."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    out = str(tmp_path / "analysis.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--json", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=570,
+    )
+    assert proc.returncode == 0, (
+        f"analysis CLI failed\nstdout tail: {proc.stdout[-2000:]}\n"
+        f"stderr tail: {proc.stderr[-800:]}"
+    )
+    records = [json.loads(l) for l in open(out)]
+    assert records, "CLI emitted no analysis records"
+    for rec in records:
+        assert rec["kind"] == "analysis"
+        assert rec["allowed"] is True
+        assert rec["reason"].strip()

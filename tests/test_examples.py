@@ -85,7 +85,9 @@ def test_gpt_pretrain_xray(tmp_path):
     """The X-ray flags through the real example: startup banners (memory
     breakdown + predicted comms/step) on stdout, and kind='comms'/
     'memory'/'compile' records in the SAME jsonl stream as metrics and
-    anomalies — the one-tailer contract."""
+    anomalies — the one-tailer contract. --audit-donation rides along:
+    the donation auditor (apex_tpu.analysis) must verify the example's
+    donate_argnums=(0,1,2,3) against XLA's realized aliasing."""
     import json
 
     jsonl = tmp_path / "metrics.jsonl"
@@ -94,9 +96,10 @@ def test_gpt_pretrain_xray(tmp_path):
                 "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
                 "--global-batch", "16", "--log-interval", "2", "--tp", "2",
                 "--metrics-jsonl", str(jsonl),
-                "--xray-report", "--xray-comms"])
+                "--xray-report", "--xray-comms", "--audit-donation"])
     assert "comms ledger (per step):" in out
     assert "memory report (per device):" in out
+    assert "donation audit: ok" in out
     records = [json.loads(line) for line in jsonl.read_text().splitlines()]
     by_kind = {}
     for r in records:
@@ -159,7 +162,12 @@ def test_gpt_pretrain_chaos(tmp_path):
 
 
 def test_llama_finetune_example():
-    out = _run("examples/llama/finetune_llama.py", ["--steps", "20"])
+    # --audit-donation: the donation auditor must verify that params AND
+    # the ZeRO opt-state alias in place (the opt-state donation is what
+    # keeps ZeRO-2 from double-buffering its fp32 master+moments)
+    out = _run("examples/llama/finetune_llama.py",
+               ["--steps", "20", "--audit-donation"])
+    assert "donation audit: ok" in out
     assert "final loss" in out
     # memorization demo: loss must fall well below the uniform floor
     final = float(out.split("final loss")[1].split(";")[0])

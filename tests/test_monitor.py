@@ -10,7 +10,6 @@ fetches via ``monitor.host_fetch_count`` instead of trusting comments.
 
 import json
 import os
-import re
 import threading
 import time
 
@@ -23,9 +22,6 @@ from apex_tpu import monitor
 from apex_tpu.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
-APEX_ROOT = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "apex_tpu"
-)
 
 
 class TestMetricBag:
@@ -585,122 +581,70 @@ class TestLayerMetricsTap:
         assert monitor.taps_from_intermediates(col.get("intermediates", {})) == {}
 
 
-SOW_RE = re.compile(
-    r"""\.sow\(\s*['"]intermediates['"]\s*,\s*['"](?P<name>\w+)['"]"""
-)
-
-
 class TestRegisteredTapsLint:
     """Tier-1 drift guard: every ``sow("intermediates", <name>, ...)`` in
     apex_tpu/ must be registered in monitor/taps.py, and every registry
-    row must still have a live sow site (no stale registry either)."""
+    row must still have a live sow site. THIN WRAPPER: the rule logic
+    migrated to the unified AST lint framework
+    (apex_tpu.analysis.lint, rule ``lint.registered-taps``); these test
+    names are kept so the tier-1 history stays legible."""
 
-    def _sown_names(self):
-        names = {}
-        for dirpath, _, files in os.walk(APEX_ROOT):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path) as f:
-                    for m in SOW_RE.finditer(f.read()):
-                        names.setdefault(m.group("name"), []).append(path)
-        return names
+    def _findings(self):
+        from apex_tpu.analysis import lint
+
+        return lint.run_lint(rules=["lint.registered-taps"])
 
     def test_every_sown_tap_is_registered(self):
-        sown = self._sown_names()
-        assert sown, "no sow taps found — the regex or layout changed"
-        unregistered = set(sown) - set(monitor.REGISTERED_TAPS)
+        unregistered = [
+            f for f in self._findings() if not f.data.get("stale")
+        ]
         assert not unregistered, (
-            f"sow taps {sorted(unregistered)} missing from "
-            f"monitor/taps.py REGISTERED_TAPS (sown at "
-            f"{ {n: sown[n] for n in unregistered} })"
+            "sow taps missing from monitor/taps.py REGISTERED_TAPS: "
+            + "; ".join(f.format() for f in unregistered)
         )
 
     def test_every_registered_tap_is_still_sown(self):
-        stale = set(monitor.REGISTERED_TAPS) - set(self._sown_names())
+        stale = [f for f in self._findings() if f.data.get("stale")]
         assert not stale, (
-            f"REGISTERED_TAPS entries {sorted(stale)} have no sow site "
-            f"left in apex_tpu/ — remove them or restore the tap"
+            "REGISTERED_TAPS entries with no sow site left: "
+            + "; ".join(f.format() for f in stale)
         )
-
-
-#: collectives the xray ledger instruments (monitor/xray/ledger.py)
-LEDGERED_OPS = frozenset({
-    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
-    "pmean", "pmax", "pmin",
-})
-
-#: the only files allowed to call raw jax.lax collectives: the ledger's
-#: own wrappers. Everything else must route through them, or the comms
-#: report silently loses that traffic the next time someone adds an op.
-RAW_COLLECTIVE_ALLOWLIST = frozenset({
-    os.path.join("monitor", "xray", "ledger.py"),
-})
 
 
 class TestRawCollectiveLint:
     """Tier-1 drift guard (the REGISTERED_TAPS pattern, for comms): no
     call site in apex_tpu/ may invoke ``lax.{psum,all_gather,...}``
     directly — every collective goes through the xray ledger wrappers so
-    the comms ledger sees ALL of apex_tpu's traffic. Token-based (via
-    tokenize), so docstrings and comments mentioning ``jax.lax.psum``
-    don't false-positive."""
+    the comms ledger sees ALL of apex_tpu's traffic. THIN WRAPPER over
+    apex_tpu.analysis.lint rule ``lint.raw-collective``; the allowlist
+    (ledger.py itself) now lives in apex_tpu/analysis/allowlist.py with
+    its reason, and staleness is the framework's require_hit check."""
 
-    def _raw_call_sites(self):
-        import tokenize
+    def _result(self):
+        from apex_tpu.analysis import Allowlist, lint
+        from apex_tpu.analysis.allowlist import REPO_ALLOWLIST
 
-        offenders = {}
-        for dirpath, _, files in os.walk(APEX_ROOT):
-            for fn in files:
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                rel = os.path.relpath(path, APEX_ROOT)
-                if rel in RAW_COLLECTIVE_ALLOWLIST:
-                    continue
-                with open(path, "rb") as f:
-                    toks = [
-                        t for t in tokenize.tokenize(f.readline)
-                        if t.type in (tokenize.NAME, tokenize.OP)
-                    ]
-                for i in range(len(toks) - 2):
-                    if (
-                        toks[i].type == tokenize.NAME
-                        and toks[i].string == "lax"
-                        and toks[i + 1].string == "."
-                        and toks[i + 2].string in LEDGERED_OPS
-                    ):
-                        offenders.setdefault(rel, []).append(
-                            f"line {toks[i].start[0]}: "
-                            f"lax.{toks[i + 2].string}"
-                        )
-        return offenders
+        fins = lint.run_lint(rules=["lint.raw-collective"])
+        rule_entries = [
+            e for e in REPO_ALLOWLIST.entries
+            if e.rule == "lint.raw-collective"
+        ]
+        return Allowlist(rule_entries).apply(fins, check_stale=True)
 
     def test_no_raw_collective_bypasses_the_ledger(self):
-        offenders = self._raw_call_sites()
-        assert not offenders, (
+        res = self._result()
+        assert not res.findings, (
             "raw jax.lax collective call sites bypass the xray comms "
             "ledger (use apex_tpu.monitor.xray.ledger wrappers, or add "
-            f"the file to RAW_COLLECTIVE_ALLOWLIST with a reason): "
-            f"{offenders}"
+            "an allowlist entry with a reason): "
+            + "; ".join(f.format() for f in res.findings)
         )
 
     def test_allowlist_is_not_stale(self):
-        """Every allowlisted file must still exist and still contain a
-        raw collective — otherwise remove it from the allowlist."""
-        import tokenize
-
-        for rel in RAW_COLLECTIVE_ALLOWLIST:
-            path = os.path.join(APEX_ROOT, rel)
-            assert os.path.exists(path), f"allowlisted {rel} is gone"
-            with open(path, "rb") as f:
-                toks = [
-                    t.string for t in tokenize.tokenize(f.readline)
-                    if t.type in (tokenize.NAME, tokenize.OP)
-                ]
-            assert any(
-                toks[i] == "lax" and toks[i + 1] == "."
-                and toks[i + 2] in LEDGERED_OPS
-                for i in range(len(toks) - 2)
-            ), f"allowlisted {rel} no longer calls any raw collective"
+        """Every allowlist entry for this rule must still suppress a live
+        raw-collective site — otherwise remove it."""
+        res = self._result()
+        assert not res.stale_entries, (
+            "stale lint.raw-collective allowlist entries: "
+            + ", ".join(e.match for e in res.stale_entries)
+        )
