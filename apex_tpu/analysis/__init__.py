@@ -17,8 +17,14 @@ shared telemetry schema (``kind="analysis"`` via monitor.MetricRouter):
   (collectives.py)
 - ``host-sync``   — callbacks / device->host transfers inside the
   compiled step (host_sync.py)
+- ``hlo-comms``   — the ghost-collective differ: collectives in the
+  OPTIMIZED HLO vs the xray ledger's trace-time prediction, with
+  replica_groups attributed back to mesh axes (hlo/comms_diff.py)
+- ``hlo-sharding``— >=1MiB entry params/outputs left fully replicated
+  on a >1-sized mesh axis (hlo/sharding_audit.py)
 - ``lint``        — raw-collective + registered-taps (migrated from the
-  tier-1 tests) + jit-donate + float64 source rules (lint.py)
+  tier-1 tests) + jit-donate + float64 + hlo-text source rules
+  (lint.py)
 
 CLI: ``python -m apex_tpu.analysis`` runs the AST rules over the tree
 and the jaxpr passes over the in-repo GPT/BERT step builders on a CPU
@@ -49,6 +55,8 @@ _EXPORTS = {
     "run_passes": "passes",
     # individual auditors
     "audit_donation": "donation",
+    "audit_comms": "hlo",
+    "audit_entry_shardings": "hlo",
     # lint framework (jax-free)
     "LINT_RULES": "lint",
     "lint_rule": "lint",
@@ -67,7 +75,7 @@ _EXPORTS = {
 
 __all__ = sorted(_EXPORTS) + [
     "findings", "passes", "precision", "donation", "collectives",
-    "host_sync", "lint", "allowlist", "targets",
+    "host_sync", "lint", "allowlist", "targets", "hlo",
 ]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)

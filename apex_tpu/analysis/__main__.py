@@ -1,20 +1,26 @@
 """``python -m apex_tpu.analysis`` — the repo's static-analysis gate.
 
-Runs the AST lint rules over apex_tpu/ + examples/ and the four jaxpr
-passes (precision / donation / collective-safety / host-sync) over the
-in-repo GPT and BERT step builders on a CPU dp2xtp2 mesh, then applies
-the documented allowlist (analysis/allowlist.py). Exit status:
+Runs the AST lint rules over apex_tpu/ + examples/ and the jaxpr passes
+(precision / donation / collective-safety / host-sync) PLUS the
+compiled-HLO passes (the hlo-comms ghost-collective differ and the
+hlo-sharding replication auditor) over the in-repo GPT and BERT step
+builders on a CPU dp2xtp2 mesh, then applies the documented allowlist
+(analysis/allowlist.py). Exit status:
 
 - 0 — clean: every finding suppressed by a reason-carrying entry and no
   entry gone stale;
 - 1 — unallowlisted findings (or stale allowlist entries) — the report
-  lists each with rule, site, and message.
+  lists each with rule, site, and message. In particular any collective
+  in the optimized HLO that is neither matched to a ledger prediction
+  nor allowlisted with a reason fails the gate.
 
 No step executes: precision/collective/host-sync work on abstract
-traces; only the donation auditor pays a compile (seconds for the tiny
-targets). The tier-1 self-check (tests/test_analysis.py) runs this exact
-entry point and asserts exit 0, so a PR introducing a silent promotion,
-a broken donation, or a stray ``debug.print`` in a step fails fast.
+traces; the donation and HLO passes share ONE ``.lower().compile()``
+per target (seconds for the tiny targets, CPU-safe). The tier-1
+self-check (tests/test_analysis.py) runs this exact entry point and
+asserts exit 0, so a PR introducing a silent promotion, a broken
+donation, a resharding leak, or a stray ``debug.print`` in a step
+fails fast.
 
 Flags: ``--verbose`` also prints suppressed findings with their reasons;
 ``--json PATH`` appends every finding as a ``kind="analysis"`` record to

@@ -28,13 +28,13 @@ the params/opt-state buffer someone forgot, which is a whole extra copy
 of the model in HBM.
 """
 
-import re
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Optional, Sequence
 
 import jax
 import numpy as np
 
 from apex_tpu.analysis.findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+from apex_tpu.analysis.hlo.parser import mlir_marked_aliases, realized_aliases
 from apex_tpu.analysis.passes import jaxpr_pass
 
 __all__ = ["audit_donation", "donation_pass"]
@@ -74,75 +74,6 @@ def _donated_leaf_indices(args, donate_argnums) -> set:
     return donated
 
 
-def _main_signature(mlir_text: str) -> Optional[str]:
-    """The argument list of the entry ``@main`` func, by paren matching."""
-    m = re.search(r"func\.func\s+public\s+@main\s*\(", mlir_text)
-    if m is None:
-        return None
-    depth, start = 1, m.end()
-    for i in range(start, len(mlir_text)):
-        c = mlir_text[i]
-        if c == "(":
-            depth += 1
-        elif c == ")":
-            depth -= 1
-            if depth == 0:
-                return mlir_text[start:i]
-    return None
-
-
-def _marked_aliases(
-    mlir_text: str,
-) -> Tuple[Optional[Dict[int, Optional[int]]], int]:
-    """``{param_index: output_index_or_None}`` for parameters jax marked
-    donated, plus the entry parameter count. jax spells the mark two
-    ways: ``tf.aliasing_output = N`` when it matched the donated input to
-    output N itself, or ``jax.buffer_donor = true`` when it hands XLA the
-    buffer and lets the compiler pick the alias (value None). (None, 0)
-    when the signature cannot be found."""
-    sig = _main_signature(mlir_text)
-    if sig is None:
-        return None, 0
-    marked: Dict[int, Optional[int]] = {}
-    chunks = re.split(r"%arg(\d+)\s*:", sig)
-    # chunks: [prefix, idx0, body0, idx1, body1, ...]
-    nparams = 0
-    for i in range(1, len(chunks) - 1, 2):
-        param = int(chunks[i])
-        nparams = max(nparams, param + 1)
-        m = re.search(r"tf\.aliasing_output\s*=\s*(\d+)", chunks[i + 1])
-        if m:
-            marked[param] = int(m.group(1))
-        elif re.search(r"jax\.buffer_donor\s*=\s*true", chunks[i + 1]):
-            marked[param] = None
-    return marked, nparams
-
-
-def _realized_aliases(hlo_text: str) -> Dict[int, int]:
-    """``{param_index: output_index}`` from the optimized HLO module's
-    ``input_output_alias`` config (absent section = nothing realized)."""
-    m = re.search(r"input_output_alias=\{", hlo_text)
-    if m is None:
-        return {}
-    depth, start = 1, m.end()
-    end = start
-    for i in range(start, len(hlo_text)):
-        c = hlo_text[i]
-        if c == "{":
-            depth += 1
-        elif c == "}":
-            depth -= 1
-            if depth == 0:
-                end = i
-                break
-    section = hlo_text[start:end]
-    realized: Dict[int, int] = {}
-    for mm in re.finditer(r"\{([\d ,]*)\}:\s*\((\d+)", section):
-        out_idx = int(mm.group(1).split(",")[0]) if mm.group(1).strip() else 0
-        realized[int(mm.group(2))] = out_idx
-    return realized
-
-
 def audit_donation(
     fn,
     *args,
@@ -150,6 +81,9 @@ def audit_donation(
     min_donatable_bytes: int = DEFAULT_MIN_DONATABLE_BYTES,
     arg_names: Optional[Sequence[str]] = None,
     target: str = "",
+    lowered=None,
+    compiled=None,
+    hlo_module=None,
 ) -> List[Finding]:
     """Audit one step's donation story; see the module docstring.
 
@@ -158,34 +92,66 @@ def audit_donation(
     map 1:1 onto flat input leaves) or an already-jitted function whose
     own ``donate_argnums`` are used (pass nothing). Args may be arrays
     or ``ShapeDtypeStruct``s — nothing executes, but the step IS
-    compiled.
+    compiled (pass ``lowered``/``compiled`` to reuse an existing AOT
+    pair — the CLI's shared per-target compile; ``hlo_module``, a
+    parsed :class:`~apex_tpu.analysis.hlo.parser.HloModule` of that
+    same compiled, additionally skips re-serializing the optimized HLO
+    for the realized aliases). The MLIR/HLO scraping itself lives in
+    ``analysis/hlo/parser.py``, the one blessed home of ``.as_text()``
+    parsing.
     """
+    if compiled is not None and lowered is None:
+        raise ValueError(
+            "pass lowered alongside compiled — the donation marks (from "
+            "lowered) and the realized aliases (from compiled) must come "
+            "from the same AOT pair"
+        )
     if donate_argnums is None:
         if not hasattr(fn, "lower"):
             raise ValueError(
                 "donate_argnums is required for a non-jitted step function"
             )
-        lowered = fn.lower(*args)
-        compiled = lowered.compile()
+        if lowered is None:
+            lowered = fn.lower(*args)
+        if compiled is None:
+            compiled = lowered.compile()
         # Compiled.donate_argnums reports FLAT input-leaf indices (not the
         # user-level argnums the jit was built with) — exactly the set we
         # need, no tree math
         requested = set(compiled.donate_argnums)
     else:
         donate_argnums = tuple(donate_argnums)
-        lowered = jax.jit(
-            fn, donate_argnums=donate_argnums, keep_unused=True
-        ).lower(*args)
-        compiled = lowered.compile()
+        if lowered is None:
+            lowered = jax.jit(
+                fn, donate_argnums=donate_argnums, keep_unused=True
+            ).lower(*args)
+        if compiled is None:
+            compiled = lowered.compile()
         requested = _donated_leaf_indices(args, set(donate_argnums))
 
     labels = _leaf_labels(args, arg_names)
     in_leaves = jax.tree_util.tree_leaves(args)
-    marked, nparams = _marked_aliases(lowered.as_text())
-    realized = _realized_aliases(compiled.as_text())
+    marked, nparams = mlir_marked_aliases(lowered)
 
     findings: List[Finding] = []
     site = f"<step:{target or getattr(fn, '__name__', 'fn')}>"
+    if hlo_module is not None:
+        realized = dict(hlo_module.input_output_alias)
+    else:
+        try:
+            realized = realized_aliases(compiled)
+        except ValueError as e:
+            # malformed/unexpected HLO text: report honestly instead of
+            # crashing the gate or guessing an empty alias map
+            findings.append(Finding(
+                rule="donation.unverifiable",
+                message=(
+                    f"optimized HLO input_output_alias section could "
+                    f"not be parsed ({e}) — donation not verified"
+                ),
+                site=site, severity=SEV_INFO, target=target,
+            ))
+            return findings
     if marked is None or nparams != len(in_leaves):
         # pruned/unparseable parameter list: leaf<->parameter numbering no
         # longer lines up, so report honestly instead of guessing
@@ -270,7 +236,13 @@ def donation_pass(ctx) -> Iterable[Finding]:
         names = list(inspect.signature(ctx.fn).parameters)
     except (TypeError, ValueError):
         names = None
+    lowered, compiled = ctx.aot()
+    try:
+        hlo_module = ctx.hlo_module()
+    except ValueError:
+        hlo_module = None  # audit_donation reports unverifiable itself
     return audit_donation(
         ctx.fn, *ctx.args, donate_argnums=ctx.donate_argnums,
         arg_names=names, target=ctx.name,
+        lowered=lowered, compiled=compiled, hlo_module=hlo_module,
     )

@@ -1,0 +1,71 @@
+"""Compiled-HLO static analysis: read what XLA actually emitted.
+
+The trace-time passes (precision/collective/host-sync) and the xray
+ledger see what the program ASKS for; this subpackage audits what the
+compiler DID. One shared, nesting-safe HLO text parser
+(:mod:`~apex_tpu.analysis.hlo.parser` — the single ``.as_text()``
+scraping home, lint-enforced), a ``replica_groups`` -> mesh-axis
+attribution layer (:mod:`~apex_tpu.analysis.hlo.attribution`), the
+ghost-collective differ (:mod:`~apex_tpu.analysis.hlo.comms_diff`,
+emitted vs ledger-predicted traffic), and the entry-sharding auditor
+(:mod:`~apex_tpu.analysis.hlo.sharding_audit`, >=1MiB replicated
+buffers on a parallel mesh). The two audits register as jaxpr passes
+(``hlo-comms`` / ``hlo-sharding``) so ``run_passes`` and the
+``python -m apex_tpu.analysis`` gate pick them up with everything else.
+
+Lazy attribute access (PEP 562), same contract as the parent package:
+importing ``apex_tpu.analysis.hlo`` must not initialize jax (the parser
+and attribution are jax-free; the audits import jax on use).
+"""
+
+_EXPORTS = {
+    # parser (jax-free)
+    "HloModule": "parser",
+    "HloCollective": "parser",
+    "HloParam": "parser",
+    "HloShape": "parser",
+    "HloSharding": "parser",
+    "COLLECTIVE_KINDS": "parser",
+    "parse_hlo_module": "parser",
+    "module_text": "parser",
+    "realized_aliases": "parser",
+    "mlir_marked_aliases": "parser",
+    "mlir_main_signature": "parser",
+    "balanced": "parser",
+    # attribution (numpy only)
+    "mesh_axis_partitions": "attribution",
+    "classify_replica_groups": "attribution",
+    "classify_source_target_pairs": "attribution",
+    "canon_axis_key": "attribution",
+    "AXIS_NONE": "attribution",
+    "AXIS_UNKNOWN": "attribution",
+    # audits
+    "audit_comms": "comms_diff",
+    "OP_CLASS": "comms_diff",
+    "audit_entry_shardings": "sharding_audit",
+}
+
+__all__ = sorted(_EXPORTS) + [
+    "parser", "attribution", "comms_diff", "sharding_audit",
+]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        mod = importlib.import_module(
+            f"apex_tpu.analysis.hlo.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apex_tpu.analysis.hlo.{name}")
+    raise AttributeError(
+        f"module 'apex_tpu.analysis.hlo' has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

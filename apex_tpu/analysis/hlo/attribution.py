@@ -1,0 +1,139 @@
+"""``replica_groups`` -> mesh-axis attribution.
+
+An HLO collective carries no axis names — only its ``replica_groups``
+partition of partition ids. But for a given mesh every subset of mesh
+axes induces exactly one partition (the groups that vary along those
+axes and agree on all others), so the mapping can be inverted: build
+the partition for every subset of >1-sized axes and look the observed
+groups up. A group set matching no subset is ``"unknown"`` — XLA
+invented communication along a shape the program's mesh does not
+express (the classic symptom of a bad resharding).
+
+Partition ids: XLA's ``use_global_device_ids`` groups index the
+device assignment, which jax builds in ``mesh.devices`` flattened
+(row-major) order — attribution therefore works on POSITIONS in the
+flattened mesh, never on ``Device.id`` (the two coincide on the common
+contiguous meshes but not on sub-meshes or reordered topologies).
+
+Size-1 axes are dropped everywhere: a collective over them moves no
+bytes (the ledger elides them; XLA emits singleton groups), and a
+composite like ``("pp","cp","tp")`` on a pp=cp=1 mesh canonicalizes to
+``"tp"`` so both sides of the differ bucket identically.
+"""
+
+import itertools
+from typing import Dict, FrozenSet, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "AXIS_NONE",
+    "AXIS_UNKNOWN",
+    "mesh_axis_partitions",
+    "classify_replica_groups",
+    "classify_source_target_pairs",
+    "canon_axis_key",
+]
+
+#: singleton groups: no traffic (a collective over a size-1 axis)
+AXIS_NONE = "none"
+#: a group set matching no subset of the mesh's axes
+AXIS_UNKNOWN = "unknown"
+
+GroupKey = FrozenSet[FrozenSet[int]]
+
+
+def _live_axes(mesh) -> Tuple[str, ...]:
+    shape = dict(mesh.shape)
+    return tuple(n for n in mesh.axis_names if shape[n] > 1)
+
+
+def mesh_axis_partitions(mesh) -> Dict[GroupKey, str]:
+    """``{replica-group partition: axis label}`` for every non-empty
+    subset of the mesh's >1-sized axes. Labels join subset names in
+    mesh order (``"dp,tp"``). Degenerate subsets that induce the same
+    partition keep the smallest label (fewest axes)."""
+    shape = dict(mesh.shape)
+    names = list(mesh.axis_names)
+    sizes = [shape[n] for n in names]
+    ids = np.arange(int(np.prod(sizes, dtype=np.int64))).reshape(sizes)
+    live = _live_axes(mesh)
+    out: Dict[GroupKey, str] = {}
+    for r in range(1, len(live) + 1):
+        for subset in itertools.combinations(live, r):
+            axes = [names.index(n) for n in subset]
+            rest = [i for i in range(len(names)) if i not in axes]
+            group_size = int(np.prod([sizes[i] for i in axes], dtype=np.int64))
+            arr = ids.transpose(rest + axes).reshape(-1, group_size)
+            key: GroupKey = frozenset(
+                frozenset(int(x) for x in row) for row in arr
+            )
+            # setdefault: smaller subsets come first, so a partition
+            # reachable with fewer axes keeps the shorter label
+            out.setdefault(key, ",".join(subset))
+    return out
+
+
+def classify_replica_groups(
+    mesh, replica_groups: Sequence[Sequence[int]],
+    partitions: Dict[GroupKey, str] = None,
+) -> str:
+    """The mesh-axis label of one collective's ``replica_groups``:
+    an axis-subset label (``"tp"``, ``"dp,tp"``), :data:`AXIS_NONE`
+    for singleton groups (no traffic), or :data:`AXIS_UNKNOWN`."""
+    if not replica_groups:
+        # implicit "everyone": the full-mesh subset (or no traffic on a
+        # single-device mesh)
+        live = _live_axes(mesh)
+        return ",".join(live) if live else AXIS_NONE
+    if len(replica_groups[0]) <= 1:
+        return AXIS_NONE
+    if partitions is None:
+        partitions = mesh_axis_partitions(mesh)
+    key: GroupKey = frozenset(
+        frozenset(int(x) for x in g) for g in replica_groups
+    )
+    return partitions.get(key, AXIS_UNKNOWN)
+
+
+def classify_source_target_pairs(
+    mesh, pairs: Sequence[Sequence[int]],
+    partitions: Dict[GroupKey, str] = None,
+) -> str:
+    """The mesh-axis label of a collective-permute's
+    ``source_target_pairs`` (permutes print pairs, not replica_groups).
+
+    A permute belongs to axis subset S when every (src, dst) edge stays
+    inside one group of S's partition — i.e. the endpoints differ only
+    along S. The SMALLEST such subset wins (a pp-edge permute also fits
+    inside the dp,pp partition; "pp" is the informative answer).
+    Returns :data:`AXIS_NONE` for an empty pair list (ships nothing)
+    and :data:`AXIS_UNKNOWN` when no subset contains every edge."""
+    if not pairs:
+        return AXIS_NONE
+    if partitions is None:
+        partitions = mesh_axis_partitions(mesh)
+    # smallest subsets first: fewest axes, then mesh order via the label
+    for key, label in sorted(
+        partitions.items(), key=lambda kv: (kv[1].count(",") + 1, kv[1])
+    ):
+        if all(
+            any(int(s) in g and int(d) in g for g in key)
+            for s, d in pairs
+        ):
+            return label
+    return AXIS_UNKNOWN
+
+
+def canon_axis_key(mesh, axis_key: str) -> str:
+    """Canonicalize a ledger axis key (names joined in CALL order, e.g.
+    ``"pp,cp,tp"``) onto the attribution labels: drop size-1 axes, order
+    by mesh axis order. Names the mesh does not know are kept (sorted
+    last) so a mismatch stays visible instead of aliasing to a real
+    axis."""
+    names = [n for n in axis_key.split(",") if n]
+    shape = dict(mesh.shape)
+    known = [n for n in mesh.axis_names if n in names and shape[n] > 1]
+    foreign = sorted(n for n in names if n not in shape)
+    out = known + foreign
+    return ",".join(out) if out else AXIS_NONE

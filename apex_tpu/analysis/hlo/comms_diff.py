@@ -1,0 +1,375 @@
+"""Ghost-collective differ: XLA's emitted collectives vs the ledger.
+
+The xray ledger (monitor/xray/ledger.py) predicts a step's collective
+traffic at TRACE time — what the program asked for. XLA is free to ask
+for more (resharding all-gathers at sharding boundaries, the implicit
+weight-update replication of arXiv:2004.13336) or less (CSE folds
+duplicate reductions, dead traffic is deleted), and the compiled-HLO
+collective layer is where the real comms cost is decided
+(arXiv:2506.17615). This pass compiles the step once, parses the
+optimized HLO (hlo/parser.py), attributes every collective's
+``replica_groups`` to mesh axes (hlo/attribution.py), and diffs the two
+sides:
+
+- ``comms.unpredicted`` (error) — XLA emitted traffic the ledger never
+  saw: a resharding leak, an uninstrumented collective, or a
+  transpose-synthesized backward op whose forward was not custom_vjp
+  paired (the ledger docstring's disclaimed blind spot — now loud).
+- ``comms.reshard``     (error) — unpredicted traffic with no user
+  source frame (or a ``sharding_constraint`` scope): inserted by the
+  SPMD partitioner at a jit/shard_map boundary, reported with the
+  non-replicated entry shardings that induced it.
+- ``comms.vanished``    (warning) — a predicted traffic bucket with NO
+  emitted counterpart: the program asks for collectives XLA deletes
+  wholesale — dead traffic to remove at source.
+- ``comms.folded``      (info) — a bucket where XLA emitted FEWER ops
+  than predicted but not zero: CSE/combining legitimately dedupes
+  identical reductions (the CE-stats psum pair in the GPT target), so
+  a partial shortfall is bookkeeping, not a defect.
+- ``comms.unverifiable``(info) — the HLO could not be parsed or no mesh
+  is available for attribution; callers promising verification (the
+  examples' ``--audit-comms``) must treat this as NOT ok.
+
+Matching currency is (op-class, mesh axis, OPERAND element count) —
+elements, not bytes, because backends legalize dtypes without changing
+element counts (CPU XLA widens bf16 collectives to f32; matching bytes
+would break the CPU gate). Byte totals of both sides are carried in the
+finding data for the reports. A vmap-batched collective (the examples'
+microbatch loops under ``xray.scaled(n)``) emits ONE op moving ``n``
+predicted payloads, so after exact matching, leftover HLO ops may
+consume ``k = elements_hlo / elements_pred`` predictions of a matching
+bucket. Collectives inside while/scan bodies appear once in text
+however many times the loop runs — the same trace-once convention the
+ledger's ``scaled()`` regions use, so the two sides agree per traced
+occurrence.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARNING,
+)
+from apex_tpu.analysis.hlo import attribution
+from apex_tpu.analysis.hlo import parser as hlo_parser
+from apex_tpu.analysis.passes import _relsite, jaxpr_pass
+
+__all__ = ["OP_CLASS", "audit_comms", "hlo_comms_pass"]
+
+#: ledger op -> optimized-HLO opcode class
+OP_CLASS = {
+    "psum": "all-reduce",
+    "pmean": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "all_gather": "all-gather",
+    "psum_scatter": "reduce-scatter",
+    "ppermute": "collective-permute",
+    "all_to_all": "all-to-all",
+}
+
+BucketKey = Tuple[str, str, int]  # (op class, axis label, operand elements)
+
+
+@dataclasses.dataclass
+class _Unit:
+    """One matchable emitted payload: one operand of one collective."""
+
+    kind: str
+    axis: str
+    elements: int
+    nbytes: int
+    dtype: str
+    dims: Tuple[int, ...]
+    instr: hlo_parser.HloCollective
+
+    @property
+    def key(self) -> BucketKey:
+        return (self.kind, self.axis, self.elements)
+
+
+def _aot_compile(fn, args, donate_argnums):
+    """The auditors' shared compile recipe — :func:`lower_step`, so a
+    standalone ``audit_comms`` call reads the exact module the donation
+    auditor and the CLI's ``ctx.aot()`` would."""
+    from apex_tpu.analysis.passes import lower_step
+
+    return lower_step(fn, args, donate_argnums).compile()
+
+
+def _predicted_buckets(fn, args, mesh) -> Dict[BucketKey, int]:
+    from apex_tpu.monitor.xray import ledger as xlax
+
+    led = xlax.predict_comms(fn, *args)
+    pred: Dict[BucketKey, int] = {}
+    for e in led.entries:
+        axis = attribution.canon_axis_key(mesh, e.axis)
+        if axis == attribution.AXIS_NONE:
+            continue
+        elements = int(np.prod(e.shape, dtype=np.int64)) if e.shape else 1
+        key = (OP_CLASS.get(e.op, e.op), axis, elements)
+        pred[key] = pred.get(key, 0) + e.count
+    return pred
+
+
+def _emitted_units(module: hlo_parser.HloModule, mesh) -> List[_Unit]:
+    partitions = attribution.mesh_axis_partitions(mesh)
+    units: List[_Unit] = []
+    for c in module.collectives:
+        if c.kind == "collective-permute":
+            # permutes print source_target_pairs, not replica_groups
+            axis = attribution.classify_source_target_pairs(
+                mesh, c.source_target_pairs, partitions
+            )
+        else:
+            axis = attribution.classify_replica_groups(
+                mesh, c.replica_groups, partitions
+            )
+        if axis == attribution.AXIS_NONE:
+            continue  # singleton groups / empty perm: zero bytes, the
+            # ledger elides these too
+        for op in c.operands:
+            units.append(_Unit(
+                kind=c.kind, axis=axis, elements=op.elements,
+                nbytes=op.nbytes, dtype=op.shape.dtype,
+                dims=op.shape.dims, instr=c,
+            ))
+    return units
+
+
+def _is_ledger_sited(instr: hlo_parser.HloCollective) -> bool:
+    return instr.source_file.replace("\\", "/").endswith(
+        "monitor/xray/ledger.py"
+    )
+
+
+def _site(instr: hlo_parser.HloCollective, target: str) -> str:
+    if instr.source_file:
+        return _relsite(instr.source_file, instr.source_line)
+    return f"<hlo:{target or 'step'}>"
+
+
+def _entry_sharding_summary(
+    module: hlo_parser.HloModule, limit: int = 8
+) -> List[str]:
+    """The non-replicated entry shardings — the boundary state that
+    induces partitioner resharding — as compact strings."""
+    out = []
+    for p in module.entry_params:
+        if p.sharding is not None and not p.sharding.fully_replicated:
+            out.append(f"{p.label or p.name}: {p.sharding.raw}")
+            if len(out) >= limit:
+                break
+    return out
+
+
+def audit_comms(
+    fn,
+    *args,
+    mesh,
+    donate_argnums: Optional[Tuple[int, ...]] = None,
+    target: str = "",
+    compiled=None,
+    module=None,
+) -> List[Finding]:
+    """Diff ``fn``'s optimized-HLO collectives against the ledger's
+    trace-time prediction; see the module docstring for the rules.
+
+    ``fn``/``args`` follow :func:`~apex_tpu.analysis.donation.audit_donation`:
+    a plain step function or a jitted one, args may be
+    ``ShapeDtypeStruct``s. ``compiled`` short-circuits the (seconds)
+    compile when the caller already has the executable; ``module``
+    additionally short-circuits the text + parse (the CLI's shared
+    ``ctx.hlo_module()`` — on a real model the HLO text is tens of MB).
+    """
+    site0 = f"<step:{target or getattr(fn, '__name__', 'fn')}>"
+    if mesh is None:
+        return [Finding(
+            rule="comms.unverifiable",
+            message=(
+                "no mesh available — replica_groups cannot be attributed "
+                "to axes, comms NOT verified"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+        )]
+    if module is None:
+        if compiled is None:
+            compiled = _aot_compile(fn, args, donate_argnums)
+        try:
+            module = hlo_parser.parse_hlo_module(
+                hlo_parser.module_text(compiled)
+            )
+        except ValueError as e:
+            return [Finding(
+                rule="comms.unverifiable",
+                message=(
+                    f"optimized HLO could not be parsed ({e}) — comms "
+                    f"NOT verified (parser out of date for this XLA?)"
+                ),
+                site=site0, severity=SEV_INFO, target=target,
+            )]
+    if not module.entry_name:
+        return [Finding(
+            rule="comms.unverifiable",
+            message=(
+                "optimized HLO has no recognizable entry computation — "
+                "comms NOT verified (parser out of date for this XLA?)"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+        )]
+
+    pred = _predicted_buckets(fn, args, mesh)
+    units = _emitted_units(module, mesh)
+    emitted_keys = {u.key for u in units}
+
+    findings: List[Finding] = []
+    remaining = dict(pred)
+    consumed_any: Dict[BucketKey, bool] = {k: False for k in pred}
+
+    # stage 1 — exact bucket matches; ledger-sited instructions consume
+    # predictions first so any excess is reported at the site that is
+    # NOT the wrapper (the transpose/reshard site a human must look at)
+    leftovers: List[_Unit] = []
+    for u in sorted(
+        units,
+        key=lambda u: (not _is_ledger_sited(u.instr), u.instr.line),
+    ):
+        if remaining.get(u.key, 0) > 0:
+            remaining[u.key] -= 1
+            consumed_any[u.key] = True
+        else:
+            leftovers.append(u)
+
+    # stage 2 — batched reconcile: a vmapped microbatch loop batches n
+    # traced collectives into ONE op moving an n-stack of the predicted
+    # payload, so its operand dims factor as (batch..., payload...).
+    # Only leading-dim splits are candidates — element divisibility
+    # alone would let a GENUINE unpredicted op (a reshard leak whose
+    # size coincidentally equals k*e of some bucket) be consumed as
+    # batching, masking exactly the error class the gate exists for.
+    unmatched: List[_Unit] = []
+    for u in leftovers:
+        candidates = []
+        for j in range(1, len(u.dims) + 1):
+            k = int(np.prod(u.dims[:j], dtype=np.int64))
+            e = int(np.prod(u.dims[j:], dtype=np.int64))
+            if k > 1 and remaining.get((u.kind, u.axis, e), 0) >= k:
+                candidates.append((e, k))
+        if candidates:
+            # smallest payload = largest batch factor: vmap batches the
+            # WHOLE microbatch loop, so the right bucket is the one this
+            # op covers k=n_micro times over — a larger-e candidate is a
+            # coincidental split (seen: a (4,1,32) CE-stats op is 4x32,
+            # not 2x64 of an unrelated layernorm bucket)
+            e, k = min(candidates)
+            key = (u.kind, u.axis, e)
+            remaining[key] -= k
+            consumed_any[key] = True
+        else:
+            unmatched.append(u)
+
+    # stage 3 — emitted-but-never-predicted: the gate's raison d'etre
+    for u in unmatched:
+        instr = u.instr
+        is_reshard = (
+            not instr.source_file or "sharding_constraint" in instr.op_name
+        )
+        is_transpose = "transpose(" in instr.op_name
+        data = {
+            "op": u.kind, "axis": u.axis, "elements": u.elements,
+            "hlo_bytes": u.nbytes, "hlo_dtype": u.dtype,
+            "groups": len(instr.replica_groups),
+            "group_size": (
+                instr.group_size or int(np.prod(
+                    [s for _, s in mesh.shape.items()], dtype=np.int64))
+            ),
+        }
+        if instr.kind == "collective-permute":
+            data["pairs"] = len(instr.source_target_pairs)
+        if instr.channel_id is not None:
+            data["channel_id"] = instr.channel_id
+        if is_reshard:
+            shardings = _entry_sharding_summary(module)
+            findings.append(Finding(
+                rule="comms.reshard",
+                message=(
+                    f"partitioner-inserted {u.kind} over {u.axis!r} "
+                    f"({u.elements} el, {u.nbytes} B {u.dtype}) with no "
+                    f"ledger prediction: XLA reshards at a jit/shard_map "
+                    f"boundary; non-replicated entry shardings: "
+                    f"{'; '.join(shardings) or '(none annotated)'}"
+                ),
+                site=_site(instr, target), severity=SEV_ERROR,
+                target=target,
+                data=dict(data, entry_shardings=shardings),
+            ))
+        else:
+            why = (
+                "transpose-synthesized backward collective the ledger "
+                "cannot see (no custom_vjp pairing on the forward)"
+                if is_transpose else
+                "resharding leak or uninstrumented collective"
+            )
+            findings.append(Finding(
+                rule="comms.unpredicted",
+                message=(
+                    f"XLA emitted {u.kind} over {u.axis!r} "
+                    f"({u.elements} el, {u.nbytes} B {u.dtype}) that "
+                    f"matches no ledger prediction — {why}"
+                ),
+                site=_site(instr, target), severity=SEV_ERROR,
+                target=target, data=dict(data, transpose=is_transpose),
+            ))
+
+    # stage 4 — predicted-but-not-emitted
+    for key, n in sorted(remaining.items(), key=str):
+        if n <= 0:
+            continue
+        cls, axis, elements = key
+        if consumed_any.get(key) or key in emitted_keys:
+            findings.append(Finding(
+                rule="comms.folded",
+                message=(
+                    f"{n} predicted {cls} over {axis!r} ({elements} el) "
+                    f"beyond what XLA emitted — CSE/combining folded "
+                    f"duplicate reductions (bookkeeping, not a defect)"
+                ),
+                site=site0, severity=SEV_INFO, target=target, count=n,
+                data={"op": cls, "axis": axis, "elements": elements},
+            ))
+        else:
+            findings.append(Finding(
+                rule="comms.vanished",
+                message=(
+                    f"{n} predicted {cls} over {axis!r} ({elements} el) "
+                    f"never appear in the optimized HLO — dead traffic "
+                    f"the program should stop asking for"
+                ),
+                site=site0, severity=SEV_WARNING, target=target, count=n,
+                data={"op": cls, "axis": axis, "elements": elements},
+            ))
+    return findings
+
+
+@jaxpr_pass("hlo-comms")
+def hlo_comms_pass(ctx) -> List[Finding]:
+    """The registered-pass wrapper: reuses the target's shared AOT
+    compile AND its parsed module (one ``.lower().compile()`` + one
+    text/parse serve donation + both HLO passes)."""
+    if ctx.mesh is None:
+        return []
+    _, compiled = ctx.aot()
+    try:
+        module = ctx.hlo_module()
+    except ValueError:
+        module = None  # audit_comms re-parses and reports unverifiable
+    return audit_comms(
+        ctx.fn, *ctx.args, mesh=ctx.mesh,
+        donate_argnums=ctx.donate_argnums, target=ctx.name,
+        compiled=compiled, module=module,
+    )

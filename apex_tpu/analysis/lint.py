@@ -35,6 +35,11 @@ Plus the new rules this framework exists to host:
   f64 at a fraction of rate, and a single f64 literal poisons every
   dtype downstream of it. (Host-side ``np.float64`` index math is fine
   and not flagged.)
+- ``lint.hlo-text``   — no ``.as_text()`` scraping outside
+  ``analysis/hlo/parser.py``: the brace-aware parser is the single home
+  of HLO/MLIR text parsing (its ``module_text`` helper is the one
+  blessed ``.as_text`` call site), so ad-hoc regexes over compiler
+  output cannot quietly rot when XLA's printer changes.
 """
 
 import ast
@@ -264,6 +269,36 @@ def jit_donate(ctx: LintContext) -> Iterable[Finding]:
                         site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                         data={"keyword": kw.arg},
                     )
+
+
+@lint_rule("lint.hlo-text", scopes=("apex_tpu/", "examples/"))
+def hlo_text(ctx: LintContext) -> Iterable[Finding]:
+    """``.as_text`` attribute access outside the blessed parser.
+
+    Token-based so a docstring MENTIONING ``.as_text()`` (this one, the
+    parser's) does not trip it; the rule keys on the NAME token
+    preceded by a ``.`` operator."""
+    for rel, src in sorted(ctx.files.items()):
+        toks = ctx.tokens(src)
+        for i in range(1, len(toks)):
+            if (
+                toks[i].type == tokenize.NAME
+                and toks[i].string == "as_text"
+                and toks[i - 1].string == "."
+            ):
+                yield Finding(
+                    rule="lint.hlo-text",
+                    message=(
+                        "ad-hoc .as_text() scraping outside "
+                        "apex_tpu/analysis/hlo/parser.py — hand the "
+                        "Lowered/Compiled object to the shared parser "
+                        "(module_text / parse_hlo_module / "
+                        "realized_aliases) so HLO text parsing has one "
+                        "nesting-safe home"
+                    ),
+                    site=f"{rel}:{toks[i].start[0]}",
+                    severity=SEV_ERROR,
+                )
 
 
 @lint_rule("lint.float64", scopes=("apex_tpu/",))

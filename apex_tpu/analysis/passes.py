@@ -44,6 +44,7 @@ __all__ = [
     "StepTarget",
     "iter_eqns",
     "eqn_site",
+    "lower_step",
     "run_passes",
 ]
 
@@ -136,6 +137,28 @@ def iter_eqns(jaxpr) -> Iterator[Any]:
             yield from iter_eqns(sub)
 
 
+def lower_step(fn, args, donate_argnums=None):
+    """The auditors' ONE AOT lowering recipe (donation, the HLO comms
+    differ, the sharding auditor all read products of this — keep them
+    agreeing):
+
+    - a DECLARED donation intent always builds a fresh
+      ``jax.jit(fn, donate_argnums=..., keep_unused=True)``, even over a
+      prejitted ``fn`` — keep_unused makes HLO parameters map 1:1 onto
+      flat input leaves, which the donation auditor's indexing needs;
+    - otherwise a prejitted ``fn`` lowers as-is (its own donation marks
+      are the thing under audit), and a plain function gets
+      ``keep_unused=True`` with no donation.
+    """
+    if donate_argnums:
+        return jax.jit(
+            fn, donate_argnums=tuple(donate_argnums), keep_unused=True
+        ).lower(*args)
+    if hasattr(fn, "lower"):  # only jit stages carry .lower
+        return fn.lower(*args)
+    return jax.jit(fn, keep_unused=True).lower(*args)
+
+
 @dataclasses.dataclass
 class StepTarget:
     """A step function prepared for auditing: what the CLI and tests hand
@@ -163,6 +186,8 @@ class StepContext:
     def __init__(self, target: StepTarget):
         self.target = target
         self._jaxpr = None
+        self._aot = None
+        self._hlo_module = None
 
     @property
     def name(self) -> str:
@@ -205,6 +230,36 @@ class StepContext:
             self._jaxpr = jax.make_jaxpr(fn)(*self.args)
         return self._jaxpr
 
+    def aot(self):
+        """``(lowered, compiled)`` of the step, built once and shared by
+        every pass that reads compile products (donation, the HLO comms
+        differ, the sharding auditor) — the compile is the only
+        non-tracing cost in the whole gate, so it is paid once per
+        target. Lowering follows :func:`lower_step` exactly (declared
+        donation intent wins, ``keep_unused=True`` for 1:1 leaf↔param
+        mapping) so every consumer reads the same module."""
+        if self._aot is None:
+            lowered = lower_step(self.fn, self.args, self.donate_argnums)
+            self._aot = (lowered, lowered.compile())
+        return self._aot
+
+    def hlo_module(self):
+        """The parsed optimized-HLO module of :meth:`aot`'s executable,
+        parsed once and shared by every compile-product pass (donation's
+        realized aliases, the comms differ, the sharding auditor) — on a
+        real model ``.as_text()`` serializes tens of MB, so text + parse
+        are paid once per target, like the compile itself. Raises
+        ``ValueError`` on unparseable HLO; callers downgrade that to
+        their own unverifiable outcome."""
+        if self._hlo_module is None:
+            from apex_tpu.analysis.hlo import parser as hlo_parser
+
+            _, compiled = self.aot()
+            self._hlo_module = hlo_parser.parse_hlo_module(
+                hlo_parser.module_text(compiled)
+            )
+        return self._hlo_module
+
     def iter_eqns(self) -> Iterator[Any]:
         return iter_eqns(self.jaxpr)
 
@@ -245,3 +300,5 @@ from apex_tpu.analysis import precision as _precision  # noqa: E402,F401
 from apex_tpu.analysis import donation as _donation  # noqa: E402,F401
 from apex_tpu.analysis import collectives as _collectives  # noqa: E402,F401
 from apex_tpu.analysis import host_sync as _host_sync  # noqa: E402,F401
+from apex_tpu.analysis.hlo import comms_diff as _comms_diff  # noqa: E402,F401
+from apex_tpu.analysis.hlo import sharding_audit as _sharding_audit  # noqa: E402,F401

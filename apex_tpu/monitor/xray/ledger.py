@@ -36,7 +36,11 @@ rules synthesize from non-custom_vjp code — chiefly the reversed
 forward edges one-for-one (double the pp numbers by hand for fwd+bwd).
 A jit-CACHED call traces nothing: trace under the ledger via
 :func:`predict_comms` (eval_shape — no compute, no devices needed) or
-call the un-cached function once inside the context.
+call the un-cached function once inside the context. The transpose
+blind spot is audited downstream: the compiled-HLO differ
+(``apex_tpu.analysis.hlo.comms_diff``, the ``hlo-comms`` pass)
+cross-checks what XLA actually emitted against this ledger's
+prediction and flags anything unpredicted.
 
 Static axis-size queries (``psum(1, axis)``) move no bytes — XLA folds
 them to a constant — and are NOT recorded; call sites use

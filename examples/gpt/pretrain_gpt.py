@@ -125,6 +125,11 @@ def parse_args():
                         "realized input/output aliasing "
                         "(apex_tpu.analysis) before training; emits "
                         "kind='analysis' records")
+    p.add_argument("--audit-comms", action="store_true",
+                   help="diff the optimized HLO's collectives against "
+                        "the xray ledger's prediction (ghost-collective "
+                        "differ, apex_tpu.analysis.hlo) before training; "
+                        "emits kind='analysis' records")
     # fault injection (apex_tpu.resilience.chaos) — for tests and drills
     p.add_argument("--chaos-nan-steps", default="",
                    help="comma/range list of steps whose loss is NaN-poisoned")
@@ -439,11 +444,24 @@ def main():
         report = monitor.xray.memory_report(train_step, *step_args)
         print(report.format(), flush=True)
         router.event("memory", step0, **report.fields())
+    audit_lowered = audit_compiled = audit_module = None
+    if args.audit_donation or args.audit_comms:
+        # ONE AOT compile + ONE HLO text/parse shared by both audits
+        # (the ctx.aot()/ctx.hlo_module() pattern the CLI gate uses) —
+        # each flag alone would otherwise pay its own multi-second
+        # .lower().compile() and re-serialize the optimized HLO
+        from apex_tpu.analysis.hlo import parse_hlo_module
+
+        audit_lowered = train_step.lower(*step_args)
+        audit_compiled = audit_lowered.compile()
+        try:
+            audit_module = parse_hlo_module(audit_compiled)
+        except ValueError:
+            pass  # each audit re-derives and reports unverifiable
     if args.audit_donation:
         # static donation audit (apex_tpu.analysis, docs/analysis.md):
         # the declared donate_argnums vs the aliases XLA actually
         # realized, plus large buffers that could be donated but aren't.
-        # Pays one extra compile, like --xray-report.
         from apex_tpu.analysis import repo_allowlist
         from apex_tpu.analysis.donation import audit_donation
 
@@ -452,6 +470,8 @@ def main():
             arg_names=("params", "opt_state", "scaler_state", "sent_state",
                        "bag", "tokens", "labels", "inject_nan", "lr_scale"),
             target="gpt-pretrain",
+            lowered=audit_lowered, compiled=audit_compiled,
+            hlo_module=audit_module,
         )
         audit = repo_allowlist().apply(fins, check_stale=False)
         for rec in audit.to_records(step=step0):
@@ -468,6 +488,31 @@ def main():
         else:
             print(audit.format(verbose=True), flush=True)
             raise SystemExit("donation audit failed")
+    if args.audit_comms:
+        # ghost-collective differ (apex_tpu.analysis.hlo, docs/analysis.md):
+        # every collective XLA actually emitted must match a ledger
+        # prediction — resharding leaks and transpose-synthesized traffic
+        # surface here. Reuses --audit-donation's compile.
+        from apex_tpu.analysis import repo_allowlist
+        from apex_tpu.analysis.hlo import audit_comms
+
+        fins = audit_comms(
+            train_step, *step_args, mesh=mesh, target="gpt-pretrain",
+            compiled=audit_compiled, module=audit_module,
+        )
+        audit = repo_allowlist().apply(fins, check_stale=False)
+        for rec in audit.to_records(step=step0):
+            router.emit(rec)
+        # an 'unverifiable' outcome (no mesh / unparseable HLO) is
+        # info-severity but must NOT print ok: the flag exists to VERIFY,
+        # same hardening rule as --audit-donation above
+        unverifiable = [f for f in fins if f.rule == "comms.unverifiable"]
+        if audit.ok and not unverifiable:
+            print("comms audit: ok (emitted collectives match the ledger "
+                  "prediction)", flush=True)
+        else:
+            print(audit.format(verbose=True), flush=True)
+            raise SystemExit("comms audit failed")
     # warm the interval-emission path's eager host ops (bag pack/reset)
     # NOW: their one-off compiles must land before the recompile
     # sentinel arms, and on a RESUMED run the first interval boundary

@@ -38,6 +38,10 @@ def parse_args():
     p.add_argument("--audit-donation", action="store_true",
                    help="verify the train step's donation against XLA's "
                         "realized aliasing (apex_tpu.analysis) before running")
+    p.add_argument("--audit-comms", action="store_true",
+                   help="diff the optimized HLO's collectives against the "
+                        "xray ledger's prediction (apex_tpu.analysis.hlo) "
+                        "before running")
     return p.parse_args()
 
 
@@ -150,6 +154,18 @@ def main():
         return params, opt_state, losses
 
     opt_state = init_opt(variables)
+    audit_lowered = audit_compiled = audit_module = None
+    if args.audit_donation or args.audit_comms:
+        # one shared AOT compile + one HLO text/parse for both audits
+        # (the ctx.aot()/ctx.hlo_module() pattern)
+        from apex_tpu.analysis.hlo import parse_hlo_module
+
+        audit_lowered = train.lower(variables, opt_state, tokens, labels)
+        audit_compiled = audit_lowered.compile()
+        try:
+            audit_module = parse_hlo_module(audit_compiled)
+        except ValueError:
+            pass  # each audit re-derives and reports unverifiable
     if args.audit_donation:
         from apex_tpu.analysis import repo_allowlist
         from apex_tpu.analysis.donation import audit_donation
@@ -158,6 +174,8 @@ def main():
             train, variables, opt_state, tokens, labels,
             arg_names=("params", "opt_state", "tokens", "labels"),
             target="llama-finetune",
+            lowered=audit_lowered, compiled=audit_compiled,
+            hlo_module=audit_module,
         )
         res = repo_allowlist().apply(fins, check_stale=False)
         # 'unverifiable' (info) must not count as ok: the flag promises
@@ -168,6 +186,25 @@ def main():
         else:
             print(res.format(verbose=True))
             raise SystemExit("donation audit failed")
+    if args.audit_comms:
+        from apex_tpu.analysis import repo_allowlist
+        from apex_tpu.analysis.hlo import audit_comms
+
+        fins = audit_comms(
+            train, variables, opt_state, tokens, labels,
+            mesh=mesh, target="llama-finetune",
+            compiled=audit_compiled, module=audit_module,
+        )
+        res = repo_allowlist().apply(fins, check_stale=False)
+        # 'unverifiable' (info) must not count as ok — the flag promises
+        # verification, not absence of errors (the --audit-donation rule)
+        unverifiable = [f for f in fins if f.rule == "comms.unverifiable"]
+        if res.ok and not unverifiable:
+            print("comms audit: ok (emitted collectives match the ledger "
+                  "prediction)")
+        else:
+            print(res.format(verbose=True))
+            raise SystemExit("comms audit failed")
 
     t0 = time.perf_counter()
     params, opt_state, losses = train(variables, opt_state, tokens, labels)

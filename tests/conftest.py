@@ -4,7 +4,10 @@ Mirrors the reference's test topology (DistributedTestBase spawns
 world_size<=4 single-node processes; apex/transformer/testing/
 distributed_test_base.py:36-38) — here a single JAX process with 8 virtual
 CPU devices exercises every mesh/collective path, and Pallas kernels run in
-interpret mode.
+interpret mode. The compiled-HLO analysis passes (donation, the hlo-comms
+differ, hlo-sharding) compile against this same virtual topology — their
+``replica_groups``/sharding assertions hold digit-for-digit with no TPU
+attached, which is what keeps the analysis self-check tier-1.
 """
 
 import os

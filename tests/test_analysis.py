@@ -1,13 +1,18 @@
 """Static-analysis subsystem (apex_tpu.analysis): jaxpr auditors, AST
-lint framework, allowlist machinery, and the repo self-check.
+lint framework, compiled-HLO passes, allowlist machinery, and the repo
+self-check.
 
 Every pass gets a hand-built miniature step with ONE known violation
 (bad promotion, rejected donation, non-permutation ppermute, mismatched
-pipeline edge, host callback) asserting exact Finding fields, plus a
-clean-function negative test — the auditors must find exactly what is
-seeded and nothing else. The self-check at the bottom is the acceptance
-gate: ``python -m apex_tpu.analysis`` (lint + GPT/BERT step targets on
-the dp2xtp2 CPU mesh) must exit 0 against the repo as committed.
+pipeline edge, host callback, mis-sharded matmul, transpose-synthesized
+backward collective, dead psum, oversized replicated entry buffer)
+asserting exact Finding fields, plus a clean-function negative test —
+the auditors must find exactly what is seeded and nothing else. The HLO
+side additionally pins the GPT dp2xtp2 target's hand-counted collective
+inventory (per-axis op counts AND bytes, exact). The self-check at the
+bottom is the acceptance gate: ``python -m apex_tpu.analysis`` (lint +
+jaxpr + HLO passes over the GPT/BERT step targets on the dp2xtp2 CPU
+mesh) must exit 0 against the repo as committed.
 """
 
 import functools
@@ -526,9 +531,528 @@ class TestLintFramework:
         assert seeded[0].site == "apex_tpu/fake.py:2"
         assert not seeded[0].data.get("stale")
 
+    def test_hlo_text_seeded(self):
+        files = {
+            "apex_tpu/fake.py":
+                "def dump(compiled):\n"
+                "    return compiled.as_text()\n",
+        }
+        (f,) = run_lint(rules=["lint.hlo-text"], files=files)
+        assert f.rule == "lint.hlo-text"
+        assert f.site == "apex_tpu/fake.py:2"
+        assert f.severity == "error"
+
+    def test_hlo_text_docstring_mention_not_flagged(self):
+        files = {
+            "apex_tpu/fake.py":
+                '"""docs may say .as_text() freely"""\n'
+                "# comments too: compiled.as_text()\n"
+                "s = 'as_text'\n",
+        }
+        assert run_lint(rules=["lint.hlo-text"], files=files) == []
+
     def test_unknown_rule_raises(self):
         with pytest.raises(KeyError, match="lint.nope"):
             run_lint(rules=["lint.nope"], files={})
+
+
+# ---------------------------------------------------------------------------
+# compiled-HLO parser (analysis/hlo/parser.py)
+
+
+SYNTHETIC_HLO = """\
+HloModule test_mod, input_output_alias={ {0}: (0, {}, may-alias), {1, 2}: (3, {}, must-alias) }, num_partitions=4
+
+%add.1 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+%while_body.2 (p: (s32[], f32[4])) -> (s32[], f32[4]) {
+  %p = (s32[], f32[4]{0}) parameter(0)
+  %x = f32[4]{0} get-tuple-element((s32[], f32[4]{0}) %p), index=1
+  %ar.1 = f32[4]{0} all-reduce(f32[4]{0} %x), channel_id=1, replica_groups=[2,2]<=[4], use_global_device_ids=true, to_apply=%add.1, metadata={op_name="while/psum" source_file="/repo/a.py" source_line=10}
+  %i = s32[] get-tuple-element((s32[], f32[4]{0}) %p), index=0
+  ROOT %t = (s32[], f32[4]{0}) tuple(s32[] %i, f32[4]{0} %ar.1)
+}
+
+ENTRY %main.9 (p0: f32[4], p1: f32[8,8], p2: f32[2,4]) -> (f32[8], f32[4], f32[4]) {
+  %p0 = f32[4]{0} parameter(0), sharding={replicated}, metadata={op_name="params[\\'w\\']"}
+  %p1 = f32[8,8]{1,0} parameter(1), sharding={devices=[2,1,2]<=[4] last_tile_dim_replicate}, metadata={op_name="tokens"}
+  %p2 = f32[2,4]{1,0} parameter(2), sharding={devices=[1,1,4]<=[4] last_tile_dim_replicate}
+  %ags = (f32[4]{0}, f32[8]{0}) all-gather-start(f32[4]{0} %p0), channel_id=2, replica_groups={{0,1},{2,3}}, dimensions={0}, metadata={op_name="jit(f)/all_gather" source_file="/repo/b.py" source_line=20}
+  %agd = f32[8]{0} all-gather-done((f32[4]{0}, f32[8]{0}) %ags)
+  %cp = f32[4]{0} collective-permute(f32[4]{0} %p0), channel_id=3, source_target_pairs={{0,1},{1,0},{2,3},{3,2}}
+  ROOT %r = (f32[8]{0}, f32[4]{0}, f32[4]{0}) tuple(f32[8]{0} %agd, f32[4]{0} %cp, f32[4]{0} %p0)
+}
+"""
+
+
+class TestHloParser:
+    def test_balanced_is_nesting_safe(self):
+        from apex_tpu.analysis.hlo.parser import balanced
+
+        body, end = balanced("x={a={b}, c={d={e}}} tail", 2)
+        assert body == "a={b}, c={d={e}}"
+        assert end == 19
+        with pytest.raises(ValueError):
+            balanced("{unclosed", 0)
+
+    def test_balanced_skips_quoted_braces(self):
+        # XLA carries a user named_scope verbatim into op_name, so a
+        # quoted metadata string may contain braces: an unmatched one
+        # must not crash the scan, a matched one must not truncate it
+        from apex_tpu.analysis.hlo.parser import balanced
+
+        body, _ = balanced('x={op_name="scope{x" k={v}} tail', 2)
+        assert body == 'op_name="scope{x" k={v}'
+        body, _ = balanced('x={op_name="a{b}c" k=1} tail', 2)
+        assert body == 'op_name="a{b}c" k=1'
+
+    def test_braced_named_scope_in_metadata_parses(self):
+        from apex_tpu.analysis.hlo.parser import parse_hlo_module
+
+        hlo = SYNTHETIC_HLO.replace(
+            'op_name="while/psum"', 'op_name="while/odd{scope/psum"'
+        )
+        mod = parse_hlo_module(hlo)
+        ar = next(c for c in mod.collectives if c.kind == "all-reduce")
+        assert ar.op_name == "while/odd{scope/psum"
+        assert ar.source_file == "/repo/a.py" and ar.source_line == 10
+
+    def test_realized_aliases_nested_output_indices(self):
+        from apex_tpu.analysis.hlo.parser import realized_aliases
+
+        # tuple output index {1, 2} must map through nesting-safely
+        assert realized_aliases(SYNTHETIC_HLO) == {0: 0, 3: 1}
+
+    def test_parse_synthetic_module(self):
+        from apex_tpu.analysis.hlo.parser import parse_hlo_module
+
+        mod = parse_hlo_module(SYNTHETIC_HLO)
+        assert mod.name == "test_mod"
+        assert mod.entry_name == "main.9"
+        # collectives everywhere: the while-body all-reduce is found, the
+        # -start async form normalizes to its sync kind, -done is skipped
+        kinds = sorted(c.kind for c in mod.collectives)
+        assert kinds == ["all-gather", "all-reduce", "collective-permute"]
+        ar = next(c for c in mod.collectives if c.kind == "all-reduce")
+        assert ar.computation == "while_body.2"
+        # iota shorthand [2,2]<=[4] expands row-major
+        assert ar.replica_groups == ((0, 1), (2, 3))
+        assert ar.channel_id == 1
+        assert ar.source_file == "/repo/a.py" and ar.source_line == 10
+        assert ar.operands[0].elements == 4 and ar.operands[0].nbytes == 16
+        ag = next(c for c in mod.collectives if c.kind == "all-gather")
+        assert ag.computation == "main.9"
+        # ledger convention: the operand (local shard), not the result
+        assert ag.elements == 4
+        assert ag.op_name == "jit(f)/all_gather"
+        # permutes print source_target_pairs, not replica_groups
+        cp = next(c for c in mod.collectives
+                  if c.kind == "collective-permute")
+        assert cp.replica_groups == ()
+        assert cp.source_target_pairs == ((0, 1), (1, 0), (2, 3), (3, 2))
+        # entry params with shardings and jax's human labels
+        assert [p.index for p in mod.entry_params] == [0, 1, 2]
+        p0, p1, p2 = mod.entry_params
+        assert p0.sharding.fully_replicated and p0.label == "params[\\'w\\']"
+        assert not p1.sharding.fully_replicated  # tiled over a real axis
+        assert p2.sharding.fully_replicated  # all tile dims 1 + replicate
+        assert p1.shape.nbytes == 256
+        assert [s.elements for s in mod.entry_root_shapes] == [8, 4, 4]
+
+    def test_module_text_requires_as_text_or_str(self):
+        from apex_tpu.analysis.hlo.parser import module_text
+
+        assert module_text("HloModule x") == "HloModule x"
+        with pytest.raises(TypeError, match="as_text"):
+            module_text(42)
+
+
+# ---------------------------------------------------------------------------
+# replica_groups -> mesh-axis attribution
+
+
+class TestHloAttribution:
+    def test_partitions_and_classify_dp2tp2(self):
+        from apex_tpu.analysis.hlo import attribution
+
+        mesh = mesh2d(2, 2, ("dp", "tp"))
+        parts = attribution.mesh_axis_partitions(mesh)
+        labels = set(parts.values())
+        assert labels == {"dp", "tp", "dp,tp"}
+        classify = attribution.classify_replica_groups
+        assert classify(mesh, ((0, 1), (2, 3))) == "tp"
+        assert classify(mesh, ((0, 2), (1, 3))) == "dp"
+        assert classify(mesh, ((0, 1, 2, 3),)) == "dp,tp"
+        # implicit "everyone" and singleton groups
+        assert classify(mesh, ()) == "dp,tp"
+        assert classify(mesh, ((0,), (1,), (2,), (3,))) == attribution.AXIS_NONE
+        # a partition no axis subset induces
+        assert classify(mesh, ((0, 3), (1, 2))) == attribution.AXIS_UNKNOWN
+
+    def test_classify_source_target_pairs(self):
+        from apex_tpu.analysis.hlo import attribution
+
+        mesh = mesh2d(2, 2, ("dp", "pp"))
+        classify = attribution.classify_source_target_pairs
+        # pp ring edges inside each dp group: the SMALLEST subset wins
+        assert classify(mesh, ((0, 1), (1, 0), (2, 3), (3, 2))) == "pp"
+        assert classify(mesh, ((0, 2), (2, 0), (1, 3), (3, 1))) == "dp"
+        # an edge crossing both axes only fits the full-mesh subset
+        assert classify(mesh, ((0, 3),)) == "dp,pp"
+        assert classify(mesh, ()) == attribution.AXIS_NONE
+        assert classify(mesh, ((0, 9),)) == attribution.AXIS_UNKNOWN
+
+    def test_size1_axes_dropped(self):
+        from apex_tpu.analysis.hlo import attribution
+
+        mesh = jax.sharding.Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 1, 1, 2),
+            ("dp", "pp", "cp", "tp"),
+        )
+        parts = attribution.mesh_axis_partitions(mesh)
+        assert set(parts.values()) == {"dp", "tp", "dp,tp"}
+        # ledger composite keys canonicalize: size-1 names drop, order is
+        # mesh order, unknown names stay visible
+        canon = attribution.canon_axis_key
+        assert canon(mesh, "pp,cp,tp") == "tp"
+        assert canon(mesh, "tp,dp") == "dp,tp"
+        assert canon(mesh, "pp") == attribution.AXIS_NONE
+        assert canon(mesh, "nope") == "nope"
+
+
+# ---------------------------------------------------------------------------
+# ghost-collective differ (analysis/hlo/comms_diff.py)
+
+
+class TestHloComms:
+    def mesh(self):
+        return mesh2d(2, 2, ("dp", "tp"))
+
+    def test_misharded_matmul_unpredicted(self):
+        # the ISSUE's seeded positive: a matmul whose operands are
+        # sharded along the contracting dim forces GSPMD to insert an
+        # all-reduce no ledger wrapper ever saw
+        from apex_tpu.analysis.hlo import audit_comms
+        from jax.sharding import NamedSharding
+
+        mesh = self.mesh()
+        xs = jax.ShapeDtypeStruct((8, 64), jnp.float32,
+                                  sharding=NamedSharding(mesh, P(None, "tp")))
+        ws = jax.ShapeDtypeStruct((64, 8), jnp.float32,
+                                  sharding=NamedSharding(mesh, P("tp", None)))
+        f = jax.jit(lambda x, w: x @ w,
+                    out_shardings=NamedSharding(mesh, P()))
+        fins = audit_comms(f, xs, ws, mesh=mesh, target="seeded")
+        (f1,) = [f for f in fins if f.rule == "comms.unpredicted"]
+        assert f1.severity == "error"
+        assert f1.data["op"] == "all-reduce"
+        assert f1.data["axis"] == "tp"
+        assert f1.data["elements"] == 64  # the (8,8) partial product
+        assert f1.data["transpose"] is False
+        assert f1.site.startswith(THIS_FILE + ":")  # the matmul's line
+
+    def test_transpose_bwd_unpredicted_and_allowlisted(self):
+        # a NON-custom_vjp all_gather under grad: jax's transpose rule
+        # synthesizes the reduce-scatter mate, which never runs through
+        # the ledger wrappers — the documented blind spot, now loud. The
+        # reason-carrying allowlist is the sanctioned way to keep known
+        # transpose-derived backward collectives.
+        from apex_tpu.analysis.hlo import audit_comms
+
+        mesh = self.mesh()
+
+        # x sharded over BOTH axes: no dp broadcast in the forward, so
+        # the only transpose-synthesized collective is the tp
+        # reduce-scatter mate of the gather
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P("dp", "tp"), out_specs=P(),
+            check_vma=False,
+        )
+        def gathered_sum(x):
+            return jnp.sum(xlax.all_gather(x, "tp"))
+
+        def step(x):
+            return jax.value_and_grad(gathered_sum)(x)
+
+        x = jax.ShapeDtypeStruct((2, 8), jnp.float32)
+        fins = audit_comms(step, x, mesh=mesh, target="seeded")
+        rs = [f for f in fins if f.rule == "comms.unpredicted"
+              and f.data["op"] == "reduce-scatter"]
+        (f1,) = rs
+        assert f1.severity == "error"
+        assert f1.data["axis"] == "tp"
+        assert f1.data["transpose"] is True
+        assert "transpose-synthesized" in f1.message
+        # the transposed op inherits the FORWARD call's source info —
+        # the ledger wrapper line (the eqn_site quirk, passes.py)
+        assert "ledger.py" in f1.site
+        allow = Allowlist([AllowlistEntry(
+            rule="comms.unpredicted",
+            match="ledger.py",
+            reason=(
+                "transpose-derived backward mate of the forward "
+                "all_gather: legitimate mirrored traffic the ledger "
+                "cannot see without a custom_vjp pairing"
+            ),
+        )])
+        res = allow.apply(fins, check_stale=False)
+        assert not any(
+            f.rule == "comms.unpredicted" for f in res.findings
+        )
+        assert any(
+            f.rule == "comms.unpredicted" for f, _ in res.suppressed
+        )
+
+    def test_ledgered_ppermute_matches(self):
+        # a predicted permute must MATCH its emitted collective-permute —
+        # which XLA prints with source_target_pairs, not replica_groups
+        # (the attribution goes through the pair graph)
+        from apex_tpu.analysis.hlo import audit_comms
+
+        mesh = mesh2d(2, 2, ("dp", "pp"))
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            return xlax.ppermute(x, "pp", [(0, 1), (1, 0)])
+
+        fins = audit_comms(step, jax.ShapeDtypeStruct((16,), jnp.float32),
+                           mesh=mesh, target="seeded")
+        assert fins == [], [f.format() for f in fins]
+
+    def test_dead_psum_vanished(self):
+        from apex_tpu.analysis.hlo import audit_comms
+
+        mesh = self.mesh()
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            xlax.psum(x, "tp")  # result unused: XLA deletes the traffic
+            return x * 2.0
+
+        fins = audit_comms(step, jax.ShapeDtypeStruct((16,), jnp.float32),
+                           mesh=mesh, target="seeded")
+        (f1,) = [f for f in fins if f.rule == "comms.vanished"]
+        assert f1.severity == "warning"
+        assert f1.data == {"op": "all-reduce", "axis": "tp", "elements": 16}
+
+    def test_unverifiable_without_mesh(self):
+        from apex_tpu.analysis.hlo import audit_comms
+
+        fins = audit_comms(lambda x: x * 2, jnp.ones((4,)), mesh=None,
+                           target="t")
+        (f1,) = fins
+        assert f1.rule == "comms.unverifiable"
+        assert f1.severity == "info"
+
+    def test_unparseable_hlo_unverifiable_not_crash(self):
+        # malformed module text (truncated alias header) must degrade to
+        # the documented comms.unverifiable outcome, not a ValueError
+        # that kills the whole gate
+        from apex_tpu.analysis.hlo import audit_comms
+
+        fins = audit_comms(
+            lambda x: x * 2, jnp.ones((4,)), mesh=self.mesh(), target="t",
+            compiled="HloModule m, input_output_alias={ {0",
+        )
+        (f1,) = fins
+        assert f1.rule == "comms.unverifiable"
+        assert f1.severity == "info"
+        assert "could not be parsed" in f1.message
+
+    def test_batched_reconcile_requires_leading_dim_split(self):
+        # stage-2 guard: an emitted op whose size is coincidentally k*e
+        # of a predicted bucket but whose operand dims do NOT factor as
+        # (batch..., payload...) is a real unpredicted collective, not
+        # vmap batching — it must survive to comms.unpredicted instead
+        # of silently consuming k predictions
+        from apex_tpu.analysis.hlo import audit_comms
+
+        mesh = self.mesh()
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def step(x):
+            with xlax.scaled(4):  # 4 predicted tp psums of 16 el
+                return xlax.psum(x, "tp")
+
+        x = jax.ShapeDtypeStruct((16,), jnp.float32)
+        synthetic = """\
+HloModule m
+
+ENTRY %main.1 (p0: f32[{dims}]) -> f32[{dims}] {{
+  %p0 = f32[{dims}]{{0}} parameter(0)
+  ROOT %ar = f32[{dims}]{{0}} all-reduce(f32[{dims}]{{0}} %p0), channel_id=1, replica_groups={{{{0,1}},{{2,3}}}}, to_apply=%add, metadata={{op_name="jit(step)/mystery" source_file="/repo/c.py" source_line=5}}
+}}
+"""
+        # 48 = 3*16 divides the bucket payload, but f32[48] is not a
+        # 3-stack of f32[16] payloads in any leading-dim split
+        fins = audit_comms(step, x, mesh=mesh, target="seeded",
+                           compiled=synthetic.format(dims="48"))
+        (f1,) = [f for f in fins if f.rule == "comms.unpredicted"]
+        assert f1.data["op"] == "all-reduce"
+        assert f1.data["axis"] == "tp"
+        assert f1.data["elements"] == 48
+        # the 4 predictions are then genuinely unconsumed -> vanished
+        assert [f.rule for f in fins if f is not f1] == ["comms.vanished"]
+        # positive control: a true vmap batch IS a leading-dim stack and
+        # consumes the whole bucket cleanly
+        fins = audit_comms(step, x, mesh=mesh, target="seeded",
+                           compiled=synthetic.format(dims="4,16"))
+        assert fins == [], [f.format() for f in fins]
+
+    def test_gpt_dp2tp2_inventory_and_clean(self):
+        """ACCEPTANCE: the hand-counted collective inventory of the GPT
+        dp2xtp2 target's OPTIMIZED HLO, pinned exactly per (op, axis) in
+        both counts and operand bytes (f32 on the CPU backend — XLA
+        legalizes bf16 collectives to f32 there, which is exactly why
+        the differ matches on elements, not bytes).
+
+        The hand count (model: 2 layers, hidden 16, ffn 32, heads 2,
+        vocab 32, seq 8, batch 2 over dp2 => per-shard b=1; SP over tp2
+        => s/tp=4):
+
+        - all-gather/tp, 10 ops x 64 el (4,1,16): SP activation gathers
+          -- fwd qkv + h_to_4h per layer (4) + final pre-logits gather
+          (1), and their custom_vjp backward mates at dense + 4h_to_h
+          per layer (4) + the tied-embedding attend path (1).
+        - reduce-scatter/tp, 9 ops x 128 el (8,1,16): fwd dense +
+          4h_to_h per layer (4), bwd qkv + h_to_4h per layer (4), and
+          the tied-embedding logits-grad path (1).
+        - all-reduce/tp, 19 ops, 1508 B: 14 x 16-el grad psums for the
+          tp-replicated LN scales/biases (5 norms x 2 params) and the
+          SP dense/4h biases (4); 3 x 8-el vocab-parallel CE stats over
+          the (1,8) token rows (pmax + sumexp psum + target-logit psum,
+          the 4th predicted psum CSE-folds with the sumexp one); 1 x
+          scalar found_inf psum (grad scaler); 1 x 128-el vocab-parallel
+          embedding-grad psum.
+        - all-reduce/dp, 29 ops, 15172 B: one grad psum per parameter
+          leaf (28 leaves: 12 per layer + word/pos embeddings + final
+          LN scale/bias) + the scalar loss pmean.
+        - all-reduce/none, 1 op: the found_inf psum over the size-1
+          pp/cp axes — singleton groups, zero bytes, elided by the
+          ledger and skipped by the differ.
+
+        And the differ itself must come back CLEAN on this target: only
+        the info-severity comms.folded record for the CSE'd CE-stats
+        psum (no unpredicted, no reshard, no vanished).
+        """
+        from apex_tpu.analysis import StepContext
+        from apex_tpu.analysis.hlo import attribution, audit_comms
+        from apex_tpu.analysis.hlo.parser import parse_hlo_module
+        from apex_tpu.analysis.targets import dp2tp2_mesh, gpt_step_target
+
+        mesh = dp2tp2_mesh()
+        tgt = gpt_step_target(mesh)
+        ctx = StepContext(tgt)
+        _, compiled = ctx.aot()
+        mod = parse_hlo_module(compiled)
+        parts = attribution.mesh_axis_partitions(mesh)
+
+        inventory = {}
+        for c in mod.collectives:
+            axis = attribution.classify_replica_groups(
+                mesh, c.replica_groups, parts
+            )
+            count, nbytes = inventory.get((c.kind, axis), (0, 0))
+            inventory[(c.kind, axis)] = (count + 1, nbytes + c.nbytes)
+
+        assert inventory == {
+            ("all-gather", "tp"): (10, 10 * 64 * 4),
+            ("reduce-scatter", "tp"): (9, 9 * 128 * 4),
+            ("all-reduce", "tp"): (19, 14 * 16 * 4 + 3 * 8 * 4
+                                   + 1 * 4 + 128 * 4),
+            ("all-reduce", "dp"): (29, 15172),
+            ("all-reduce", "none"): (1, 4),
+        }
+        # dp bytes cross-check: 28 f32 grad leaves = the full parameter
+        # tree (3792 el) + the scalar loss pmean
+        assert 15172 == 3792 * 4 + 4
+
+        fins = audit_comms(
+            tgt.fn, *tgt.args, mesh=mesh,
+            donate_argnums=tgt.donate_argnums, target=tgt.name,
+            compiled=compiled,
+        )
+        assert all(f.severity == "info" for f in fins), [
+            f.format() for f in fins
+        ]
+        (folded,) = [f for f in fins if f.rule == "comms.folded"]
+        assert folded.data == {
+            "op": "all-reduce", "axis": "tp", "elements": 8,
+        }
+
+    def test_bert_clean(self):
+        """Clean negative for the second CLI target: no error/warning
+        comms findings, and the sharding auditor is silent (every entry
+        buffer is tiny)."""
+        from apex_tpu.analysis import StepContext
+        from apex_tpu.analysis.hlo import audit_comms, audit_entry_shardings
+        from apex_tpu.analysis.targets import bert_step_target, dp2tp2_mesh
+
+        mesh = dp2tp2_mesh()
+        tgt = bert_step_target(mesh)
+        ctx = StepContext(tgt)
+        _, compiled = ctx.aot()
+        fins = audit_comms(
+            tgt.fn, *tgt.args, mesh=mesh,
+            donate_argnums=tgt.donate_argnums, target=tgt.name,
+            compiled=compiled,
+        )
+        assert all(f.severity == "info" for f in fins), [
+            f.format() for f in fins
+        ]
+        assert audit_entry_shardings(compiled, mesh, target=tgt.name) == []
+
+
+# ---------------------------------------------------------------------------
+# entry-sharding auditor (analysis/hlo/sharding_audit.py)
+
+
+class TestHloSharding:
+    def test_replicated_param_flagged_sharded_clean(self):
+        from apex_tpu.analysis.hlo import audit_entry_shardings
+        from jax.sharding import NamedSharding
+
+        mesh = mesh2d(2, 2, ("dp", "tp"))
+        big = jax.ShapeDtypeStruct((512, 1024), jnp.float32,
+                                   sharding=NamedSharding(mesh, P()))
+        small = jax.ShapeDtypeStruct((8,), jnp.float32,
+                                     sharding=NamedSharding(mesh, P()))
+        compiled = jax.jit(lambda a, b: (a * 2.0, b + 1.0)).lower(
+            big, small
+        ).compile()
+        fins = audit_entry_shardings(compiled, mesh, target="seeded")
+        (f1,) = fins  # the small buffer is exempt by the 1 MiB floor
+        assert f1.rule == "sharding.replicated-param"
+        assert f1.severity == "warning"
+        assert f1.data["bytes"] == 512 * 1024 * 4
+        assert f1.data["index"] == 0
+
+        sharded = jax.ShapeDtypeStruct(
+            (512, 1024), jnp.float32,
+            sharding=NamedSharding(mesh, P("dp", None)),
+        )
+        compiled2 = jax.jit(lambda a: a * 2.0).lower(sharded).compile()
+        assert audit_entry_shardings(compiled2, mesh, target="s") == []
+
+    def test_silent_without_parallel_axes(self):
+        from apex_tpu.analysis.hlo import audit_entry_shardings
+
+        mesh = mesh1d(1, "dp")
+        assert audit_entry_shardings("HloModule x", mesh) == []
+        assert audit_entry_shardings("HloModule x", None) == []
 
 
 # ---------------------------------------------------------------------------
@@ -536,6 +1060,14 @@ class TestLintFramework:
 
 
 class TestRepoSelfCheck:
+    def test_hlo_passes_registered(self):
+        # the CLI gate runs every registered pass: the HLO family must
+        # be in the registry or the gate silently loses its coverage
+        from apex_tpu.analysis import JAXPR_PASSES
+
+        assert {"precision", "donation", "collective", "host-sync",
+                "hlo-comms", "hlo-sharding"} <= set(JAXPR_PASSES)
+
     def test_repo_lint_clean(self):
         """All source rules over the real tree, repo allowlist applied:
         zero unallowlisted findings and zero stale entries."""

@@ -87,7 +87,11 @@ def test_gpt_pretrain_xray(tmp_path):
     'memory'/'compile' records in the SAME jsonl stream as metrics and
     anomalies — the one-tailer contract. --audit-donation rides along:
     the donation auditor (apex_tpu.analysis) must verify the example's
-    donate_argnums=(0,1,2,3) against XLA's realized aliasing."""
+    donate_argnums=(0,1,2,3) against XLA's realized aliasing.
+    --audit-comms likewise: the ghost-collective differ must match every
+    collective XLA emitted for the real tp=2 step against the ledger
+    prediction (vmapped microbatch batching and XLA's reduce
+    reassociation included) — and must refuse to print ok otherwise."""
     import json
 
     jsonl = tmp_path / "metrics.jsonl"
@@ -96,10 +100,12 @@ def test_gpt_pretrain_xray(tmp_path):
                 "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
                 "--global-batch", "16", "--log-interval", "2", "--tp", "2",
                 "--metrics-jsonl", str(jsonl),
-                "--xray-report", "--xray-comms", "--audit-donation"])
+                "--xray-report", "--xray-comms", "--audit-donation",
+                "--audit-comms"])
     assert "comms ledger (per step):" in out
     assert "memory report (per device):" in out
     assert "donation audit: ok" in out
+    assert "comms audit: ok" in out
     records = [json.loads(line) for line in jsonl.read_text().splitlines()]
     by_kind = {}
     for r in records:
@@ -164,10 +170,13 @@ def test_gpt_pretrain_chaos(tmp_path):
 def test_llama_finetune_example():
     # --audit-donation: the donation auditor must verify that params AND
     # the ZeRO opt-state alias in place (the opt-state donation is what
-    # keeps ZeRO-2 from double-buffering its fp32 master+moments)
+    # keeps ZeRO-2 from double-buffering its fp32 master+moments).
+    # --audit-comms: the ZeRO gather/scatter collectives XLA emits for
+    # the scanned train step must all match the ledger prediction
     out = _run("examples/llama/finetune_llama.py",
-               ["--steps", "20", "--audit-donation"])
+               ["--steps", "20", "--audit-donation", "--audit-comms"])
     assert "donation audit: ok" in out
+    assert "comms audit: ok" in out
     assert "final loss" in out
     # memorization demo: loss must fall well below the uniform floor
     final = float(out.split("final loss")[1].split(";")[0])
