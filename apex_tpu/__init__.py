@@ -86,18 +86,31 @@ def deprecated_warning(msg: str) -> None:
     warnings.warn(msg, FutureWarning, stacklevel=2)
 
 
-# Eager subpackage imports, mirroring the reference's top-level __init__
-# (apex/__init__.py: __all__ = amp, fp16_utils, optimizers, normalization,
-# transformer [+ parallel]) so `import apex_tpu; apex_tpu.amp.initialize(...)`
-# works like `import apex; apex.amp...`.
-from apex_tpu import amp  # noqa: E402
-from apex_tpu import fp16_utils  # noqa: E402
-from apex_tpu import monitor  # noqa: E402
-from apex_tpu import normalization  # noqa: E402
-from apex_tpu import optimizers  # noqa: E402
-from apex_tpu import parallel  # noqa: E402
-from apex_tpu import resilience  # noqa: E402
-from apex_tpu import transformer  # noqa: E402
+# Lazy subpackage attributes (PEP 562), keeping the reference's top-level
+# surface (apex/__init__.py: __all__ = amp, fp16_utils, optimizers,
+# normalization, transformer [+ parallel]) so `import apex_tpu;
+# apex_tpu.amp.initialize(...)` works like `import apex; apex.amp...` —
+# but WITHOUT importing jax at `import apex_tpu` time: the jax-free
+# corners (analysis HLO parser, monitor router, xray.timeline's trace
+# analyzer) must stay importable on a box with no jax, and the analysis
+# CLI must be able to force its CPU topology before jax initializes.
+_SUBPACKAGES = frozenset({
+    "amp", "fp16_utils", "monitor", "normalization", "optimizers",
+    "parallel", "resilience", "transformer",
+})
+
+
+def __getattr__(name):
+    if name in _SUBPACKAGES:
+        import importlib
+
+        return importlib.import_module(f"apex_tpu.{name}")
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))
+
 
 __all__ = [
     "amp",

@@ -4,7 +4,10 @@ Runs the AST lint rules over apex_tpu/ + examples/ and the jaxpr passes
 (precision / donation / collective-safety / host-sync) PLUS the
 compiled-HLO passes (the hlo-comms ghost-collective differ and the
 hlo-sharding replication auditor) over the in-repo GPT and BERT step
-builders on a CPU dp2xtp2 mesh, then applies the documented allowlist
+builders on a CPU dp2xtp2 mesh, PLUS the profiler trace-schema smoke
+(a tiny real capture through the timeline analyzer,
+analysis/trace_smoke.py — loud failure when a jax upgrade drifts
+XProf's export), then applies the documented allowlist
 (analysis/allowlist.py). Exit status:
 
 - 0 — clean: every finding suppressed by a reason-carrying entry and no
@@ -25,8 +28,8 @@ fails fast.
 Flags: ``--verbose`` also prints suppressed findings with their reasons;
 ``--json PATH`` appends every finding as a ``kind="analysis"`` record to
 a jsonl (the shared MetricRouter schema); ``--skip-jaxpr`` /
-``--skip-lint`` run half the gate; ``--target gpt|bert`` restricts the
-jaxpr half.
+``--skip-lint`` / ``--skip-timeline`` run part of the gate;
+``--target gpt|bert`` restricts the jaxpr half.
 """
 
 import argparse
@@ -59,6 +62,8 @@ def main(argv=None) -> int:
                         help="skip the AST lint rules")
     parser.add_argument("--skip-jaxpr", action="store_true",
                         help="skip the jaxpr passes over the step targets")
+    parser.add_argument("--skip-timeline", action="store_true",
+                        help="skip the profiler trace-schema smoke check")
     parser.add_argument("--target", choices=("gpt", "bert"), default=None,
                         help="audit only one step builder")
     args = parser.parse_args(argv)
@@ -86,6 +91,15 @@ def main(argv=None) -> int:
             print(f"auditing step target {target.name!r} "
                   f"(mesh {dict(mesh.shape)})", flush=True)
             findings.extend(passes_mod.run_passes(target))
+    if not args.skip_timeline:
+        # trace-schema smoke (analysis/trace_smoke.py): a tiny REAL
+        # profiler capture through the timeline analyzer, so a jax
+        # upgrade that changes XProf's export fails the gate instead of
+        # silently blinding every --profile-analyze run
+        from apex_tpu.analysis.trace_smoke import timeline_smoke_findings
+
+        print("timeline trace-schema smoke (2-step capture)", flush=True)
+        findings.extend(timeline_smoke_findings())
 
     # stale-entry detection needs the full lint scan (a require_hit entry
     # trivially suppresses nothing when its rule never ran)

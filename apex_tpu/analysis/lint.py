@@ -40,6 +40,13 @@ Plus the new rules this framework exists to host:
   of HLO/MLIR text parsing (its ``module_text`` helper is the one
   blessed ``.as_text`` call site), so ad-hoc regexes over compiler
   output cannot quietly rot when XLA's printer changes.
+- ``lint.trace-file`` — no profiler trace-event reading outside
+  ``monitor/xray/timeline/``: the ``.trace.json`` literal (the format's
+  filename marker) in any string is the tell of an ad-hoc reader of
+  ``jax.profiler`` output — the exact rot ``lint.hlo-text`` prevents
+  for HLO text, applied to XProf's export. String-token based (a code
+  COMMENT mentioning the format is fine; a docstring or glob pattern
+  is a reader's fingerprint and routes to the shared parser).
 """
 
 import ast
@@ -298,6 +305,51 @@ def hlo_text(ctx: LintContext) -> Iterable[Finding]:
                     ),
                     site=f"{rel}:{toks[i].start[0]}",
                     severity=SEV_ERROR,
+                )
+
+
+# string-literal token types: 3.12+ tokenizes f-strings as FSTRING_*
+# (the literal text lands in FSTRING_MIDDLE), not STRING — without them
+# an f"{host}.trace.json.gz" reader would slip past on newer pythons
+_STRING_TOKEN_TYPES = frozenset(
+    t for t in (
+        tokenize.STRING,
+        getattr(tokenize, "FSTRING_START", None),
+        getattr(tokenize, "FSTRING_MIDDLE", None),
+    ) if t is not None
+)
+
+
+@lint_rule("lint.trace-file", scopes=("apex_tpu/", "examples/"))
+def trace_file(ctx: LintContext) -> Iterable[Finding]:
+    """``.trace.json`` in any string/docstring outside the blessed
+    timeline parser package — the fingerprint of ad-hoc profiler-trace
+    reading (see the module docstring)."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(src).readline)
+            strings = [t for t in toks if t.type in _STRING_TOKEN_TYPES]
+        except (tokenize.TokenError, SyntaxError) as e:
+            yield Finding(
+                rule="lint.trace-file",
+                message=f"untokenizable file: {e}",
+                site=f"{rel}:1", severity=SEV_ERROR,
+            )
+            continue
+        for t in strings:
+            if ".trace.json" in t.string:
+                yield Finding(
+                    rule="lint.trace-file",
+                    message=(
+                        "profiler trace-event reading outside "
+                        "apex_tpu/monitor/xray/timeline/ — the timeline "
+                        "parser is the one blessed home of the "
+                        "*.trace.json[.gz] format (parse_logdir / "
+                        "parse_trace_file return structured events); "
+                        "ad-hoc readers rot when XProf's exporter "
+                        "changes"
+                    ),
+                    site=f"{rel}:{t.start[0]}", severity=SEV_ERROR,
                 )
 
 

@@ -1,0 +1,112 @@
+"""Timeline smoke check: does THIS jax still write traces we can read?
+
+The timeline analyzer (``apex_tpu.monitor.xray.timeline``) parses the
+trace-event JSON XProf exports — a format jax does not version. If a
+jax upgrade changes the exporter (renames ``args.hlo_op``, stops
+stringifying ``step_num``, moves the step markers off the host lane),
+the analyzer would silently degrade: no steps segmented, every capture
+"one undifferentiated span". This module makes that drift LOUD in the
+``python -m apex_tpu.analysis`` gate: capture a real (tiny) profiler
+trace of a jitted step under a ``step_annotation``, run the full
+parse -> segment -> classify -> partition path over it, and report a
+``profile.trace-schema`` finding when any link breaks.
+
+This is the one analysis pass that executes device code — two jitted
+matmuls, milliseconds on CPU — because schema drift is a property of
+the RUNNING jax's exporter, unreachable from synthetic fixtures (those
+pin the math in tests/test_timeline.py; this pins the wire format).
+"""
+
+import os
+import tempfile
+from typing import List
+
+from apex_tpu.analysis.findings import Finding, SEV_ERROR
+
+__all__ = ["timeline_smoke_findings"]
+
+_SITE = "apex_tpu/monitor/xray/timeline/parser.py:1"
+_RULE = "profile.trace-schema"
+_STEPS = 2
+
+
+def _drift(message: str, **data) -> Finding:
+    return Finding(
+        rule=_RULE,
+        message=(
+            f"{message} — the XProf trace-event schema this container's "
+            f"jax writes no longer matches what the timeline parser "
+            f"understands; fix the parser (the one blessed reader) "
+            f"before any capture-based claim is trusted"
+        ),
+        site=_SITE,
+        severity=SEV_ERROR,
+        data=data,
+    )
+
+
+def timeline_smoke_findings() -> List[Finding]:
+    """Capture + analyze a two-step trace; findings on any schema drift.
+
+    Empty list = the exporter still writes step markers the analyzer
+    segments on, op events it classifies, and a per-step partition that
+    sums to the step span.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.monitor.xray import timeline
+    from apex_tpu.utils.timers import step_annotation, trace
+
+    @jax.jit
+    def step(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((64, 64), jnp.float32)
+    step(x).block_until_ready()  # compile OUTSIDE the capture
+    with tempfile.TemporaryDirectory(prefix="apex_tpu_trace_smoke_") as d:
+        try:
+            with trace(d):
+                for i in range(_STEPS):
+                    with step_annotation(i):
+                        step(x).block_until_ready()
+        except Exception as e:  # profiler itself unusable here
+            return [_drift(f"jax.profiler capture failed: {e!r}")]
+        try:
+            tl, files = timeline.parse_logdir(d)
+        except FileNotFoundError:
+            return [_drift(
+                "capture produced no trace-event file under the "
+                "plugins/profile layout"
+            )]
+        except ValueError as e:
+            return [_drift(f"trace file unparseable: {e}")]
+        report = timeline.analyze(tl)
+
+    findings: List[Finding] = []
+    spans = tl.step_spans()
+    if len(spans) < _STEPS:
+        findings.append(_drift(
+            f"segmented {len(spans)} step(s) from a capture of {_STEPS} "
+            f"annotated steps (StepTraceAnnotation markers missing or "
+            f"their step_num arg unreadable)",
+            steps_found=len(spans),
+            files=[os.path.basename(f) for f in files],
+        ))
+    if report.n_device_ops == 0:
+        findings.append(_drift(
+            "no XLA op events recognized (args.hlo_op / device-lane "
+            "detection both came up empty for a jitted matmul)"
+        ))
+    for s in report.steps:
+        resid = abs(
+            s.compute_us + s.exposed_collective_us + s.exposed_memcpy_us
+            + s.idle_us - s.span_us
+        )
+        if resid > 1e-6 * max(s.span_us, 1.0):
+            findings.append(_drift(
+                f"step {s.step} partition does not sum to its span "
+                f"(residual {resid:.6f}us of {s.span_us:.3f}us)",
+                step=s.step,
+            ))
+    return findings

@@ -33,67 +33,65 @@ profiling subsystem (PAPERS.md). Four cooperating pieces:
   ``kind="comms"/"memory"/"compile"`` records through the router.
 
 See docs/observability.md for the end-to-end wiring.
+
+Attribute access is lazy (PEP 562, the ``analysis`` package's contract):
+importing this package must not initialize jax, so the jax-free
+consumers — ``xray.timeline``'s trace analyzer and the ``router``
+record schema — stay importable on a box with no jax at all
+(docs/benchmarking.md: a capture is analyzable offline, anywhere).
 """
 
-from apex_tpu.monitor.metrics import (
-    MetricBag,
-    global_grad_norm,
-    host_fetch_count,
-    metric_bag,
-    per_layer_grad_norms,
-    read_bag,
-    reset_bag,
-    taps_from_intermediates,
-)
-from apex_tpu.monitor.router import (
-    CsvSink,
-    JsonlSink,
-    MemorySink,
-    MetricRouter,
-    Sink,
-    StdoutSink,
-    make_record,
-    try_tensorboard_sink,
-)
-from apex_tpu.monitor.flops import (
-    bert_flops_per_token,
-    gpt_flops_per_token,
-    mfu,
-    peak_flops_per_device,
-    tokens_per_second,
-    transformer_layer_flops_per_token,
-    training_flops_per_step,
-)
-from apex_tpu.monitor.watchdog import ProfilerTrigger, StallWatchdog
-from apex_tpu.monitor.taps import REGISTERED_TAPS
-from apex_tpu.monitor import xray
+_EXPORTS = {
+    # metrics (jax + flax)
+    "MetricBag": "metrics",
+    "metric_bag": "metrics",
+    "reset_bag": "metrics",
+    "read_bag": "metrics",
+    "host_fetch_count": "metrics",
+    "global_grad_norm": "metrics",
+    "per_layer_grad_norms": "metrics",
+    "taps_from_intermediates": "metrics",
+    # router (jax-free)
+    "MetricRouter": "router",
+    "Sink": "router",
+    "JsonlSink": "router",
+    "CsvSink": "router",
+    "StdoutSink": "router",
+    "MemorySink": "router",
+    "make_record": "router",
+    "try_tensorboard_sink": "router",
+    # flops (jax only for device-kind lookup, on use)
+    "transformer_layer_flops_per_token": "flops",
+    "gpt_flops_per_token": "flops",
+    "bert_flops_per_token": "flops",
+    "training_flops_per_step": "flops",
+    "tokens_per_second": "flops",
+    "mfu": "flops",
+    "peak_flops_per_device": "flops",
+    # watchdog / profiler trigger
+    "StallWatchdog": "watchdog",
+    "ProfilerTrigger": "watchdog",
+    # registered-taps table (jax-free)
+    "REGISTERED_TAPS": "taps",
+}
 
-__all__ = [
-    "MetricBag",
-    "metric_bag",
-    "reset_bag",
-    "read_bag",
-    "host_fetch_count",
-    "global_grad_norm",
-    "per_layer_grad_norms",
-    "taps_from_intermediates",
-    "MetricRouter",
-    "Sink",
-    "JsonlSink",
-    "CsvSink",
-    "StdoutSink",
-    "MemorySink",
-    "make_record",
-    "try_tensorboard_sink",
-    "transformer_layer_flops_per_token",
-    "gpt_flops_per_token",
-    "bert_flops_per_token",
-    "training_flops_per_step",
-    "tokens_per_second",
-    "mfu",
-    "peak_flops_per_device",
-    "StallWatchdog",
-    "ProfilerTrigger",
-    "REGISTERED_TAPS",
-    "xray",
+__all__ = sorted(_EXPORTS) + [
+    "metrics", "router", "flops", "watchdog", "taps", "xray",
 ]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"apex_tpu.monitor.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apex_tpu.monitor.{name}")
+    raise AttributeError(f"module 'apex_tpu.monitor' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -20,40 +20,59 @@ program itself, all emitting through the same MetricRouter record schema
 - ``compile_watch`` — :class:`CompileWatcher`: compiles and
   compile-seconds per step (``kind="compile"`` records), warning loudly
   on a post-warmup recompile — the classic silent 10x throughput killer.
+- ``timeline``      — the profiler-trace analyzer: parses the
+  ``*.trace.json.gz`` captures ``ProfilerTrigger``/``utils.trace``
+  write, segments steps on their ``StepTraceAnnotation`` markers, and
+  reports the measured device-time partition (compute / collective /
+  exposed comms / idle, overlap + bubble fractions) plus achieved
+  bytes/s per mesh axis against the ledger's prediction
+  (``kind="profile"`` records) — the wall-clock referee for every
+  overlap/zero-bubble schedule claim.
+
+Attribute access is lazy (PEP 562, the parent package's contract): the
+first three probes need a live jax, but ``timeline`` deliberately does
+not — a captured trace is analyzable on any box — so importing this
+package must not initialize jax either.
 """
 
-from apex_tpu.monitor.xray import ledger
-from apex_tpu.monitor.xray.ledger import (
-    CollectiveEntry,
-    CommsLedger,
-    axis_size,
-    comms_ledger,
-    ici_bandwidth_per_device,
-    muted,
-    predict_comms,
-    record,
-    scaled,
-)
-from apex_tpu.monitor.xray.memory import (
-    MemoryReport,
-    device_memory_limit,
-    memory_report,
-)
-from apex_tpu.monitor.xray.compile_watch import CompileWatcher
+_EXPORTS = {
+    # collective-traffic ledger
+    "CollectiveEntry": "ledger",
+    "CommsLedger": "ledger",
+    "comms_ledger": "ledger",
+    "predict_comms": "ledger",
+    "scaled": "ledger",
+    "muted": "ledger",
+    "axis_size": "ledger",
+    "record": "ledger",
+    "ici_bandwidth_per_device": "ledger",
+    # XLA memory reports
+    "MemoryReport": "memory",
+    "memory_report": "memory",
+    "device_memory_limit": "memory",
+    # recompile sentinel
+    "CompileWatcher": "compile_watch",
+}
 
-__all__ = [
-    "ledger",
-    "CollectiveEntry",
-    "CommsLedger",
-    "comms_ledger",
-    "predict_comms",
-    "scaled",
-    "muted",
-    "axis_size",
-    "record",
-    "ici_bandwidth_per_device",
-    "MemoryReport",
-    "memory_report",
-    "device_memory_limit",
-    "CompileWatcher",
+__all__ = sorted(_EXPORTS) + [
+    "ledger", "memory", "compile_watch", "timeline",
 ]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"apex_tpu.monitor.xray.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apex_tpu.monitor.xray.{name}")
+    raise AttributeError(
+        f"module 'apex_tpu.monitor.xray' has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,56 @@
+"""``python -m apex_tpu.monitor.xray.timeline <logdir>`` — analyze a capture.
+
+Standalone device-time breakdown of any ``jax.profiler`` capture (a
+``ProfilerTrigger`` window, a ``utils.trace`` block, a TensorBoard
+profile dir): per-step compute/collective/exposed/idle partition,
+overlap and bubble fractions. Exit status: 0 on a successful analysis
+with at least one step, 1 when no trace files / no device ops were
+found (so CI can gate on "the capture was analyzable").
+
+The bandwidth join needs the compiled step's HLO and the mesh, which a
+bare log dir does not carry — run the examples with
+``--profile-analyze`` for the joined report, or call
+``timeline.analyze_logdir(logdir, module=..., mesh=..., ledger=...)``
+programmatically.
+
+Flags: ``--json PATH`` appends the ``kind="profile"`` records to a
+jsonl (the shared MetricRouter schema).
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.monitor.xray.timeline",
+        description="device-time breakdown of a jax.profiler capture",
+    )
+    p.add_argument("logdir", help="profiler log dir (the dir passed to "
+                   "jax.profiler.trace / ProfilerTrigger)")
+    p.add_argument("--json", default=None,
+                   help="append kind='profile' records to this jsonl")
+    args = p.parse_args(argv)
+
+    from apex_tpu.monitor.xray.timeline.analyzer import analyze_logdir
+
+    try:
+        report = analyze_logdir(args.logdir)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"timeline: {e}", file=sys.stderr)
+        return 1
+    for path in report.files:
+        print(f"trace: {path}", flush=True)
+    print(report.summary(), flush=True)
+    if args.json:
+        from apex_tpu.monitor.router import JsonlSink
+
+        sink = JsonlSink(args.json)
+        for rec in report.to_records():
+            sink.emit(rec)
+        sink.close()
+    return 0 if report.steps else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,591 @@
+"""Device-time breakdown + measured-vs-predicted bandwidth join.
+
+The profiler traces ``ProfilerTrigger``/``utils.trace`` capture hold the
+answer to "where did the step's wall clock GO?" — this module computes
+it. Per step (segmented on the ``StepTraceAnnotation`` markers the
+examples wrap each step in):
+
+- **compute / collective / memcpy seconds** — union of the XLA op
+  intervals of each class (never a sum: ops overlap across lanes, and
+  an async collective's ``-start``/``-done`` pair is fused into ONE
+  in-flight interval first);
+- **exposed-comms seconds** — collective time NOT covered by compute:
+  the part of the comms bill the schedule failed to hide (the quantity
+  ROADMAP item 5's overlap schedules exist to drive to zero);
+- **overlap fraction** — hidden / total collective time;
+- **idle seconds and bubble fraction** — step span not covered by any
+  device op: pipeline bubbles, host stalls, dispatch gaps.
+
+The partition identity, pinned digit-for-digit in tests: ``compute +
+exposed_collective + exposed_memcpy + idle == span``.
+
+The bandwidth join closes the loop with PR 3's ledger: each measured
+collective event is matched to its instruction in the compiled
+``HloModule`` by NAME, its ``replica_groups`` (or permute pairs)
+attributed to a mesh axis (``analysis/hlo/attribution.py``), and the
+per-axis measured seconds divided into the ledger's predicted per-axis
+wire bytes — **achieved bytes/s per mesh axis**, and with an ICI
+bandwidth a measured utilization percentage. The static roofline table
+becomes a measurement.
+
+Everything emits ``kind="profile"`` records through the shared
+MetricRouter schema; ``python -m apex_tpu.monitor.xray.timeline`` is
+the standalone entry point.
+
+Caveat for CPU captures (the test topology): "device" ops run on the
+XLA host threadpool, so compute/collective durations are real measured
+seconds but the idle/bubble numbers include host scheduling noise, and
+achieved "bandwidth" is memcpy rate, not ICI. The math is identical on
+a real TPU capture; only the interpretation of absolute numbers changes
+(docs/observability.md#timeline).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.hlo.parser import COLLECTIVE_KINDS
+from apex_tpu.monitor.xray.timeline.parser import (
+    StepSpan,
+    Timeline,
+    TraceEvent,
+    parse_logdir,
+)
+
+__all__ = [
+    "CLASS_COMPUTE",
+    "CLASS_COLLECTIVE",
+    "CLASS_MEMCPY",
+    "classify_op",
+    "op_base",
+    "merge_intervals",
+    "total_us",
+    "intersect_intervals",
+    "subtract_intervals",
+    "clip_intervals",
+    "pair_async_collectives",
+    "OpInterval",
+    "StepBreakdown",
+    "AxisBandwidth",
+    "TimelineReport",
+    "analyze",
+    "analyze_logdir",
+]
+
+CLASS_COMPUTE = "compute"
+CLASS_COLLECTIVE = "collective"
+CLASS_MEMCPY = "memcpy"
+
+#: op stems that move bytes without computing: host/device transfers,
+#: on-device copies, infeed/outfeed. (``transpose`` is deliberately
+#: compute: it burns core time, not wire.)
+_MEMCPY_STEMS = frozenset({
+    "copy", "copy-start", "copy-done", "infeed", "outfeed",
+    "send", "send-done", "recv", "recv-done",
+})
+
+Interval = Tuple[float, float]
+
+
+def op_base(name: str) -> str:
+    """Instruction base of an op event name: ``%`` and the trailing
+    ``.N`` ordinal stripped, lowercased (``%All-Reduce.17`` ->
+    ``all-reduce``... no — ordinal only: ``all-reduce.17`` ->
+    ``all-reduce``; the full name WITH ordinal is the HLO-join key, so
+    this strips exactly one trailing numeric suffix)."""
+    base = name.lstrip("%").lower()
+    head, dot, tail = base.rpartition(".")
+    if dot and tail.isdigit():
+        return head
+    return base
+
+
+def classify_op(name: str) -> str:
+    """``compute`` / ``collective`` / ``memcpy`` for one op event name.
+
+    Collectives are matched against the HLO parser's
+    :data:`COLLECTIVE_KINDS` with the async ``-start``/``-done`` forms
+    normalized — the exact opcode grammar the comms differ uses, so
+    "collective" means the same thing in both auditors. ``reduce.N``
+    (a plain reduction) is NOT ``reduce-scatter`` and stays compute.
+    """
+    stem = op_base(name)
+    if stem in _MEMCPY_STEMS or "memcpy" in stem:
+        return CLASS_MEMCPY
+    if stem.endswith("-start"):
+        stem = stem[: -len("-start")]
+    elif stem.endswith("-done"):
+        stem = stem[: -len("-done")]
+    if stem in COLLECTIVE_KINDS:
+        return CLASS_COLLECTIVE
+    return CLASS_COMPUTE
+
+
+# -- interval algebra (all inputs/outputs in microseconds) -------------------
+
+
+def merge_intervals(intervals: Sequence[Interval]) -> List[Interval]:
+    """Disjoint, sorted union of ``intervals`` (zero-length dropped)."""
+    out: List[Interval] = []
+    for lo, hi in sorted(i for i in intervals if i[1] > i[0]):
+        if out and lo <= out[-1][1]:
+            if hi > out[-1][1]:
+                out[-1] = (out[-1][0], hi)
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def total_us(merged: Sequence[Interval]) -> float:
+    return sum(hi - lo for lo, hi in merged)
+
+
+def intersect_intervals(
+    a: Sequence[Interval], b: Sequence[Interval]
+) -> List[Interval]:
+    """Intersection of two MERGED interval lists."""
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            out.append((lo, hi))
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def subtract_intervals(
+    a: Sequence[Interval], b: Sequence[Interval]
+) -> List[Interval]:
+    """``a`` minus ``b``, both MERGED."""
+    out: List[Interval] = []
+    j = 0
+    for lo, hi in a:
+        cur = lo
+        while j < len(b) and b[j][1] <= cur:
+            j += 1
+        k = j
+        while k < len(b) and b[k][0] < hi:
+            if b[k][0] > cur:
+                out.append((cur, b[k][0]))
+            cur = max(cur, b[k][1])
+            k += 1
+        if cur < hi:
+            out.append((cur, hi))
+    return out
+
+
+def clip_intervals(
+    intervals: Sequence[Interval], lo: float, hi: float
+) -> List[Interval]:
+    return [
+        (max(a, lo), min(b, hi))
+        for a, b in intervals
+        if min(b, hi) > max(a, lo)
+    ]
+
+
+# -- async start/done fusion -------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class OpInterval:
+    """One classified device-op occupancy interval.
+
+    For a fused async pair this spans launch (``-start`` begin) to
+    completion (``-done`` end) and ``name`` is the ``-start``
+    instruction's full name — the one the parsed :class:`HloModule`
+    knows (the parser skips ``-done`` halves)."""
+
+    cls: str
+    name: str  # full instruction name, ordinal kept: "all-reduce.17"
+    ts: float
+    end: float
+
+    @property
+    def interval(self) -> Interval:
+        return (self.ts, self.end)
+
+
+def pair_async_collectives(events: Sequence[TraceEvent]) -> List[OpInterval]:
+    """Classified intervals of device-op ``events``, with each async
+    collective's ``-start``/``-done`` fused into one in-flight interval.
+
+    Pairing is FIFO per ``(pid, collective kind)`` in timestamp order:
+    XLA completes same-kind async ops in issue order on a device, and
+    the ``-done`` instruction's ordinal does NOT match its ``-start``'s
+    (so name-matching would be wrong). Unpaired halves keep their own
+    span — a capture window can open between a start and its done.
+    """
+    out: List[OpInterval] = []
+    pending: Dict[Tuple[int, str], List[TraceEvent]] = {}
+    for e in sorted(events, key=lambda e: (e.ts, e.end)):
+        cls = classify_op(e.name)
+        stem = op_base(e.name)
+        if cls == CLASS_COLLECTIVE and stem.endswith("-start"):
+            pending.setdefault((e.pid, stem[:-6]), []).append(e)
+            continue
+        if cls == CLASS_COLLECTIVE and stem.endswith("-done"):
+            queue = pending.get((e.pid, stem[:-5]), [])
+            if queue:
+                start = queue.pop(0)
+                out.append(OpInterval(
+                    cls=CLASS_COLLECTIVE,
+                    name=start.name.lstrip("%"),
+                    ts=start.ts,
+                    end=max(e.end, start.end),
+                ))
+                continue
+        out.append(OpInterval(
+            cls=cls, name=e.name.lstrip("%"), ts=e.ts, end=e.end
+        ))
+    for queue in pending.values():  # starts whose done fell off the capture
+        for e in queue:
+            out.append(OpInterval(
+                cls=CLASS_COLLECTIVE, name=e.name.lstrip("%"),
+                ts=e.ts, end=e.end,
+            ))
+    return out
+
+
+# -- per-step breakdown ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StepBreakdown:
+    """One step's device-time partition (all times microseconds).
+
+    Identity (test-pinned): ``compute_us + exposed_collective_us +
+    exposed_memcpy_us + idle_us == span_us``.
+    """
+
+    step: int
+    ts: float
+    end: float
+    compute_us: float
+    collective_us: float
+    memcpy_us: float
+    exposed_collective_us: float
+    exposed_memcpy_us: float
+    busy_us: float
+    n_ops: int
+
+    @property
+    def span_us(self) -> float:
+        return self.end - self.ts
+
+    @property
+    def idle_us(self) -> float:
+        return self.span_us - self.busy_us
+
+    @property
+    def bubble_fraction(self) -> float:
+        return self.idle_us / self.span_us if self.span_us > 0 else 0.0
+
+    @property
+    def overlap_fraction(self) -> Optional[float]:
+        """Hidden collective time / total collective time; None when the
+        step ran no collectives (0/0 is not 'perfect overlap')."""
+        if self.collective_us <= 0:
+            return None
+        return 1.0 - self.exposed_collective_us / self.collective_us
+
+
+@dataclasses.dataclass
+class AxisBandwidth:
+    """Measured seconds joined to predicted bytes for one mesh axis."""
+
+    axis: str
+    n_events: int
+    n_steps: int
+    measured_us_per_step: float
+    predicted_bytes_per_step: int  # ledger payload convention
+    predicted_ici_bytes_per_step: int  # ring-algorithm wire bytes
+    roofline_bytes_per_s: Optional[float]
+
+    @property
+    def achieved_bytes_per_s(self) -> Optional[float]:
+        """Predicted wire bytes moved per measured second — the axis's
+        realized bandwidth (None when nothing was measured)."""
+        if self.measured_us_per_step <= 0:
+            return None
+        return self.predicted_ici_bytes_per_step / (
+            self.measured_us_per_step * 1e-6
+        )
+
+    @property
+    def utilization(self) -> Optional[float]:
+        """Achieved / roofline, or None when either side is unknown —
+        never a fake number (the peak-FLOPs contract)."""
+        a = self.achieved_bytes_per_s
+        if a is None or not self.roofline_bytes_per_s:
+            return None
+        return a / self.roofline_bytes_per_s
+
+
+@dataclasses.dataclass
+class TimelineReport:
+    """The analyzer's full output: per-step partitions + the per-axis
+    measured-vs-predicted bandwidth join."""
+
+    steps: List[StepBreakdown]
+    axes: List[AxisBandwidth]
+    n_device_ops: int
+    n_unattributed_collectives: int = 0
+    files: List[str] = dataclasses.field(default_factory=list)
+    synthetic_step: bool = False  # no markers: whole capture = one span
+
+    def to_records(self) -> List[dict]:
+        """``kind="profile"`` records in the shared MetricRouter schema:
+        one per step (milliseconds, the partition + fractions), then one
+        per joined axis (stamped with the last step)."""
+        from apex_tpu.monitor.router import make_record
+
+        records = []
+        for s in self.steps:
+            records.append(make_record(
+                "profile", s.step,
+                span_ms=s.span_us / 1e3,
+                compute_ms=s.compute_us / 1e3,
+                collective_ms=s.collective_us / 1e3,
+                exposed_comms_ms=s.exposed_collective_us / 1e3,
+                memcpy_ms=s.memcpy_us / 1e3,
+                exposed_memcpy_ms=s.exposed_memcpy_us / 1e3,
+                idle_ms=s.idle_us / 1e3,
+                overlap_fraction=s.overlap_fraction,
+                bubble_fraction=s.bubble_fraction,
+                n_ops=s.n_ops,
+            ))
+        last_step = self.steps[-1].step if self.steps else 0
+        for ax in self.axes:
+            records.append(make_record(
+                "profile", last_step,
+                axis=ax.axis,
+                events=ax.n_events,
+                measured_ms_per_step=ax.measured_us_per_step / 1e3,
+                predicted_bytes=ax.predicted_bytes_per_step,
+                predicted_ici_bytes=ax.predicted_ici_bytes_per_step,
+                achieved_bytes_per_s=ax.achieved_bytes_per_s,
+                roofline_bytes_per_s=ax.roofline_bytes_per_s,
+                utilization=ax.utilization,
+            ))
+        return records
+
+    def summary(self) -> str:
+        """The human-readable breakdown (the ``--profile-analyze``
+        printout and the CLI's output)."""
+        if not self.steps:
+            return "timeline: no steps found (no device ops in capture)"
+        lines = [
+            f"timeline: {len(self.steps)} step(s), "
+            f"{self.n_device_ops} device op events"
+            + (" [no step markers: whole capture analyzed as one span]"
+               if self.synthetic_step else "")
+        ]
+        for s in self.steps:
+            ov = (
+                f"{100 * s.overlap_fraction:5.1f}%"
+                if s.overlap_fraction is not None else "    -"
+            )
+            lines.append(
+                f"  step {s.step:4d}: span {s.span_us / 1e3:9.3f} ms | "
+                f"compute {s.compute_us / 1e3:8.3f} | "
+                f"collective {s.collective_us / 1e3:8.3f} "
+                f"(exposed {s.exposed_collective_us / 1e3:8.3f}) | "
+                f"memcpy {s.memcpy_us / 1e3:7.3f} | "
+                f"idle {s.idle_us / 1e3:8.3f} "
+                f"(bubble {100 * s.bubble_fraction:5.1f}%) | "
+                f"overlap {ov}"
+            )
+        for ax in self.axes:
+            a = ax.achieved_bytes_per_s
+            ach = f"{a / 1e9:.3f} GB/s achieved" if a is not None else (
+                "no time measured"
+            )
+            util = (
+                f" = {100 * ax.utilization:.1f}% of ICI roofline"
+                if ax.utilization is not None else
+                " (roofline unknown; set APEX_TPU_ICI_BANDWIDTH)"
+            )
+            lines.append(
+                f"  axis {ax.axis!r}: {ax.n_events} collective events, "
+                f"{ax.measured_us_per_step / 1e3:.3f} ms/step measured, "
+                f"{ax.predicted_ici_bytes_per_step / 2**20:.2f} MiB/step "
+                f"predicted wire -> {ach}{util}"
+            )
+        if self.n_unattributed_collectives:
+            lines.append(
+                f"  ({self.n_unattributed_collectives} collective event(s) "
+                f"matched no HLO instruction / axis — not joined)"
+            )
+        return "\n".join(lines)
+
+
+def _axis_of_collective(instr, mesh, partitions) -> str:
+    from apex_tpu.analysis.hlo import attribution
+
+    if instr.kind == "collective-permute":
+        return attribution.classify_source_target_pairs(
+            mesh, instr.source_target_pairs, partitions
+        )
+    return attribution.classify_replica_groups(
+        mesh, instr.replica_groups, partitions
+    )
+
+
+def _predicted_per_axis(ledger, mesh) -> Dict[str, Dict[str, int]]:
+    """The ledger's per-axis totals re-keyed onto attribution labels
+    (size-1 axes dropped, mesh order) so both join sides bucket
+    identically — the comms differ's canon rule."""
+    from apex_tpu.analysis.hlo import attribution
+
+    out: Dict[str, Dict[str, int]] = {}
+    for axis, d in ledger.per_axis().items():
+        key = attribution.canon_axis_key(mesh, axis)
+        if key == attribution.AXIS_NONE:
+            continue
+        agg = out.setdefault(key, {"bytes": 0, "ici_bytes": 0})
+        agg["bytes"] += d["bytes"]
+        agg["ici_bytes"] += d["ici_bytes"]
+    return out
+
+
+def analyze(
+    timeline: Timeline,
+    module=None,
+    mesh=None,
+    ledger=None,
+    ici_bandwidth: Optional[float] = None,
+) -> TimelineReport:
+    """Compute the full report from one parsed capture.
+
+    ``module`` (a parsed :class:`HloModule`), ``mesh``, and ``ledger``
+    (a :class:`CommsLedger`, e.g. from ``xray.predict_comms``) enable
+    the bandwidth join; without them only the per-step partition is
+    produced. ``ici_bandwidth`` (bytes/s per chip) enables the
+    utilization column — pass
+    ``xray.ledger.ici_bandwidth_per_device()`` or a pinned number; the
+    analyzer itself never guesses one.
+    """
+    ops = timeline.device_op_events()
+    intervals = pair_async_collectives(ops)
+    spans = timeline.step_spans()
+    synthetic = False
+    if not spans and intervals:
+        synthetic = True
+        spans = [StepSpan(
+            step=-1,
+            ts=min(o.ts for o in intervals),
+            end=max(o.end for o in intervals),
+        )]
+
+    by_class: Dict[str, List[Interval]] = {
+        CLASS_COMPUTE: [], CLASS_COLLECTIVE: [], CLASS_MEMCPY: [],
+    }
+    for o in intervals:
+        by_class[o.cls].append(o.interval)
+
+    steps: List[StepBreakdown] = []
+    for span in spans:
+        comp = merge_intervals(
+            clip_intervals(by_class[CLASS_COMPUTE], span.ts, span.end)
+        )
+        coll = merge_intervals(
+            clip_intervals(by_class[CLASS_COLLECTIVE], span.ts, span.end)
+        )
+        memc = merge_intervals(
+            clip_intervals(by_class[CLASS_MEMCPY], span.ts, span.end)
+        )
+        busy = merge_intervals(list(comp) + list(coll) + list(memc))
+        n_ops = sum(
+            1 for o in intervals if o.end > span.ts and o.ts < span.end
+        )
+        steps.append(StepBreakdown(
+            step=span.step,
+            ts=span.ts,
+            end=span.end,
+            compute_us=total_us(comp),
+            collective_us=total_us(coll),
+            memcpy_us=total_us(memc),
+            exposed_collective_us=total_us(
+                subtract_intervals(coll, comp)
+            ),
+            exposed_memcpy_us=total_us(subtract_intervals(
+                memc, merge_intervals(list(comp) + list(coll))
+            )),
+            busy_us=total_us(busy),
+            n_ops=n_ops,
+        ))
+
+    axes: List[AxisBandwidth] = []
+    unattributed = 0
+    if module is not None and mesh is not None and steps:
+        from apex_tpu.analysis.hlo import attribution
+
+        partitions = attribution.mesh_axis_partitions(mesh)
+        instr_by_name = {c.name.lstrip("%"): c for c in module.collectives}
+        axis_intervals: Dict[str, List[Interval]] = {}
+        axis_events: Dict[str, int] = {}
+        for o in intervals:
+            if o.cls != CLASS_COLLECTIVE:
+                continue
+            instr = instr_by_name.get(o.name)
+            axis = (
+                _axis_of_collective(instr, mesh, partitions)
+                if instr is not None else None
+            )
+            if axis is None or axis in (
+                attribution.AXIS_NONE, attribution.AXIS_UNKNOWN
+            ):
+                unattributed += 1
+                continue
+            axis_intervals.setdefault(axis, []).append(o.interval)
+            axis_events[axis] = axis_events.get(axis, 0) + 1
+        predicted = (
+            _predicted_per_axis(ledger, mesh) if ledger is not None else {}
+        )
+        for axis in sorted(set(axis_intervals) | set(predicted)):
+            measured = 0.0
+            for span in spans:
+                measured += total_us(merge_intervals(clip_intervals(
+                    axis_intervals.get(axis, []), span.ts, span.end
+                )))
+            pred = predicted.get(axis, {"bytes": 0, "ici_bytes": 0})
+            axes.append(AxisBandwidth(
+                axis=axis,
+                n_events=axis_events.get(axis, 0),
+                n_steps=len(steps),
+                measured_us_per_step=measured / len(steps),
+                predicted_bytes_per_step=pred["bytes"],
+                predicted_ici_bytes_per_step=pred["ici_bytes"],
+                roofline_bytes_per_s=ici_bandwidth,
+            ))
+
+    return TimelineReport(
+        steps=steps,
+        axes=axes,
+        n_device_ops=len(ops),
+        n_unattributed_collectives=unattributed,
+        synthetic_step=synthetic,
+    )
+
+
+def analyze_logdir(
+    logdir: str,
+    module=None,
+    mesh=None,
+    ledger=None,
+    ici_bandwidth: Optional[float] = None,
+) -> TimelineReport:
+    """Parse the newest capture under ``logdir`` and :func:`analyze` it
+    (the ``--profile-analyze`` and CLI entry path)."""
+    timeline, files = parse_logdir(logdir)
+    report = analyze(
+        timeline, module=module, mesh=mesh, ledger=ledger,
+        ici_bandwidth=ici_bandwidth,
+    )
+    report.files = files
+    return report

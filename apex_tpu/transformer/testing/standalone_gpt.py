@@ -189,8 +189,16 @@ def run_gpt(args=None, log=print):
 
     import time
 
+    from apex_tpu.utils.timers import step_annotation
+
     t0 = time.perf_counter()
-    losses = jax.device_get(train(tokens, labels))  # one fetch for ALL steps
+    # the whole run is ONE compiled scan, so per-step markers are
+    # impossible; the single annotation still makes any profiler window
+    # over this run segmentable (as one span covering all steps) by the
+    # timeline analyzer (apex_tpu.monitor.xray.timeline) instead of
+    # marker-less noise
+    with step_annotation(0, name="train_scan"):
+        losses = jax.device_get(train(tokens, labels))  # ONE fetch, all steps
     elapsed = max(time.perf_counter() - t0, 1e-9)
     for i, l in enumerate(losses):
         log(f"iteration {i:4d} | lm loss {float(l):.4f}")

@@ -104,9 +104,18 @@ def annotate(name: str):
         yield
 
 
-def step_annotation(step: int):
-    """Step marker for profiler traces (jax.profiler.StepTraceAnnotation)."""
-    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+def step_annotation(step: int, name: str = "train"):
+    """Step marker for profiler traces (jax.profiler.StepTraceAnnotation).
+
+    The timeline analyzer (``apex_tpu.monitor.xray.timeline``,
+    docs/observability.md#timeline) segments a capture into steps on
+    exactly these markers — a training loop that skips them produces a
+    capture the analyzer can only treat as one undifferentiated span.
+    Wrap the WHOLE step including its host sync (the
+    ``block_until_ready`` / fetch), or the step's device tail is
+    attributed to the next step's span.
+    """
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
 
 
 @contextmanager
@@ -128,7 +137,11 @@ def trace(log_dir: str, **kwargs):
 
     Thin delegation to ``jax.profiler.trace`` (``**kwargs`` forwarded:
     ``create_perfetto_link`` etc.) so the library surface carries the
-    workflow docs without duplicating the mechanism.
+    workflow docs without duplicating the mechanism. Captures are not
+    just for eyeballs: ``apex_tpu.monitor.xray.timeline`` (or
+    ``python -m apex_tpu.monitor.xray.timeline <log_dir>``) turns one
+    into a per-step compute/collective/exposed/idle breakdown — wrap
+    each step in :func:`step_annotation` so it can segment.
     """
     with jax.profiler.trace(log_dir, **kwargs):
         yield

@@ -509,6 +509,28 @@ def run_profile(deadline, out_path):
         }
     if incomplete:
         rec["incomplete"] = incomplete
+    trace_dir = os.environ.get("APEX_TPU_PROFILE_TRACE_DIR")
+    if trace_dir and time.monotonic() < deadline:
+        # measured device-time partition alongside the slope-derived one
+        # (BENCH.md "profile" note): capture one annotated step chain and
+        # attach the timeline analyzer's breakdown. Opt-in — the capture
+        # costs ~one extra chain inside the relay window — and
+        # best-effort: a profiler failure must not void the slope numbers
+        # already in rec.
+        try:
+            from apex_tpu.monitor.xray import timeline
+            from apex_tpu.utils.timers import step_annotation, trace
+
+            with trace(trace_dir):
+                with step_annotation(0, name="bench_step"):
+                    measure(jnp.bfloat16, 256, 224,
+                            deadline=min(deadline,
+                                         time.monotonic() + 120),
+                            mode="step")
+            report = timeline.analyze_logdir(trace_dir)
+            rec["timeline"] = report.summary().splitlines()
+        except Exception as e:
+            rec["timeline_error"] = f"{e!r}"[:200]
     return rec
 
 

@@ -107,6 +107,13 @@ def parse_args():
                    help="capture a jax.profiler trace window at this step")
     p.add_argument("--profile-dir", default=None,
                    help="profiler capture dir (default: <save>/profiles)")
+    p.add_argument("--profile-analyze", action="store_true",
+                   help="after the run, analyze the profiler capture(s) "
+                        "taken: per-step compute/collective/exposed/idle "
+                        "breakdown + achieved bytes/s per mesh axis vs the "
+                        "ledger prediction (apex_tpu.monitor.xray.timeline; "
+                        "kind='profile' records). Implies --profile-step 1 "
+                        "when no capture was otherwise requested")
     p.add_argument("--step-deadline", type=float, default=None,
                    help="stall watchdog: flag a step exceeding this many "
                         "seconds (default: off)")
@@ -163,7 +170,7 @@ def main():
     from apex_tpu.parallel.ddp import all_reduce_gradients
     from apex_tpu.parallel.utils import vma_cond
     from apex_tpu.transformer import TransformerConfig, calc_params_l2_norm
-    from apex_tpu.utils import AutoResume, Timers
+    from apex_tpu.utils import AutoResume, Timers, step_annotation
     from apex_tpu.utils.pytree import tree_any_non_finite
     from apex_tpu import monitor, resilience
     from apex_tpu.resilience import chaos
@@ -372,6 +379,10 @@ def main():
             path=info["path"], reason=info["reason"],
         ),
     )
+    if args.profile_analyze and args.profile_step is None:
+        # the analyzer needs a capture to chew on; step 1 skips the
+        # compile-dominated step 0 so the window shows steady state
+        args.profile_step = 1
     if args.profile_step is not None:
         trigger.request(step=args.profile_step)
     # created here, STARTED after the first completed step: the deadline
@@ -431,6 +442,12 @@ def main():
     scalar_struct = jax.ShapeDtypeStruct((), jnp.float32)
     step_args = (params, opt_state, scaler_state, sent_state, bag,
                  batch_struct, batch_struct, scalar_struct, scalar_struct)
+    # aval-only mirror of step_args for anything that traces AFTER the
+    # first real step: the concrete state leaves in step_args are donated
+    # on the first call, and a post-run trace must not touch dead buffers
+    step_structs = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), step_args
+    )
     comms_led = None
     if args.xray_comms:
         comms_led = monitor.xray.predict_comms(train_step, *step_args)
@@ -570,17 +587,21 @@ def main():
         x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         trigger.maybe_start(step_i)
-        timers("step").start()
-        (params, opt_state, scaler_state, sent_state, bag, loss,
-         verdict) = train_step(
-            params, opt_state, scaler_state, sent_state, bag,
-            jnp.asarray(x), jnp.asarray(y),
-            jnp.asarray(plan.take_nan(step_i), jnp.float32),
-            jnp.asarray(mgr.lr_scale, jnp.float32),
-        )
-        # the loss/verdict fetch below is the step's host sync point, so
-        # the profiler window closes on completed device work
-        timers("step").stop(barrier_on=loss)
+        # step marker: every profiler window carries a span the timeline
+        # analyzer can segment on; the barrier inside keeps the step's
+        # device tail from leaking into the next step's span
+        with step_annotation(step_i):
+            timers("step").start()
+            (params, opt_state, scaler_state, sent_state, bag, loss,
+             verdict) = train_step(
+                params, opt_state, scaler_state, sent_state, bag,
+                jnp.asarray(x), jnp.asarray(y),
+                jnp.asarray(plan.take_nan(step_i), jnp.float32),
+                jnp.asarray(mgr.lr_scale, jnp.float32),
+            )
+            # the loss/verdict fetch below is the step's host sync point,
+            # so the profiler window closes on completed device work
+            timers("step").stop(barrier_on=loss)
         steps_run += 1
         steps_since_emit += 1
         if watchdog is not None:
@@ -680,6 +701,56 @@ def main():
     if watchdog is not None:
         watchdog.stop()
     trigger.close()  # abort any capture still open (end of run)
+    if args.profile_analyze:
+        # device-time timeline of the capture(s) just taken
+        # (apex_tpu.monitor.xray.timeline, docs/observability.md#timeline):
+        # per-step compute/collective/exposed/idle partition segmented on
+        # the step_annotation markers above, and measured per-axis
+        # collective seconds joined to the ledger's predicted bytes.
+        # Blanket-guarded (ProfilerTrigger's contract: losing a trace
+        # must not lose the run) — a torn/truncated capture or a join
+        # failure here must not skip ar.close()'s manifest commit below
+        try:
+            from apex_tpu.monitor.xray import timeline
+
+            if audit_module is None:
+                # the bandwidth join matches trace events to HLO
+                # instruction names — reuse the audits' parsed module
+                # when a --audit-* flag already paid the compile, else
+                # pay one AOT compile here (the --xray-report cost note
+                # applies)
+                from apex_tpu.analysis.hlo import parse_hlo_module
+
+                try:
+                    audit_module = parse_hlo_module(
+                        train_step.lower(*step_structs).compile()
+                    )
+                except (ValueError, TypeError) as e:
+                    print(f"profile analyze: HLO module unavailable ({e}); "
+                          f"bandwidth join skipped")
+            led = (comms_led if comms_led is not None
+                   else monitor.xray.predict_comms(train_step, *step_structs))
+            bw = monitor.xray.ici_bandwidth_per_device()
+            if not trigger.captures:
+                print("profile analyze: no completed capture to analyze "
+                      "(the run must continue window-steps past the capture "
+                      "start)")
+            for cap in trigger.captures:
+                try:
+                    report = timeline.analyze_logdir(
+                        cap["path"], module=audit_module, mesh=mesh,
+                        ledger=led, ici_bandwidth=bw,
+                    )
+                except (FileNotFoundError, ValueError) as e:
+                    print(f"profile analyze: {cap['path']}: {e}")
+                    continue
+                print(f"profile timeline ({cap['path']}):")
+                print(report.summary(), flush=True)
+                for rec in report.to_records():
+                    router.emit(rec)
+        except Exception as e:
+            print(f"profile analyze: failed ({e!r}); training results "
+                  f"unaffected")
     if ar is not None:
         ar.close()  # finalize any in-flight interval save (manifest commit)
     router.close()

@@ -42,6 +42,17 @@ def parse_args():
                    help="diff the optimized HLO's collectives against the "
                         "xray ledger's prediction (apex_tpu.analysis.hlo) "
                         "before running")
+    p.add_argument("--profile-analyze", action="store_true",
+                   help="after training, capture a jax.profiler trace of a "
+                        "few single-step calls (each under a step "
+                        "annotation) and print the device-time breakdown + "
+                        "achieved bytes/s per mesh axis "
+                        "(apex_tpu.monitor.xray.timeline)")
+    p.add_argument("--profile-dir", default=None,
+                   help="profiler capture dir for --profile-analyze "
+                        "(default: a temp dir)")
+    p.add_argument("--profile-steps", type=int, default=3,
+                   help="annotated steps captured by --profile-analyze")
     return p.parse_args()
 
 
@@ -215,6 +226,78 @@ def main():
     print(f"final loss {losses[-1]:.4f}; {args.steps} steps in {dt:.2f}s "
           f"on {jax.devices()[0].platform}")
     assert np.isfinite(losses).all()
+
+    if args.profile_analyze:
+        # device-time timeline (apex_tpu.monitor.xray.timeline,
+        # docs/observability.md#timeline). The main run is ONE compiled
+        # scan — its steps are invisible to a profiler — so the capture
+        # drives a single-step variant a few times from Python, each call
+        # under a step annotation the analyzer segments on. The variant
+        # is not donated (the trained state must survive the loop) and
+        # costs one extra compile. Blanket-guarded: the training above
+        # already finished, and a profiler/capture failure must not turn
+        # a successful run into a nonzero exit (ProfilerTrigger's
+        # losing-a-trace-must-not-lose-the-run contract).
+        import tempfile
+
+        from apex_tpu.monitor.xray import ledger as xled, timeline
+        from apex_tpu.utils.timers import step_annotation
+        from apex_tpu.utils.timers import trace as profiler_trace
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), opt_specs, P("dp"), P("dp")),
+            out_specs=(P(), opt_specs, P()),
+            check_vma=False,
+        )
+        def train_one(params, opt_state, tokens, labels):
+            def loss_fn(p):
+                return jnp.mean(model.apply(p, tokens, labels=labels))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, xlax.pmean(loss, "dp")
+
+        prof_dir = args.profile_dir or tempfile.mkdtemp(
+            prefix="apex_tpu_llama_prof_"
+        )
+        try:
+            # warm the jit OUTSIDE the capture: a compile inside the
+            # first step's span would dwarf every device event
+            params, opt_state, l1 = train_one(params, opt_state, tokens,
+                                              labels)
+            jax.block_until_ready(l1)
+            with profiler_trace(prof_dir):
+                for s in range(max(1, args.profile_steps)):
+                    with step_annotation(s):
+                        params, opt_state, l1 = train_one(
+                            params, opt_state, tokens, labels
+                        )
+                        jax.block_until_ready(l1)
+            led = xled.predict_comms(train_one, params, opt_state, tokens,
+                                     labels)
+            module = None
+            try:
+                from apex_tpu.analysis.hlo import parse_hlo_module
+
+                module = parse_hlo_module(
+                    train_one.lower(params, opt_state, tokens,
+                                    labels).compile()
+                )
+            except (ValueError, TypeError) as e:
+                print(f"profile analyze: HLO module unavailable ({e}); "
+                      f"bandwidth join skipped")
+            report = timeline.analyze_logdir(
+                prof_dir, module=module, mesh=mesh, ledger=led,
+                ici_bandwidth=xled.ici_bandwidth_per_device(),
+            )
+            print(f"profile timeline ({prof_dir}):")
+            print(report.summary(), flush=True)
+        except Exception as e:
+            print(f"profile analyze: failed ({e!r}); training results "
+                  f"unaffected")
 
 
 if __name__ == "__main__":

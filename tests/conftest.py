@@ -49,6 +49,7 @@ _SLOW_TESTS = {
     "test_gpt_pretrain_resume",
     "test_gpt_pretrain_chaos",
     "test_gpt_pretrain_xray",
+    "test_gpt_pretrain_profile_analyze",
     "test_analysis_cli_subprocess",
     "test_sparsity_example",
     "test_llama_finetune_example",

@@ -551,6 +551,42 @@ class TestLintFramework:
         }
         assert run_lint(rules=["lint.hlo-text"], files=files) == []
 
+    def test_trace_file_seeded(self):
+        # a glob/suffix string is a reader's fingerprint, wherever it
+        # appears — docstrings included (unlike hlo-text's NAME tokens,
+        # the format marker only ever appears as a string)
+        files = {
+            "apex_tpu/fake.py":
+                "import gzip\n"
+                "SUFFIX = '.trace.json.gz'\n",
+            "examples/fake2.py":
+                '"""reads the *.trace.json export by hand"""\n',
+        }
+        fins = run_lint(rules=["lint.trace-file"], files=files)
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/fake.py:2", "examples/fake2.py:1",
+        ]
+        assert all(f.rule == "lint.trace-file" for f in fins)
+        assert all(f.severity == "error" for f in fins)
+
+    def test_trace_file_fstring_flagged(self):
+        # 3.12+ tokenizes f-strings as FSTRING_* (literal text in
+        # FSTRING_MIDDLE), not STRING — the rule must catch the reader
+        # fingerprint in both spellings on every supported python
+        files = {
+            "apex_tpu/fake.py": 'p = f"{host}.trace.json.gz"\n',
+        }
+        (f,) = run_lint(rules=["lint.trace-file"], files=files)
+        assert f.site == "apex_tpu/fake.py:1"
+
+    def test_trace_file_comment_mention_not_flagged(self):
+        files = {
+            "apex_tpu/fake.py":
+                "# the parser owns .trace.json reading\n"
+                "x = 1\n",
+        }
+        assert run_lint(rules=["lint.trace-file"], files=files) == []
+
     def test_unknown_rule_raises(self):
         with pytest.raises(KeyError, match="lint.nope"):
             run_lint(rules=["lint.nope"], files={})

@@ -123,6 +123,50 @@ def test_gpt_pretrain_xray(tmp_path):
     assert "metrics" in by_kind
 
 
+def test_gpt_pretrain_profile_analyze(tmp_path):
+    """ACCEPTANCE round trip: a real CPU-captured profiler trace of the
+    dp4xtp2 GPT step, analyzed by the timeline module end to end. The
+    run wraps each step in a step_annotation, ProfilerTrigger captures a
+    window at step 1, and --profile-analyze must segment >= 2 steps,
+    report a non-degenerate device-time partition (identity: compute +
+    exposed comms + exposed memcpy + idle == span), and join measured
+    collective seconds to the ledger's predicted per-axis bytes —
+    kind='profile' records landing in the SAME jsonl stream as metrics
+    (the one-tailer contract)."""
+    import json
+
+    jsonl = tmp_path / "metrics.jsonl"
+    out = _run("examples/gpt/pretrain_gpt.py",
+               ["--steps", "4", "--layers", "2", "--hidden", "64",
+                "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
+                "--global-batch", "16", "--tp", "2",
+                "--save", str(tmp_path / "ckpt"),
+                "--metrics-jsonl", str(jsonl), "--profile-analyze"])
+    assert "profile timeline" in out
+    assert "2 step(s)" in out
+    records = [json.loads(line) for line in jsonl.read_text().splitlines()]
+    profile = [r for r in records if r["kind"] == "profile"]
+    steps = [r for r in profile if "span_ms" in r]
+    axes = [r for r in profile if "axis" in r]
+    assert len(steps) >= 2          # the capture window held >= 2 steps
+    for rec in steps:
+        assert rec["span_ms"] > 0 and rec["compute_ms"] > 0
+        assert rec["collective_ms"] > 0 and rec["n_ops"] > 0
+        # the partition identity survives the record round trip
+        total = (rec["compute_ms"] + rec["exposed_comms_ms"]
+                 + rec["exposed_memcpy_ms"] + rec["idle_ms"])
+        assert total == pytest.approx(rec["span_ms"], rel=1e-6)
+    # >= 1 collective event joined to a ledger-predicted byte bucket on
+    # each mesh axis -> an achieved-bandwidth record
+    assert {r["axis"] for r in axes} == {"dp", "tp"}
+    for rec in axes:
+        assert rec["events"] > 0
+        assert rec["predicted_ici_bytes"] > 0
+        assert rec["achieved_bytes_per_s"] > 0
+    # the shared stream still carries the ordinary metrics
+    assert any(r["kind"] == "metrics" for r in records)
+
+
 def test_gpt_pretrain_resume(tmp_path):
     """Checkpoint-then-resume through the example's AutoResume wiring: the
     second invocation must pick up at the saved step, not step 0 (the
@@ -167,16 +211,25 @@ def test_gpt_pretrain_chaos(tmp_path):
     assert "step    11" in out  # ran to completion
 
 
-def test_llama_finetune_example():
+def test_llama_finetune_example(tmp_path):
     # --audit-donation: the donation auditor must verify that params AND
     # the ZeRO opt-state alias in place (the opt-state donation is what
     # keeps ZeRO-2 from double-buffering its fp32 master+moments).
     # --audit-comms: the ZeRO gather/scatter collectives XLA emits for
-    # the scanned train step must all match the ledger prediction
+    # the scanned train step must all match the ledger prediction.
+    # --profile-analyze: the post-run capture of the single-step variant
+    # must segment into the annotated steps and produce a joined
+    # breakdown (pins the whole llama profile path — train_one's
+    # shard_map closure, the capture loop, and the bandwidth join)
     out = _run("examples/llama/finetune_llama.py",
-               ["--steps", "20", "--audit-donation", "--audit-comms"])
+               ["--steps", "20", "--audit-donation", "--audit-comms",
+                "--profile-analyze", "--profile-steps", "2",
+                "--profile-dir", str(tmp_path / "prof")])
     assert "donation audit: ok" in out
     assert "comms audit: ok" in out
+    assert "profile timeline" in out
+    assert "timeline: 2 step(s)" in out
+    assert "axis 'dp'" in out
     assert "final loss" in out
     # memorization demo: loss must fall well below the uniform floor
     final = float(out.split("final loss")[1].split(";")[0])
