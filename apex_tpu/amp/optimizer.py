@@ -60,17 +60,28 @@ class AmpOptimizer:
         self.num_losses = int(num_losses)
 
     def init(self, params) -> AmpOptimizerState:
-        if self.policy.master_weights:
-            master = tree_cast(params, jnp.float32)
-        else:
-            master = params
-        if self.num_losses > 1:
-            scaler = tuple(self.scaler.init() for _ in range(self.num_losses))
-        else:
-            scaler = self.scaler.init()
-        return AmpOptimizerState(
-            master=master, inner=self.tx.init(master), scaler=scaler
-        )
+        # goodput span (apex_tpu.monitor.goodput): the master-weight
+        # materialization (a full fp32 copy of the params) + optimizer
+        # state build is real setup wall time — init badput in the
+        # run-level ledger when a span router is registered, free
+        # otherwise. Under a jit trace the span measures trace time,
+        # which is the host cost actually paid here.
+        from apex_tpu.monitor.goodput.spans import span as _goodput_span
+
+        with _goodput_span("init"):
+            if self.policy.master_weights:
+                master = tree_cast(params, jnp.float32)
+            else:
+                master = params
+            if self.num_losses > 1:
+                scaler = tuple(
+                    self.scaler.init() for _ in range(self.num_losses)
+                )
+            else:
+                scaler = self.scaler.init()
+            return AmpOptimizerState(
+                master=master, inner=self.tx.init(master), scaler=scaler
+            )
 
     def _scaler_state(self, state: AmpOptimizerState, loss_id: int):
         if isinstance(state.scaler, tuple):

@@ -47,6 +47,14 @@ Plus the new rules this framework exists to host:
   for HLO text, applied to XProf's export. String-token based (a code
   COMMENT mentioning the format is fine; a docstring or glob pattern
   is a reader's fingerprint and routes to the shared parser).
+- ``lint.span-phases`` — every goodput span call site
+  (``span``/``begin_span``/``Span``/``emit_span`` and their import
+  aliases) must name its phase with literals from the CLOSED registry
+  ``monitor.goodput.spans.PHASES``. The goodput partition is only
+  comparable across runs when every run buckets wall time the same way;
+  an ad-hoc phase string fragments the taxonomy (the accountant would
+  silently skip it), and a variable phase defeats the review-time
+  check, so both are errors.
 """
 
 import ast
@@ -351,6 +359,98 @@ def trace_file(ctx: LintContext) -> Iterable[Finding]:
                     ),
                     site=f"{rel}:{t.start[0]}", severity=SEV_ERROR,
                 )
+
+
+#: goodput span constructors -> position of their ``phase`` argument
+#: (emit_span takes the router first). Aliased imports are caught by the
+#: ``*_span`` suffix match in :func:`span_phases`.
+_SPAN_CALLEES = {"span": 0, "begin_span": 0, "Span": 0, "emit_span": 1}
+
+
+@lint_rule("lint.span-phases", scopes=("apex_tpu/", "examples/"))
+def span_phases(ctx: LintContext) -> Iterable[Finding]:
+    """Goodput span call sites whose phase is not a registry literal.
+
+    AST-based: matches calls whose terminal name is a span constructor
+    (``goodput.span(...)``, ``begin_span(...)``, ``Span(...)``,
+    ``emit_span(...)``) or an import alias ending in ``_span``; the
+    phase argument's string constants must ALL be in
+    ``monitor.goodput.spans.PHASES`` (a conditional of two literals is
+    fine), and a phase expression with no string constant at all is a
+    variable phase — unverifiable, flagged. Calls with no phase argument
+    or a non-string constant one (``m.span(1)`` on a regex match) are
+    not span-ledger calls and are skipped."""
+    from apex_tpu.monitor.goodput.spans import PHASES
+
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.span-phases",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.id if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute)
+                else None
+            )
+            if name in _SPAN_CALLEES:
+                pos = _SPAN_CALLEES[name]
+            elif name is not None and name.endswith("_span"):
+                pos = 0  # import alias: `from ... import span as _x_span`
+            else:
+                continue
+            phase_expr = None
+            for kw in node.keywords:
+                if kw.arg == "phase":
+                    phase_expr = kw.value
+            if phase_expr is None and len(node.args) > pos:
+                arg = node.args[pos]
+                if not isinstance(arg, ast.Starred):
+                    phase_expr = arg
+            if phase_expr is None:
+                continue  # no phase argument: not a span-ledger call
+            if (isinstance(phase_expr, ast.Constant)
+                    and not isinstance(phase_expr.value, str)):
+                continue  # m.span(1): a regex match-group, not a phase
+            strings = [
+                n.value for n in ast.walk(phase_expr)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            ]
+            if not strings:
+                yield Finding(
+                    rule="lint.span-phases",
+                    message=(
+                        f"span call {name!r} passes a non-literal phase — "
+                        f"the closed taxonomy (goodput.spans.PHASES) is "
+                        f"only enforceable on literals; name the phase "
+                        f"inline (or allowlist the forwarding helper "
+                        f"with its reason)"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"callee": name},
+                )
+                continue
+            for s in strings:
+                if s not in PHASES:
+                    yield Finding(
+                        rule="lint.span-phases",
+                        message=(
+                            f"unknown span phase {s!r} — the taxonomy is "
+                            f"closed (goodput.spans.PHASES: "
+                            f"{', '.join(PHASES)}); an ad-hoc phase "
+                            f"fragments the goodput partition across runs"
+                        ),
+                        site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                        data={"callee": name, "phase": s},
+                    )
 
 
 @lint_rule("lint.float64", scopes=("apex_tpu/",))

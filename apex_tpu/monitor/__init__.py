@@ -31,6 +31,11 @@ profiling subsystem (PAPERS.md). Four cooperating pieces:
   (args/outputs/temps vs device headroom), and the recompile sentinel
   (:class:`~apex_tpu.monitor.xray.CompileWatcher`) — all emitting
   ``kind="comms"/"memory"/"compile"`` records through the router.
+- ``goodput``  — the RUN-level ledger over everything above: phase spans
+  (``kind="span"``: init/compile/data_wait/step/ckpt/rollback/stall/
+  shutdown) + run headers joining restart incarnations, the goodput/
+  badput accountant, the fleet-health divergence detector, and the
+  perf-regression sentinel (``python -m apex_tpu.monitor.goodput``).
 
 See docs/observability.md for the end-to-end wiring.
 
@@ -76,7 +81,7 @@ _EXPORTS = {
 }
 
 __all__ = sorted(_EXPORTS) + [
-    "metrics", "router", "flops", "watchdog", "taps", "xray",
+    "metrics", "router", "flops", "watchdog", "taps", "xray", "goodput",
 ]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)

@@ -6,38 +6,89 @@ flat record shape (:func:`make_record`), so one consumer (a jsonl tailer,
 a dashboard) can join metrics with anomalies on ``step`` without per-
 producer parsers:
 
-    {"t": <unix time>, "step": <int>, "kind": <str>, ...fields}
+    {"t": <unix time>, "step": <int>, "kind": <str>, "host": <int>, ...}
 
 ``kind`` partitions the stream: "metrics" (interval scalars), "timer"
 (named timer averages), the resilience kinds ("skip", "rollback",
 "rollback_restore", "halt") which predate this module and keep their
 exact historical shape — the schema was chosen to match them — the
-xray kinds ("comms", "memory", "compile"), and "analysis"
+xray kinds ("comms", "memory", "compile"), "analysis"
 (static-auditor findings from apex_tpu.analysis: rule/site/severity
-plus the allowlist verdict), so pre-flight audit results land in the
-same jsonl a tailer already reads.
+plus the allowlist verdict), and the goodput kinds ("run", "span",
+"stall", "goodput", "fleet", "bench" — apex_tpu.monitor.goodput), so
+pre-flight audit results and run-lifecycle accounting land in the same
+jsonl a tailer already reads.
+
+``host`` is the producing process's index (``jax.process_index()``) so
+merged multi-host streams stay attributable; it defaults to 0 and is
+resolved WITHOUT importing or initializing jax (see :func:`make_record`)
+— the record schema stays importable and usable on a jax-free box.
 
 Sinks are deliberately dumb append-only writers; the router owns fan-out
 and failure isolation (one broken sink must not take down training — a
 metrics pipeline that can kill the run is worse than no metrics).
 """
 
+import atexit
 import collections
 import csv
 import json
 import logging
 import os
+import signal as _signal
 import sys
 import threading
 import time
+import weakref
 from typing import Deque, Dict, List, Optional, Sequence
 
 logger = logging.getLogger("apex_tpu.monitor")
 
+_HOST_CACHE: Optional[int] = None
+
+
+def _default_host() -> int:
+    """This process's fleet index, resolved lazily and jax-free-safely.
+
+    ``jax.process_index()`` is only consulted when jax is ALREADY
+    imported AND its backends are already initialized (the
+    ``xla_bridge._backends`` probe) — calling it earlier would trigger
+    backend initialization from a telemetry helper, which on this box
+    can mean claiming the TPU relay. Until then records say host 0,
+    which is correct for every single-process run; ``APEX_TPU_HOST``
+    overrides for producers that know better (multi-process launchers,
+    tests synthesizing fleets).
+    """
+    global _HOST_CACHE
+    env = os.environ.get("APEX_TPU_HOST")
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    if _HOST_CACHE is None:
+        jax = sys.modules.get("jax")
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if jax is None or xb is None or not getattr(xb, "_backends", None):
+            return 0
+        try:
+            _HOST_CACHE = int(jax.process_index())
+        except Exception:  # backend mid-init or API drift: stay at 0
+            return 0
+    return _HOST_CACHE
+
 
 def make_record(kind: str, step: int, **fields) -> dict:
-    """The one shared record shape (see module docstring)."""
-    return {"t": time.time(), "step": int(step), "kind": str(kind), **fields}
+    """The one shared record shape (see module docstring).
+
+    ``host`` defaults to this process's index (:func:`_default_host`);
+    pass ``host=`` explicitly to override (replaying or synthesizing
+    another host's stream).
+    """
+    return {
+        "t": time.time(), "step": int(step), "kind": str(kind),
+        "host": _default_host(), **fields,
+    }
 
 
 class Sink:
@@ -57,20 +108,28 @@ class MemorySink(Sink):
     seconds must not grow host memory without limit, so the oldest
     records evict once ``max_records`` is reached (the file sinks are
     the durable record; this one is a window). ``max_records=None``
-    removes the cap — opt into the leak explicitly.
+    removes the cap — opt into the leak explicitly. ``kinds`` filters
+    to the listed record kinds (the CsvSink convention; default: keep
+    everything) so a consumer interested in one slice of the stream —
+    the examples' goodput-accounting window keeps only run/span — does
+    not spend its window on the rest.
     """
 
     DEFAULT_MAX_RECORDS = 100_000
 
-    def __init__(self, max_records: Optional[int] = DEFAULT_MAX_RECORDS):
+    def __init__(self, max_records: Optional[int] = DEFAULT_MAX_RECORDS,
+                 kinds=None):
         if max_records is not None and max_records < 1:
             raise ValueError(
                 f"max_records must be >= 1 or None, got {max_records}"
             )
         self.max_records = max_records
+        self.kinds = None if kinds is None else frozenset(kinds)
         self.records: Deque[dict] = collections.deque(maxlen=max_records)
 
     def emit(self, record: dict) -> None:
+        if self.kinds is not None and record.get("kind") not in self.kinds:
+            return
         self.records.append(record)
 
 
@@ -99,10 +158,17 @@ class CsvSink(Sink):
     are FILTERED, not errored — pass ``kinds=None`` to accept everything
     at your own risk, or use jsonl for open schemas. Later records may
     omit columns (written empty); a genuinely new key after the header is
-    frozen is surfaced via the router's isolation log. Re-opening an
+    frozen is surfaced via the router's isolation log — EXCEPT the
+    schema-plumbing keys in :data:`TOLERATED_EXTRA_KEYS` ("host"), which
+    are silently dropped so a CSV written before the schema grew them
+    resumes cleanly instead of rejecting every record. Re-opening an
     existing non-empty file adopts ITS header instead of writing a second
     one mid-file (resume with the same --metrics-csv path).
     """
+
+    #: record keys a frozen header may lack without dropping the row:
+    #: schema additions that are plumbing, not data (see class docstring)
+    TOLERATED_EXTRA_KEYS = frozenset({"host"})
 
     def __init__(self, path: str, kinds=("metrics",)):
         self.path = path
@@ -124,7 +190,11 @@ class CsvSink(Sink):
         if self._writer is None:
             self._writer = csv.DictWriter(self._f, fieldnames=list(record))
             self._writer.writeheader()
-        self._writer.writerow(record)  # raises on extra keys
+        elif not (set(record) - set(self._writer.fieldnames)
+                  - self.TOLERATED_EXTRA_KEYS):
+            record = {k: v for k, v in record.items()
+                      if k in self._writer.fieldnames}
+        self._writer.writerow(record)  # raises on (non-tolerated) extra keys
         self._f.flush()
 
     def close(self) -> None:
@@ -136,11 +206,16 @@ class StdoutSink(Sink):
 
     "metrics" records render as ``step  NNNN loss   X.XXXX k v ...`` —
     the exact prefix the example tests (and human eyeballs) key on; other
-    kinds render as ``[kind] step N k=v ...``.
+    kinds render as ``[kind] step N k=v ...``. ``skip_kinds`` defaults to
+    the goodput plumbing kinds ("span", "run"): they fire per loop
+    iteration and exist for the accountant, not the console — the file
+    sinks carry them. The ``host`` field is likewise plumbing and never
+    rendered.
     """
 
-    def __init__(self, stream=None):
+    def __init__(self, stream=None, skip_kinds=("span", "run")):
         self.stream = stream or sys.stdout
+        self.skip_kinds = frozenset(skip_kinds or ())
 
     @staticmethod
     def _fmt(v) -> str:
@@ -151,8 +226,11 @@ class StdoutSink(Sink):
         return str(v)
 
     def emit(self, record: dict) -> None:
+        if record.get("kind") in self.skip_kinds:
+            return
         rest = {
-            k: v for k, v in record.items() if k not in ("t", "step", "kind")
+            k: v for k, v in record.items()
+            if k not in ("t", "step", "kind", "host")
         }
         if record["kind"] == "metrics":
             parts = [f"step {record['step']:5d}"]
@@ -191,7 +269,9 @@ class TensorBoardSink(Sink):
         step = record["step"]
         kind = record["kind"]
         for k, v in record.items():
-            if k in ("t", "step", "kind") or not isinstance(v, (int, float)):
+            # host is schema plumbing, not a scalar series worth a chart
+            if (k in ("t", "step", "kind", "host")
+                    or not isinstance(v, (int, float))):
                 continue
             self._writer.add_scalar(f"{kind}/{k}", v, step)
 
@@ -221,6 +301,61 @@ def try_tensorboard_sink(log_dir: str) -> Optional[TensorBoardSink]:
     return TensorBoardSink(log_dir)
 
 
+#: live routers, flushed+closed best-effort at interpreter exit / SIGTERM
+_LIVE_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
+#: callables run BEFORE routers close in the teardown path — the goodput
+#: span ledger registers its open-span flush here so a SIGTERM-killed run
+#: still lands its in-flight spans (marked interrupted) in the stream
+_FLUSH_HOOKS: List = []
+_TEARDOWN = {"installed": False}
+
+
+def register_flush_hook(fn) -> None:
+    """Run ``fn()`` before routers close in the exit/SIGTERM teardown."""
+    if fn not in _FLUSH_HOOKS:
+        _FLUSH_HOOKS.append(fn)
+
+
+def _flush_all_routers() -> None:
+    for fn in list(_FLUSH_HOOKS):
+        try:
+            fn()
+        except Exception:  # teardown must never raise
+            pass
+    for router in list(_LIVE_ROUTERS):
+        try:
+            router.close()
+        except Exception:
+            pass
+
+
+def _install_teardown() -> None:
+    """Best-effort atexit + SIGTERM flush (installed once, lazily).
+
+    The SIGTERM hook only installs over the DEFAULT handler — anything
+    custom (pytest plugins, a launcher) keeps precedence, and
+    ``AutoResume`` installing its preemption handler LATER simply
+    replaces this one (its flag-and-exit path reaches the normal close).
+    Our handler flushes, restores the default disposition, and re-raises
+    the signal so the process still dies by SIGTERM — the chaos
+    harness's real-SIGTERM drill must not be converted into a survival.
+    """
+    if _TEARDOWN["installed"]:
+        return
+    _TEARDOWN["installed"] = True
+    atexit.register(_flush_all_routers)
+    try:
+        if _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL:
+            def _on_term(signum, frame):
+                _flush_all_routers()
+                _signal.signal(signum, _signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+            _signal.signal(_signal.SIGTERM, _on_term)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
+
+
 class MetricRouter:
     """Fan one record stream out to sinks, isolating sink failures.
 
@@ -232,11 +367,27 @@ class MetricRouter:
     stall watchdog (and any other daemon thread) emits concurrently with
     the training loop, and interleaved writes on a shared file object
     would corrupt the stream.
+
+    Lifecycle: usable as a context manager; :meth:`close` is idempotent
+    and a record emitted after close is dropped with one warning (a
+    daemon thread racing shutdown must not crash it). Every router is
+    also registered for a best-effort atexit/SIGTERM flush-and-close
+    (:func:`register_flush_hook` runs first), so an abnormal exit cannot
+    tear buffered records — or the goodput ledger's final spans — off
+    the stream.
     """
 
     def __init__(self, sinks: Sequence[Sink] = ()):
         self.sinks: List[Sink] = list(sinks)
-        self._lock = threading.Lock()
+        # RLock, not Lock: the SIGTERM teardown runs as a signal handler
+        # IN the main thread and may interrupt an in-flight emit — a
+        # non-reentrant lock would deadlock close() against the very
+        # frame it interrupted
+        self._lock = threading.RLock()
+        self._closed = False
+        self._warned_closed = False
+        _LIVE_ROUTERS.add(self)
+        _install_teardown()
 
     def add_sink(self, sink: Sink) -> "MetricRouter":
         self.sinks.append(sink)
@@ -244,6 +395,14 @@ class MetricRouter:
 
     def emit(self, record: dict) -> None:
         with self._lock:
+            if self._closed:
+                if not self._warned_closed:
+                    self._warned_closed = True
+                    logger.warning(
+                        "record emitted after router close (step %s) — "
+                        "dropped", record.get("step"),
+                    )
+                return
             for sink in self.sinks:
                 try:
                     sink.emit(record)
@@ -277,7 +436,12 @@ class MetricRouter:
         return write
 
     def close(self) -> None:
+        """Close every sink once; later calls (and the exit teardown
+        re-closing an already-closed router) are no-ops."""
         with self._lock:
+            if self._closed:
+                return
+            self._closed = True
             for sink in self.sinks:
                 try:
                     sink.close()
@@ -285,3 +449,9 @@ class MetricRouter:
                     logger.warning(
                         "sink %s close failed: %s", type(sink).__name__, e
                     )
+
+    def __enter__(self) -> "MetricRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -32,8 +32,14 @@ class StallWatchdog:
     polls the wall clock. On expiry, ``on_stall(info)`` runs ONCE in the
     watchdog thread (info: last step, seconds since its beat) and the dog
     re-arms on the next beat — a recovered stall can fire again, a dead
-    loop does not spam. The default action logs; pass a router-backed
-    callback (or a :class:`ProfilerTrigger`) for records/captures.
+    loop does not spam. The default action logs; pass ``router=`` a
+    :class:`~apex_tpu.monitor.MetricRouter` and each stall ALSO lands in
+    the record stream as a ``kind="stall"`` event plus a ``kind="span"``
+    record (phase ``stall``, spanning from the last heartbeat) — the
+    stream the goodput accountant reads, so detected dead time shows up
+    as badput instead of living only in this object's memory and the
+    warning log. ``on_stall`` (e.g. a :class:`ProfilerTrigger`) composes
+    with the router.
 
     Usable as a context manager; ``beat`` and ``stop`` are thread-safe.
     """
@@ -43,12 +49,14 @@ class StallWatchdog:
         deadline_s: float,
         on_stall: Optional[Callable[[dict], None]] = None,
         poll_s: Optional[float] = None,
+        router=None,
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = float(deadline_s)
         self.poll_s = float(poll_s) if poll_s else min(1.0, self.deadline_s / 4)
         self.on_stall = on_stall
+        self.router = router
         self.stalls: List[dict] = []
         self._lock = threading.Lock()
         self._last_beat = time.monotonic()
@@ -80,6 +88,7 @@ class StallWatchdog:
         while not self._stop.wait(self.poll_s):
             with self._lock:
                 overdue = time.monotonic() - self._last_beat
+                beat_mono = self._last_beat
                 fired, step = self._fired, self._last_step
                 if overdue > self.deadline_s and not fired:
                     self._fired = True
@@ -95,6 +104,22 @@ class StallWatchdog:
                 "stall: no step heartbeat for %.1fs (deadline %.1fs, "
                 "last step %s)", overdue, self.deadline_s, step,
             )
+            if self.router is not None:
+                try:
+                    self.router.event(
+                        "stall", -1 if step is None else step,
+                        overdue_s=overdue, deadline_s=self.deadline_s,
+                    )
+                    # the stall's duration as a goodput span: measured
+                    # FROM the last heartbeat — the dead time started
+                    # when the loop went quiet, not when the dog barked
+                    from apex_tpu.monitor.goodput.spans import emit_span
+
+                    emit_span(
+                        self.router, "stall", beat_mono, overdue, step=step,
+                    )
+                except Exception as e:  # the dog must outlive its sinks
+                    logger.warning("stall record emit failed: %s", e)
             if self.on_stall is not None:
                 try:
                     self.on_stall(info)
@@ -135,6 +160,11 @@ class ProfilerTrigger:
     lose the run. Remember the benchmarking caveat: callers must
     ``jax.block_until_ready`` the step's outputs before ``maybe_stop`` or
     in-flight device work leaks out of the window.
+
+    Pass ``router=`` a :class:`~apex_tpu.monitor.MetricRouter` and each
+    completed capture emits its own ``kind="profile"`` record
+    (path/reason/end_step at the capture's start step) — the wiring the
+    examples previously hand-rolled as an ``on_capture`` lambda.
     """
 
     def __init__(
@@ -142,12 +172,14 @@ class ProfilerTrigger:
         log_dir: str,
         window_steps: int = 2,
         on_capture: Optional[Callable[[dict], None]] = None,
+        router=None,
     ):
         if window_steps < 1:
             raise ValueError(f"window_steps must be >= 1, got {window_steps}")
         self.log_dir = log_dir
         self.window_steps = int(window_steps)
         self.on_capture = on_capture
+        self.router = router
         self.captures: List[dict] = []
         self._requested: Optional[dict] = None  # {"step": int|None, "reason"}
         self._active: Optional[dict] = None
@@ -219,6 +251,14 @@ class ProfilerTrigger:
         self._active = None
         info = {**act, "end_step": step}
         self.captures.append(info)
+        if self.router is not None:
+            try:
+                self.router.event(
+                    "profile", info["start_step"], path=info["path"],
+                    reason=info["reason"], end_step=step,
+                )
+            except Exception as e:
+                logger.warning("profile record emit failed: %s", e)
         if self.on_capture is not None:
             try:
                 self.on_capture(info)

@@ -239,7 +239,14 @@ class ResilienceManager:
             and self.buffer.steps
             and self.buffer.steps[-1] == self._last_restore_step
         )
-        step, state = self.buffer.rollback(pop=bool(pop))
+        # goodput span: recovery wall time (snapshot restore +
+        # device_put) is rollback badput in the run-level ledger; emitted
+        # through THIS manager's router so the span lands in the same
+        # stream as the rollback/rollback_restore events below
+        from apex_tpu.monitor.goodput.spans import span as _goodput_span
+
+        with _goodput_span("rollback", router=self.router):
+            step, state = self.buffer.rollback(pop=bool(pop))
         self.rollbacks_used += 1
         self.lr_scale = max(
             self.policy.min_lr_scale, self.lr_scale * self.policy.lr_dampen

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.monitor.goodput.spans import span as _goodput_span
 from apex_tpu.utils.checkpoint import (
     AsyncCheckpointWriter,
     latest_step,
@@ -136,48 +137,62 @@ class AutoResume:
         if self._pending is None:
             return
         step, fingerprint = self._pending
-        self._writer.wait()
-        if jax.process_index() == 0:
-            integrity = self._integrity()
-            # retried, and _pending is only cleared on success: a transient
-            # manifest-write failure is re-attempted at the next finalize
-            # point instead of silently losing the commit marker
-            integrity.save_with_retry(
-                lambda: integrity.write_manifest(
-                    os.path.join(self.directory, f"step_{step}"),
-                    fingerprint=fingerprint,
-                ),
-                retries=self.save_retries, backoff=self.save_backoff,
-            )
-            if self.keep_last_n is not None:
-                integrity.apply_retention(self.directory, self.keep_last_n)
+        # goodput span: host wall time BLOCKED on checkpoint durability
+        # (the wait + manifest commit + retention sweep) — the piece of
+        # ckpt_save badput the async overlap did NOT hide
+        with _goodput_span("ckpt_save", step=step):
+            self._writer.wait()
+            if jax.process_index() == 0:
+                integrity = self._integrity()
+                # retried, and _pending is only cleared on success: a
+                # transient manifest-write failure is re-attempted at the
+                # next finalize point instead of silently losing the
+                # commit marker
+                integrity.save_with_retry(
+                    lambda: integrity.write_manifest(
+                        os.path.join(self.directory, f"step_{step}"),
+                        fingerprint=fingerprint,
+                    ),
+                    retries=self.save_retries, backoff=self.save_backoff,
+                )
+                if self.keep_last_n is not None:
+                    integrity.apply_retention(self.directory,
+                                              self.keep_last_n)
         self._pending = None
 
     def _save(self, step: int, state: Any, durable: bool) -> None:
         integrity = self._integrity()
         if not self.use_async:
-            integrity.save_checkpoint_verified(
-                self.directory, step, state,
-                retries=self.save_retries, backoff=self.save_backoff,
-                keep_last_n=self.keep_last_n if jax.process_index() == 0 else None,
-            )
+            with _goodput_span("ckpt_save", step=step):
+                integrity.save_checkpoint_verified(
+                    self.directory, step, state,
+                    retries=self.save_retries, backoff=self.save_backoff,
+                    keep_last_n=(self.keep_last_n
+                                 if jax.process_index() == 0 else None),
+                )
             return
         self.finalize()  # previous pending save first (ordering + bounded lag)
         if self._writer is None:
             self._writer = AsyncCheckpointWriter()
-        # fingerprint NOW: the caller may donate/mutate these buffers the
-        # moment step() returns, and the manifest commits later
-        fingerprint = (
-            integrity.tree_fingerprint(state) if self.leaf_fingerprint else None
-        )
-        # the retry covers save ISSUANCE (snapshot-to-host + handoff); an
-        # error in the background write itself surfaces un-retried at the
-        # next finalize()'s wait() — by then the source buffers may be
-        # donated, so there is nothing left to re-save from
-        integrity.save_with_retry(
-            lambda: self._writer.save(self.directory, step, state),
-            retries=self.save_retries, backoff=self.save_backoff,
-        )
+        # goodput span: the synchronous slice of an async save — the
+        # fingerprint's device->host copy and the write ISSUANCE (the
+        # background write itself overlaps training and is accounted by
+        # finalize()'s span when it blocks)
+        with _goodput_span("ckpt_save", step=step):
+            # fingerprint NOW: the caller may donate/mutate these buffers
+            # the moment step() returns, and the manifest commits later
+            fingerprint = (
+                integrity.tree_fingerprint(state)
+                if self.leaf_fingerprint else None
+            )
+            # the retry covers save ISSUANCE (snapshot-to-host + handoff);
+            # an error in the background write itself surfaces un-retried
+            # at the next finalize()'s wait() — by then the source buffers
+            # may be donated, so there is nothing left to re-save from
+            integrity.save_with_retry(
+                lambda: self._writer.save(self.directory, step, state),
+                retries=self.save_retries, backoff=self.save_backoff,
+            )
         self._pending = (step, fingerprint)
         if durable:
             self.finalize()
@@ -280,14 +295,18 @@ class AutoResume:
         raw latest step and lets corruption crash the run.
         """
         self.finalize()
-        if not self.verify:
-            step = latest_step(self.directory)
-            if step is None:
+        # goodput span: restart recovery cost (badput phase ckpt_restore)
+        with _goodput_span("ckpt_restore"):
+            if not self.verify:
+                step = latest_step(self.directory)
+                if step is None:
+                    return 0, init_state
+                return step, load_checkpoint(
+                    self.directory, step, target=init_state
+                )
+            try:
+                return self._integrity().load_checkpoint_verified(
+                    self.directory, target=init_state, allow_unverified=True
+                )
+            except FileNotFoundError:
                 return 0, init_state
-            return step, load_checkpoint(self.directory, step, target=init_state)
-        try:
-            return self._integrity().load_checkpoint_verified(
-                self.directory, target=init_state, allow_unverified=True
-            )
-        except FileNotFoundError:
-            return 0, init_state

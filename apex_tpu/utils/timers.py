@@ -5,6 +5,22 @@ Reference parity: apex/transformer/pipeline_parallel/_timers.py (`_Timer`
 ``jax.block_until_ready`` replaces ``torch.cuda.synchronize`` and
 ``jax.profiler`` trace annotations replace NVTX ranges
 (parallel/distributed.py:363 nvtx.range_push sites).
+
+Three timing layers, three questions (don't conflate them):
+
+- ``Timers``/``_Timer`` here — named INTERVAL averages ("how long is a
+  step lately"), barriered via block_until_ready, reported per log
+  interval as ``kind="timer"`` records.
+- ``step_annotation``/``trace`` — DEVICE-time markers a profiler
+  capture segments on; the timeline analyzer answers "where did the
+  step's wall clock go".
+- ``apex_tpu.monitor.goodput.span`` — run-LIFECYCLE wall-clock spans
+  (``kind="span"``: compile, data_wait, step, ckpt_save/restore,
+  rollback, stall...) the goodput accountant partitions into
+  productive/badput; answers "where did the JOB's wall clock go"
+  (docs/observability.md "Goodput & fleet health"). The examples wrap
+  each loop iteration in BOTH a step span and a step annotation — same
+  boundaries, different consumers.
 """
 
 import time

@@ -173,9 +173,50 @@ def main():
     from apex_tpu.utils import AutoResume, Timers, step_annotation
     from apex_tpu.utils.pytree import tree_any_non_finite
     from apex_tpu import monitor, resilience
+    from apex_tpu.monitor import goodput
     from apex_tpu.resilience import chaos
 
     import optax
+
+    # host half of the telemetry, FIRST: one router, every producer
+    # (metric bag, timers, anomaly stream, goodput spans) emits the same
+    # record schema through it, and creating it before any real setup
+    # keeps the run-level ledger's `unattributed` bucket honest — wall
+    # time before the first record is interpreter startup, nothing else
+    sinks = [monitor.StdoutSink()]
+    if args.metrics_jsonl:
+        sinks.append(monitor.JsonlSink(args.metrics_jsonl))
+    if args.metrics_csv:
+        sinks.append(monitor.CsvSink(args.metrics_csv))
+    if args.tensorboard_dir:
+        tb = monitor.try_tensorboard_sink(args.tensorboard_dir)
+        if tb is None:
+            print("no TensorBoard writer importable; --tensorboard-dir ignored")
+        else:
+            sinks.append(tb)
+    # in-process window of the stream so the end-of-run goodput summary
+    # accounts THIS run without re-reading (or requiring) a jsonl file;
+    # kinds-filtered so metrics/timer traffic doesn't evict the spans
+    goodput_mem = monitor.MemorySink(kinds=("run", "span"))
+    router = monitor.MetricRouter(sinks + [goodput_mem])
+
+    # run-level goodput ledger (apex_tpu.monitor.goodput,
+    # docs/observability.md "Goodput & fleet health"): this incarnation
+    # announces itself with a kind="run" header — the run id is derived
+    # from the --save path, so every restart of the same job joins into
+    # ONE ledger — then every lifecycle phase (init, compile, data_wait,
+    # step, ckpt_save/restore, rollback, stall, shutdown) emits a
+    # kind="span" record the accountant partitions into goodput/badput.
+    # set_router wires the library's own spans (AutoResume, rollback)
+    # and arms the SIGTERM/atexit flush of in-flight spans. The devices
+    # touch initializes the jax backend FIRST so the header resolves the
+    # same host index as every later record — emitted earlier it would
+    # say host 0 on every process and orphan non-zero hosts' spans.
+    len(jax.devices())
+    run_id = goodput.derive_run_id(args.save)
+    run_rec = goodput.run_header(router, run_id, steps=args.steps)
+    goodput.set_router(router)
+    init_span = goodput.begin_span("init")
 
     mesh = parallel_state.initialize_model_parallel(
         tensor_model_parallel_size=args.tp
@@ -347,21 +388,6 @@ def main():
     sent_state = jax.device_put(sentinel.init(), replicated)
     bag = jax.device_put(monitor.metric_bag(METRIC_SPEC), replicated)
 
-    # host half of the telemetry: one router, every producer (metric bag,
-    # timers, anomaly stream) emits the same record schema through it
-    sinks = [monitor.StdoutSink()]
-    if args.metrics_jsonl:
-        sinks.append(monitor.JsonlSink(args.metrics_jsonl))
-    if args.metrics_csv:
-        sinks.append(monitor.CsvSink(args.metrics_csv))
-    if args.tensorboard_dir:
-        tb = monitor.try_tensorboard_sink(args.tensorboard_dir)
-        if tb is None:
-            print("no TensorBoard writer importable; --tensorboard-dir ignored")
-        else:
-            sinks.append(tb)
-    router = monitor.MetricRouter(sinks)
-
     # analytic model FLOPs for MFU/throughput (docs/observability.md);
     # peak is None off-TPU unless APEX_TPU_PEAK_FLOPS pins it, and the
     # mfu field is then emitted as null rather than against a fake peak
@@ -372,13 +398,10 @@ def main():
     profile_dir = args.profile_dir or os.path.join(
         args.save if args.save else tempfile.gettempdir(), "profiles"
     )
-    trigger = monitor.ProfilerTrigger(
-        profile_dir, window_steps=2,
-        on_capture=lambda info: router.event(
-            "profile", info["start_step"],
-            path=info["path"], reason=info["reason"],
-        ),
-    )
+    # router-backed: each completed capture emits its own kind="profile"
+    # record (path/reason/end_step) without a hand-rolled callback
+    trigger = monitor.ProfilerTrigger(profile_dir, window_steps=2,
+                                      router=router)
     if args.profile_analyze and args.profile_step is None:
         # the analyzer needs a capture to chew on; step 1 skips the
         # compile-dominated step 0 so the window shows steady state
@@ -390,13 +413,10 @@ def main():
     # first-step compile would flag every healthy run as stalled
     watchdog = None
     if args.step_deadline:
-        watchdog = monitor.StallWatchdog(
-            args.step_deadline,
-            on_stall=lambda info: router.event(
-                "stall", -1 if info["step"] is None else info["step"],
-                overdue_s=info["overdue_s"], deadline_s=info["deadline_s"],
-            ),
-        )
+        # router-backed: each stall lands as a kind="stall" event PLUS a
+        # phase="stall" span (from the last heartbeat), so detected dead
+        # time shows up in the goodput ledger as badput
+        watchdog = monitor.StallWatchdog(args.step_deadline, router=router)
 
     # chaos drill: corrupt the newest checkpoint BEFORE restore — the
     # verified restore must fall back to the previous intact step
@@ -577,31 +597,41 @@ def main():
     # seed the ring so an anomaly before the first cadence point can still
     # roll back instead of escalating straight to halt
     mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
+    init_span.close()  # everything before the loop is init (or a nested
+    # higher-priority phase: ckpt_restore from ar.restore above)
     steps_run = 0
     steps_since_emit = 0
     last_emit_t = time.perf_counter()
     step_i = step0
     while step_i < args.steps:
-        idx = next(it)
-        x, y = lm.batch(idx)
-        x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
-        y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+        # host blocked on the input pipeline = data_wait badput
+        with goodput.span("data_wait", step=step_i):
+            idx = next(it)
+            x, y = lm.batch(idx)
+            x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+            y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         trigger.maybe_start(step_i)
-        # step marker: every profiler window carries a span the timeline
-        # analyzer can segment on; the barrier inside keeps the step's
-        # device tail from leaking into the next step's span
-        with step_annotation(step_i):
-            timers("step").start()
-            (params, opt_state, scaler_state, sent_state, bag, loss,
-             verdict) = train_step(
-                params, opt_state, scaler_state, sent_state, bag,
-                jnp.asarray(x), jnp.asarray(y),
-                jnp.asarray(plan.take_nan(step_i), jnp.float32),
-                jnp.asarray(mgr.lr_scale, jnp.float32),
-            )
-            # the loss/verdict fetch below is the step's host sync point,
-            # so the profiler window closes on completed device work
-            timers("step").stop(barrier_on=loss)
+        # run-level span: the first call is compile-dominated (no AOT
+        # split exists for the jit step), so it books as compile badput;
+        # later iterations are the goodput numerator. The barrier inside
+        # step_annotation makes the span cover completed device work.
+        with goodput.span("compile" if steps_run == 0 else "step",
+                          step=step_i):
+            # step marker: every profiler window carries a span the
+            # timeline analyzer can segment on; the barrier inside keeps
+            # the step's device tail out of the next step's span
+            with step_annotation(step_i):
+                timers("step").start()
+                (params, opt_state, scaler_state, sent_state, bag, loss,
+                 verdict) = train_step(
+                    params, opt_state, scaler_state, sent_state, bag,
+                    jnp.asarray(x), jnp.asarray(y),
+                    jnp.asarray(plan.take_nan(step_i), jnp.float32),
+                    jnp.asarray(mgr.lr_scale, jnp.float32),
+                )
+                # the loss/verdict fetch below is the step's host sync
+                # point, so the profiler window closes on completed work
+                timers("step").stop(barrier_on=loss)
         steps_run += 1
         steps_since_emit += 1
         if watchdog is not None:
@@ -690,6 +720,9 @@ def main():
         # recompile warning
         compile_watcher.on_step(step_i)
         step_i += 1
+    # everything after the loop is shutdown badput (final saves nested
+    # inside book as ckpt_save — priority order, accountant.py)
+    shutdown_span = goodput.begin_span("shutdown", step=step_i)
     if mgr.events:
         print(f"anomalies this run: {len(mgr.events)} "
               f"(rollbacks {mgr.rollbacks_used}, lr_scale {mgr.lr_scale:.3f})")
@@ -753,6 +786,24 @@ def main():
                   f"unaffected")
     if ar is not None:
         ar.close()  # finalize any in-flight interval save (manifest commit)
+    # run-level goodput summary (docs/observability.md "Goodput & fleet
+    # health"): replay this run's own record window into the
+    # productive/badput partition and land it in the SAME stream — the
+    # identity productive + Σ badput + unattributed == wall holds exactly
+    # on the emitted record. Multi-incarnation jobs re-account the full
+    # jsonl offline: python -m apex_tpu.monitor.goodput <jsonl>
+    shutdown_span.close()
+    goodput.set_router(None)  # later spans (none expected) drop cleanly
+    recs = list(goodput_mem.records)
+    if not recs or recs[0] is not run_rec:
+        # the bounded window evicted the run header (very long run):
+        # re-pin it so the run-id join still holds — the evicted early
+        # spans under-report badput here, but the jsonl is the durable
+        # record and the offline CLI accounts it in full
+        recs = [run_rec] + recs
+    report = goodput.account(recs, run_id=run_id)
+    print(report.summary(), flush=True)
+    router.event("goodput", step_i, **report.fields())
     router.close()
 
 

@@ -53,6 +53,9 @@ def parse_args():
                         "(default: a temp dir)")
     p.add_argument("--profile-steps", type=int, default=3,
                    help="annotated steps captured by --profile-analyze")
+    p.add_argument("--metrics-jsonl", default=None,
+                   help="write run/span/goodput (and any other) records "
+                        "to this jsonl (apex_tpu.monitor schema)")
     return p.parse_args()
 
 
@@ -80,6 +83,29 @@ def load_model(args):
 
 def main():
     args = parse_args()
+
+    from apex_tpu import monitor
+    from apex_tpu.monitor import goodput
+
+    # run-level goodput ledger (docs/observability.md "Goodput & fleet
+    # health"): one router created BEFORE any real setup, a kind="run"
+    # incarnation header (no durable --save anchor here, so the id is
+    # per-invocation), then phase spans around the whole lifecycle. The
+    # MemorySink window lets the end-of-run summary account this run
+    # in-process; the jsonl (if given) is the durable stream.
+    sinks = [monitor.StdoutSink()]
+    if args.metrics_jsonl:
+        sinks.append(monitor.JsonlSink(args.metrics_jsonl))
+    goodput_mem = monitor.MemorySink(kinds=("run", "span"))
+    router = monitor.MetricRouter(sinks + [goodput_mem])
+    # backend init BEFORE the header so it resolves the same host index
+    # as every later record (the gpt example's multi-process caveat)
+    len(jax.devices())
+    run_id = goodput.derive_run_id()
+    goodput.run_header(router, run_id, steps=args.steps)
+    goodput.set_router(router)
+    init_span = goodput.begin_span("init")
+
     model, variables = load_model(args)
     cfg = model.config
 
@@ -171,8 +197,11 @@ def main():
         # (the ctx.aot()/ctx.hlo_module() pattern)
         from apex_tpu.analysis.hlo import parse_hlo_module
 
-        audit_lowered = train.lower(variables, opt_state, tokens, labels)
-        audit_compiled = audit_lowered.compile()
+        # compile span nested in init: the seconds book as compile
+        # badput, the rest of the setup as init (priority attribution)
+        with goodput.span("compile"):
+            audit_lowered = train.lower(variables, opt_state, tokens, labels)
+            audit_compiled = audit_lowered.compile()
         try:
             audit_module = parse_hlo_module(audit_compiled)
         except ValueError:
@@ -217,9 +246,27 @@ def main():
             print(res.format(verbose=True))
             raise SystemExit("comms audit failed")
 
+    if audit_compiled is None:
+        # AOT split so compile time books as compile badput rather than
+        # folding invisibly into the first (and only) train call — the
+        # whole run is ONE compiled scan, so without the split the
+        # goodput ledger would call the compile productive. The audits'
+        # compile above is reused when a --audit-* flag already paid it.
+        with goodput.span("compile"):
+            audit_compiled = train.lower(
+                variables, opt_state, tokens, labels
+            ).compile()
+    init_span.close()
     t0 = time.perf_counter()
-    params, opt_state, losses = train(variables, opt_state, tokens, labels)
-    losses = np.asarray(losses)
+    # one span for the whole scan (the step_annotation convention for
+    # scanned runs, utils/timers.py): all args.steps steps are inside it,
+    # and the np.asarray fetch is the barrier that closes it on
+    # completed device work
+    with goodput.span("step", step=args.steps):
+        params, opt_state, losses = audit_compiled(
+            variables, opt_state, tokens, labels
+        )
+        losses = np.asarray(losses)
     dt = time.perf_counter() - t0
     for i in range(0, args.steps, max(1, args.steps // 5)):
         print(f"step {i:4d} loss {losses[i]:9.4f}")
@@ -227,6 +274,7 @@ def main():
           f"on {jax.devices()[0].platform}")
     assert np.isfinite(losses).all()
 
+    shutdown_span = goodput.begin_span("shutdown", step=args.steps)
     if args.profile_analyze:
         # device-time timeline (apex_tpu.monitor.xray.timeline,
         # docs/observability.md#timeline). The main run is ONE compiled
@@ -298,6 +346,16 @@ def main():
         except Exception as e:
             print(f"profile analyze: failed ({e!r}); training results "
                   f"unaffected")
+
+    # run-level goodput summary in the same stream (the gpt example's
+    # contract): identity productive + Σ badput + unattributed == wall
+    # holds exactly on the emitted record
+    shutdown_span.close()
+    goodput.set_router(None)
+    report = goodput.account(goodput_mem.records, run_id=run_id)
+    print(report.summary(), flush=True)
+    router.event("goodput", args.steps, **report.fields())
+    router.close()
 
 
 if __name__ == "__main__":

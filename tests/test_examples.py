@@ -79,6 +79,22 @@ def test_gpt_pretrain_example(tmp_path):
             assert isinstance(rec[key], float), (key, rec)
     assert any(r["kind"] == "timer" for r in records)
     assert any(r["kind"] == "summary" for r in records)
+    # run-level goodput ledger (PR 7): every record carries the host
+    # field, the incarnation announces itself with a run header, phase
+    # spans cover the lifecycle, and the end-of-run summary record's
+    # partition identity holds digit-for-digit through the jsonl
+    assert all(r["host"] == 0 for r in records)
+    (run_rec,) = [r for r in records if r["kind"] == "run"]
+    phases = {r["phase"] for r in records if r["kind"] == "span"}
+    assert {"init", "compile", "step", "data_wait"} <= phases
+    (g,) = [r for r in records if r["kind"] == "goodput"]
+    assert g["run_id"] == run_rec["run_id"]
+    assert g["productive_s"] > 0 and g["badput_compile_s"] > 0
+    total = g["productive_s"]
+    for phase in ("ckpt_save", "ckpt_restore", "rollback", "compile",
+                  "data_wait", "stall", "init", "shutdown"):
+        total = total + g[f"badput_{phase}_s"]
+    assert total + g["unattributed_s"] == g["wall_s"]  # ==, not approx
 
 
 def test_gpt_pretrain_xray(tmp_path):
@@ -200,15 +216,37 @@ def test_gpt_pretrain_chaos(tmp_path):
     assert "termination checkpoint at step 10; exiting" in out
     # anomalies and metrics share one record schema in ONE stream: the
     # rollback events land in the same jsonl as the interval metrics
-    kinds = {json.loads(l)["kind"] for l in jsonl.read_text().splitlines()}
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    kinds = {r["kind"] for r in records}
     assert {"metrics", "rollback", "rollback_restore"} <= kinds
+    # ACCEPTANCE (PR 7): the goodput summary record in the shared jsonl
+    # books real ckpt_save + compile + rollback badput, and the partition
+    # identity holds digit-for-digit THROUGH the chaos rollback
+    (g,) = [r for r in records if r["kind"] == "goodput"]
+    assert g["badput_compile_s"] > 0
+    assert g["badput_ckpt_save_s"] > 0    # interval + termination saves
+    assert g["badput_rollback_s"] > 0     # the chaos rollback's recovery
+    assert g["productive_s"] > 0
+    total = g["productive_s"]
+    for phase in ("ckpt_save", "ckpt_restore", "rollback", "compile",
+                  "data_wait", "stall", "init", "shutdown"):
+        total = total + g[f"badput_{phase}_s"]
+    assert total + g["unattributed_s"] == g["wall_s"]  # ==, not approx
 
     out = _run("examples/gpt/pretrain_gpt.py",
-               ["--steps", "12", "--chaos-corrupt-latest", "bitflip"] + base)
+               ["--steps", "12", "--chaos-corrupt-latest", "bitflip",
+                "--metrics-jsonl", str(tmp_path / "m2.jsonl")] + base)
     assert "[chaos] corrupted newest checkpoint" in out
     # newest (step 10) is corrupt -> verified fallback to the interval save
     assert "resumed from step 8" in out
     assert "step    11" in out  # ran to completion
+    records = [json.loads(l)
+               for l in (tmp_path / "m2.jsonl").read_text().splitlines()]
+    # the restart shares run A's run id (both anchor on --save) and its
+    # verified-fallback restore books as ckpt_restore badput
+    (g2,) = [r for r in records if r["kind"] == "goodput"]
+    assert g2["run_id"] == g["run_id"]
+    assert g2["badput_ckpt_restore_s"] > 0
 
 
 def test_llama_finetune_example(tmp_path):
@@ -221,10 +259,14 @@ def test_llama_finetune_example(tmp_path):
     # must segment into the annotated steps and produce a joined
     # breakdown (pins the whole llama profile path — train_one's
     # shard_map closure, the capture loop, and the bandwidth join)
+    import json
+
+    jsonl = tmp_path / "metrics.jsonl"
     out = _run("examples/llama/finetune_llama.py",
                ["--steps", "20", "--audit-donation", "--audit-comms",
                 "--profile-analyze", "--profile-steps", "2",
-                "--profile-dir", str(tmp_path / "prof")])
+                "--profile-dir", str(tmp_path / "prof"),
+                "--metrics-jsonl", str(jsonl)])
     assert "donation audit: ok" in out
     assert "comms audit: ok" in out
     assert "profile timeline" in out
@@ -234,6 +276,18 @@ def test_llama_finetune_example(tmp_path):
     # memorization demo: loss must fall well below the uniform floor
     final = float(out.split("final loss")[1].split(";")[0])
     assert final < 5.0, out
+    # run-level goodput (PR 7): the scanned run's one compile books as
+    # compile badput (the AOT split), the scan itself as productive, and
+    # the summary record's identity holds exactly in the shared jsonl
+    records = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert any(r["kind"] == "run" for r in records)
+    (g,) = [r for r in records if r["kind"] == "goodput"]
+    assert g["productive_s"] > 0 and g["badput_compile_s"] > 0
+    total = g["productive_s"]
+    for phase in ("ckpt_save", "ckpt_restore", "rollback", "compile",
+                  "data_wait", "stall", "init", "shutdown"):
+        total = total + g[f"badput_{phase}_s"]
+    assert total + g["unattributed_s"] == g["wall_s"]
 
 
 def test_sparsity_example():

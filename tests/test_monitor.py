@@ -244,7 +244,7 @@ class TestRouter:
         router.close()
         rows = open(csvp).read().splitlines()
         # header froze on the first METRICS record, not the timer event
-        assert rows[0] == "t,step,kind,loss" and len(rows) == 3
+        assert rows[0] == "t,step,kind,host,loss" and len(rows) == 3
 
     def test_csv_resume_keeps_single_header(self, tmp_path):
         csvp = str(tmp_path / "m.csv")
@@ -648,3 +648,186 @@ class TestRawCollectiveLint:
             "stale lint.raw-collective allowlist entries: "
             + ", ".join(e.match for e in res.stale_entries)
         )
+
+
+class TestRecordSchemaHost:
+    """The ``host`` field (PR 7): every record carries the producing
+    process's fleet index so merged multi-host streams stay
+    attributable, resolved without importing (or initializing) jax."""
+
+    def test_make_record_defaults_host_zero(self):
+        rec = monitor.make_record("metrics", 3, loss=1.0)
+        assert set(rec) == {"t", "step", "kind", "host", "loss"}
+        assert rec["host"] == 0  # single-process runs are host 0
+
+    def test_env_override_and_explicit_kwarg(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_HOST", "5")
+        assert monitor.make_record("span", 0)["host"] == 5
+        # an explicit host= (replaying another host's stream) wins
+        assert monitor.make_record("span", 0, host=2)["host"] == 2
+        monkeypatch.setenv("APEX_TPU_HOST", "not-an-int")
+        assert monitor.make_record("span", 0)["host"] == 0
+
+    def test_csv_resume_tolerates_pre_host_header(self, tmp_path):
+        """A CSV written before the schema grew ``host`` must resume
+        cleanly: the adopted old header lacks the column and the sink
+        drops the field instead of rejecting every record."""
+        csvp = tmp_path / "m.csv"
+        csvp.write_text("t,step,kind,loss\n1.0,0,metrics,1.5\n")
+        sink = monitor.CsvSink(str(csvp))
+        sink.emit(monitor.make_record("metrics", 1, loss=2.5))
+        # a genuinely NEW data column is still rejected (header frozen)
+        with pytest.raises(ValueError):
+            sink.emit(monitor.make_record("metrics", 2, loss=1.0,
+                                          surprise=9.0))
+        sink.close()
+        rows = open(csvp).read().splitlines()
+        assert len(rows) == 3 and "host" not in rows[0]
+        assert rows[2].endswith(",2.5")
+
+    def test_stdout_sink_hides_plumbing(self, capsys):
+        sink = monitor.StdoutSink()
+        sink.emit(monitor.make_record("metrics", 1, loss=1.0))
+        # span/run records fire per loop iteration for the accountant,
+        # not the console; host is schema plumbing on every kind
+        sink.emit(monitor.make_record("span", 1, phase="step", start=0.0,
+                                      dur_s=0.1))
+        sink.emit(monitor.make_record("run", 0, run_id="r"))
+        out = capsys.readouterr().out
+        assert "step     1" in out and "host" not in out
+        assert "span" not in out and "run_id" not in out
+
+    def test_tensorboard_sink_skips_host_scalar(self, tmp_path):
+        tb = monitor.try_tensorboard_sink(str(tmp_path))
+        if tb is None:
+            pytest.skip("no TensorBoard writer importable")
+        calls = []
+        tb._writer.add_scalar = lambda *a: calls.append(a)
+        tb.emit(monitor.make_record("metrics", 1, loss=1.0))
+        assert [c[0] for c in calls] == ["metrics/loss"]
+
+
+class TestRouterLifecycle:
+    """PR 7 satellite: MetricRouter is a context manager with idempotent
+    close and a best-effort exit flush, so an abnormal termination can't
+    tear buffered records off the stream."""
+
+    def test_context_manager_closes_sinks(self, tmp_path):
+        closed = []
+
+        class Tracker(monitor.MemorySink):
+            def close(self):
+                closed.append(True)
+
+        with monitor.MetricRouter([Tracker()]) as router:
+            router.metrics(0, loss=1.0)
+        assert closed == [True]
+
+    def test_close_is_idempotent(self):
+        closed = []
+
+        class Tracker(monitor.MemorySink):
+            def close(self):
+                closed.append(True)
+
+        router = monitor.MetricRouter([Tracker()])
+        router.close()
+        router.close()  # the exit teardown re-closing is a no-op
+        assert closed == [True]
+
+    def test_emit_after_close_drops_with_one_warning(self, monkeypatch):
+        from apex_tpu.monitor import router as router_mod
+
+        warnings = []
+        monkeypatch.setattr(
+            router_mod.logger, "warning",
+            lambda msg, *args: warnings.append(msg % args),
+        )
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        router.close()
+        router.metrics(1, loss=1.0)  # daemon thread racing shutdown
+        router.metrics(2, loss=2.0)
+        assert len(mem.records) == 0
+        assert sum("after router close" in w for w in warnings) == 1
+
+    def test_flush_hooks_run_before_routers_close(self):
+        from apex_tpu.monitor import router as router_mod
+
+        order = []
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        router_mod.register_flush_hook(
+            lambda: order.append("hook") or router.event("span", 0,
+                                                         phase="stall"))
+        try:
+            router_mod._flush_all_routers()
+            # the hook's record landed BEFORE the router closed
+            assert order == ["hook"]
+            assert [r["kind"] for r in mem.records] == ["span"]
+            assert router._closed
+        finally:
+            router_mod._FLUSH_HOOKS.clear()
+
+
+class TestStallRouting:
+    """PR 7 satellite: stalls land in the record stream (kind='stall' +
+    a phase='stall' span the goodput accountant books as badput), not
+    only in logger.warning and the in-memory list."""
+
+    def test_stall_emits_event_and_span(self):
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        dog = monitor.StallWatchdog(0.08, poll_s=0.02, router=router).start()
+        try:
+            dog.beat(4)
+            time.sleep(0.3)
+        finally:
+            dog.stop()
+        by_kind = {}
+        for rec in mem.records:
+            by_kind.setdefault(rec["kind"], []).append(rec)
+        (stall,) = by_kind["stall"]
+        assert stall["step"] == 4 and stall["overdue_s"] > 0.08
+        (span_rec,) = by_kind["span"]
+        assert span_rec["phase"] == "stall" and span_rec["step"] == 4
+        # the span covers the dead time measured from the LAST heartbeat
+        assert span_rec["dur_s"] == pytest.approx(stall["overdue_s"])
+
+    def test_profiler_trigger_router_records_capture(self, tmp_path):
+        mem = monitor.MemorySink()
+        router = monitor.MetricRouter([mem])
+        trigger = monitor.ProfilerTrigger(str(tmp_path), window_steps=2,
+                                          router=router)
+        trigger.request(step=1, reason="requested")
+
+        @jax.jit
+        def work(x):
+            return (x @ x).sum()
+
+        for i in range(4):
+            trigger.maybe_start(i)
+            jax.block_until_ready(work(jnp.ones((8, 8))))
+            trigger.maybe_stop(i)
+        trigger.close()
+        (rec,) = [r for r in mem.records if r["kind"] == "profile"]
+        assert rec["step"] == 1 and rec["end_step"] == 2
+        assert rec["reason"] == "requested" and os.path.isdir(rec["path"])
+
+
+class TestMemorySinkKinds:
+    def test_kinds_filter_keeps_window_for_the_consumer(self):
+        # the examples' goodput window: metrics/timer traffic must not
+        # evict the run header and spans the accountant needs
+        mem = monitor.MemorySink(max_records=4, kinds=("run", "span"))
+        mem.emit(monitor.make_record("run", 0, run_id="r"))
+        for i in range(100):
+            mem.emit(monitor.make_record("metrics", i, loss=1.0))
+        mem.emit(monitor.make_record("span", 1, phase="step"))
+        assert [r["kind"] for r in mem.records] == ["run", "span"]
+
+    def test_default_keeps_everything(self):
+        mem = monitor.MemorySink()
+        mem.emit(monitor.make_record("metrics", 0, loss=1.0))
+        mem.emit(monitor.make_record("span", 0, phase="step"))
+        assert len(mem.records) == 2

@@ -668,3 +668,51 @@ class TestChaosEndToEnd:
         assert res["mgr"].lr_scale == 0.5
         assert res["mgr"].rollbacks_used == 1
         assert len(res["losses"]) == self.STEPS
+
+
+class TestSigtermSpanFlush:
+    """PR 7 satellite: the chaos harness's real SIGTERM must not tear
+    the final goodput spans off the record stream. The router module
+    installs a best-effort SIGTERM/atexit teardown (over the DEFAULT
+    handler only — AutoResume's preemption handler keeps precedence when
+    installed later); it flushes in-flight spans as interrupted records,
+    then re-raises so the process still dies by SIGTERM — a drill the
+    flush must never convert into a survival."""
+
+    def test_real_sigterm_lands_interrupted_spans(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+
+        stream = tmp_path / "run.jsonl"
+        code = f"""
+import os, signal, time
+from apex_tpu.monitor import JsonlSink, MetricRouter
+from apex_tpu.monitor import goodput
+
+router = MetricRouter([JsonlSink({str(stream)!r})])
+goodput.run_header(router, "run-sig")
+goodput.set_router(router)
+goodput.begin_span("step", step=12)
+goodput.begin_span("ckpt_save", step=12)
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(30)  # never reached
+"""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, env=env,
+                              timeout=60)
+        # still died BY SIGTERM (default disposition restored + re-kill)
+        assert proc.returncode == -signal.SIGTERM, (proc.returncode,
+                                                    proc.stderr)
+        recs = [json.loads(l) for l in open(stream)]
+        spans_flushed = [r for r in recs if r["kind"] == "span"]
+        assert {r["phase"] for r in spans_flushed} == {"step", "ckpt_save"}
+        assert all(r["interrupted"] for r in spans_flushed)
+        # the stream is accountable: the interrupted partials partition
+        from apex_tpu.monitor.goodput import account
+
+        rep = account(recs, run_id="run-sig")
+        assert rep.n_interrupted == 2 and rep.wall_s >= 0.0
