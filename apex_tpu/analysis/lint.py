@@ -47,6 +47,14 @@ Plus the new rules this framework exists to host:
   for HLO text, applied to XProf's export. String-token based (a code
   COMMENT mentioning the format is fine; a docstring or glob pattern
   is a reader's fingerprint and routes to the shared parser).
+- ``lint.signal-handlers`` — no raw ``signal.signal(...)`` registration
+  outside the two blessed homes, ``utils/autoresume.py`` (the
+  preemption flag + grace-budget anchor) and ``monitor/router.py`` (the
+  best-effort span-flush teardown, which installs only over SIG_DFL so
+  AutoResume keeps precedence). Scattered handlers silently overwrite
+  each other — the last registration wins the whole process — and break
+  the SIG_DFL-precedence contract those two homes coordinate on (PR 7);
+  a third registrant must route through one of them.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -358,6 +366,64 @@ def trace_file(ctx: LintContext) -> Iterable[Finding]:
                         "changes"
                     ),
                     site=f"{rel}:{t.start[0]}", severity=SEV_ERROR,
+                )
+
+
+@lint_rule("lint.signal-handlers", scopes=("apex_tpu/", "examples/"))
+def signal_handlers(ctx: LintContext) -> Iterable[Finding]:
+    """Raw signal-handler registration outside the blessed homes.
+
+    AST-based: flags ``<mod>.signal(...)`` calls where ``<mod>`` is the
+    stdlib module's conventional names (``signal`` or the repo's
+    ``import signal as _signal`` alias), and ``from signal import
+    signal`` imports (which would hide the call sites from the attribute
+    match). ``signal.getsignal`` / ``SIGTERM`` attribute reads are fine
+    — only REGISTRATION rewires process-global dispatch."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.signal-handlers",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ImportFrom) and node.module == "signal"
+                    and any(a.name == "signal" for a in node.names)):
+                yield Finding(
+                    rule="lint.signal-handlers",
+                    message=(
+                        "'from signal import signal' hides handler "
+                        "registration from review — spell it "
+                        "signal.signal(...) in one of the blessed homes "
+                        "(utils/autoresume.py, monitor/router.py)"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "signal"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("signal", "_signal")
+            ):
+                yield Finding(
+                    rule="lint.signal-handlers",
+                    message=(
+                        "raw signal.signal(...) registration outside "
+                        "utils/autoresume.py and monitor/router.py — the "
+                        "last registration silently wins the whole "
+                        "process and breaks the SIG_DFL-precedence "
+                        "contract the two blessed homes coordinate on; "
+                        "route through AutoResume (preemption) or the "
+                        "router teardown (span flush) instead"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                 )
 
 

@@ -30,6 +30,7 @@ from apex_tpu.optimizers.larc import larc, LARC
 from apex_tpu.optimizers.clip_grad import clip_grad_norm
 from apex_tpu.optimizers.distributed_fused_adam import (
     distributed_fused_adam,
+    zero_regroup_flat,
     zero_state_specs,
     DistributedFusedAdam,
 )
@@ -57,6 +58,7 @@ __all__ = [
     "LARC",
     "clip_grad_norm",
     "distributed_fused_adam",
+    "zero_regroup_flat",
     "zero_state_specs",
     "DistributedFusedAdam",
     "distributed_fused_lamb",

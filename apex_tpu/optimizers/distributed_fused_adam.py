@@ -168,6 +168,41 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
     return gshard, spec
 
 
+def zero_regroup_flat(flat, target_len: int):
+    """Host-side regroup of a saved padded ZeRO flat buffer to a new dp
+    size: the global flat buffer is the true param/moment vector of
+    length T zero-padded to a multiple of the dp size
+    (``_padded_flatten``), so changing dp only changes the PADDING —
+    truncate (dropping zeros) or zero-extend to ``target_len``.
+
+    Refuses (``ValueError``) when truncation would drop a NONZERO value:
+    that is optimizer state, not padding, and means the buffer is not a
+    padded flat shard of the claimed layout. The elastic restore
+    (``resilience.elastic.reshard``) is the caller; it wraps the refusal
+    in its reasoned ``ElasticRestoreError``.
+    """
+    import numpy as np
+
+    arr = np.asarray(flat)
+    if arr.ndim != 1:
+        raise ValueError(f"ZeRO flat buffer must be 1-D, got {arr.shape}")
+    n = arr.shape[0]
+    target_len = int(target_len)
+    if target_len == n:
+        return arr
+    if target_len < n:
+        tail = arr[target_len:]
+        if np.any(tail != 0):
+            raise ValueError(
+                f"regroup {n} -> {target_len} would truncate "
+                f"{int(np.count_nonzero(tail))} nonzero value(s) — the "
+                f"dropped region is state, not dp padding; the target "
+                f"layout is too small for the saved flat buffer"
+            )
+        return arr[:target_len]
+    return np.concatenate([arr, np.zeros(target_len - n, dtype=arr.dtype)])
+
+
 def zero_gather_updates(new_master, params, spec, axis_name: str):
     """Shared ZeRO epilogue: all-gather the updated master shard and return
     optax-style updates (new - old) in the params' dtypes."""

@@ -22,6 +22,11 @@ stakes; see PAPERS.md):
   ``keep_last_n`` retention, and save-retry-with-backoff.
 - ``chaos``     — deterministic fault injection for tests: NaN losses
   at chosen steps, checkpoint truncation/bit-flips, simulated SIGTERM.
+- ``elastic``   — topology-change checkpoint resharding: the manifest
+  topology block plus ``restore_resharded`` (load a checkpoint saved on
+  mesh A onto any mesh B, ZeRO flat buffers regrouped across a changed
+  dp size, refuse-don't-guess on layout mismatch) and the
+  ``python -m apex_tpu.resilience.elastic`` exit-nonzero self-test.
 
 End-to-end wiring: ``AmpOptimizer.step(..., sentinel=...)``,
 ``AutoResume`` (verified restore + async-finalized saves + retention),
@@ -53,9 +58,11 @@ from apex_tpu.resilience.integrity import (
     tree_fingerprint,
     verified_latest_step,
     verify_checkpoint,
+    write_abandoned_marker,
     write_manifest,
 )
 from apex_tpu.resilience import chaos
+from apex_tpu.resilience import elastic
 
 __all__ = [
     "AnomalySentinel",
@@ -77,6 +84,8 @@ __all__ = [
     "tree_fingerprint",
     "verified_latest_step",
     "verify_checkpoint",
+    "write_abandoned_marker",
     "write_manifest",
     "chaos",
+    "elastic",
 ]

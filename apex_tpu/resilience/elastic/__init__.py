@@ -1,0 +1,48 @@
+"""Elastic restart: topology-change checkpoint resharding.
+
+The robustness half of the composable-trainer arc (ROADMAP item 4): a
+job preempted on 8 chips resumes on 4 (or 16) without a human
+re-slicing checkpoints.
+
+- ``topology``  — the manifest topology block (per-leaf global
+  shape/dtype/PartitionSpec, mesh axes, ZeRO shard-axis marker) written
+  at save time by ``integrity.write_manifest`` callers.
+- ``reshard``   — :func:`restore_resharded`: load a checkpoint saved on
+  mesh A onto any mesh B, regrouping ZeRO flat optimizer buffers across
+  a changed dp size, with per-leaf crc32 verification on the resharded
+  bytes and refuse-don't-guess (:class:`ElasticRestoreError`) on any
+  layout mismatch.
+- ``__main__``  — ``python -m apex_tpu.resilience.elastic`` exit-nonzero
+  self-test: 8->4 and 4->8 round trips plus refusal cases on the
+  virtual CPU topology (wired into the verify gate).
+
+``AutoResume`` (utils/autoresume.py) routes its restore through here
+automatically when the manifest topology disagrees with the live mesh.
+See docs/resilience.md "Elastic restart".
+"""
+
+from apex_tpu.resilience.elastic.reshard import (
+    ElasticRestoreError,
+    derive_mesh,
+    needs_reshard,
+    restore_resharded,
+)
+from apex_tpu.resilience.elastic.topology import (
+    TOPOLOGY_VERSION,
+    mesh_axes,
+    spec_from_json,
+    spec_to_json,
+    topology_block,
+)
+
+__all__ = [
+    "ElasticRestoreError",
+    "TOPOLOGY_VERSION",
+    "derive_mesh",
+    "mesh_axes",
+    "needs_reshard",
+    "restore_resharded",
+    "spec_from_json",
+    "spec_to_json",
+    "topology_block",
+]

@@ -1,0 +1,277 @@
+"""``python -m apex_tpu.resilience.elastic`` — elastic round-trip gate.
+
+Exit-nonzero self-test of the topology-change restore path on the
+virtual 8-device CPU topology (no TPU needed — the same conftest trick
+as ``python -m apex_tpu.analysis``):
+
+1. build a real ZeRO-2 state (``distributed_fused_adam`` under
+   shard_map) plus replicated params / loss-scale / RNG key on an
+   8-device dp mesh, train it a few steps, save with the integrity
+   manifest (topology block included);
+2. restore it RESHARDED onto a 4-device mesh (``restore_resharded``):
+   params re-laid-out, ZeRO flat buffers regrouped 8->4, per-leaf crc32
+   verified on the resharded bytes; step one more update to prove the
+   regrouped state is live, not just loadable;
+3. round-trip back 4->8 and check values bit-for-bit on the unpadded
+   prefix;
+4. refusal cases: a non-ZeRO global-shape change, a target spec naming
+   an absent mesh axis, a structure change, and a corrupted payload must
+   each raise ``ElasticRestoreError`` (or fall back past the corrupt
+   step) — never silently misload;
+5. a newest checkpoint whose manifest PREDATES the topology block is
+   skipped and the walk falls back to the newest one that carries it.
+
+Any failed check prints its reason and exits 1 (the verify-gate
+contract; see .claude/skills/verify/SKILL.md and docs/resilience.md).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+
+def _ensure_cpu_mesh_env():
+    """Force the 8-virtual-device CPU topology BEFORE jax initializes its
+    backends (the tests/conftest.py pattern)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _check(failures, ok, label):
+    status = "ok" if ok else "FAIL"
+    print(f"  [{status}] {label}", flush=True)
+    if not ok:
+        failures.append(label)
+
+
+def selftest(directory=None) -> int:
+    _ensure_cpu_mesh_env()
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
+    from apex_tpu.resilience import integrity
+    from apex_tpu.resilience.elastic import (
+        ElasticRestoreError,
+        restore_resharded,
+    )
+
+    if len(jax.devices()) < 8:
+        print(f"elastic selftest needs 8 devices, have {len(jax.devices())} "
+              f"(set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+              f"before jax initializes)", flush=True)
+        return 1
+    directory = directory or tempfile.mkdtemp(prefix="apex_tpu_elastic_")
+    failures = []
+    devs = np.asarray(jax.devices())
+    mesh8 = Mesh(devs[:8], ("dp",))
+    mesh4 = Mesh(devs[:4], ("dp",))
+    specs = zero_state_specs("dp")
+
+    # param total 225: pad8 -> 232, pad4 -> 228, so the dp change REALLY
+    # changes the ZeRO flat-buffer length (the regroup path under test)
+    def init_params(mesh):
+        k = jax.random.PRNGKey(0)
+        rep = NamedSharding(mesh, P())
+        return {
+            "w": jax.device_put(jax.random.normal(k, (12, 16)), rep),
+            "b": jax.device_put(jnp.zeros((1,), jnp.float32), rep),
+            "emb": jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(1), (8, 4)),
+                NamedSharding(mesh, P("dp", None)),
+            ),
+        }
+
+    def make_state(mesh, dp):
+        opt = distributed_fused_adam(lr=0.1, axis_name="dp", axis_size=dp)
+        params = init_params(mesh)
+
+        init_opt = functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(),), out_specs=specs,
+            check_vma=False,
+        )(opt.init)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), specs),
+            out_specs=(P(), specs), check_vma=False,
+        )
+        def train(params, opt_state):
+            def loss_fn(p):
+                return sum(jnp.sum(l.astype(jnp.float32) ** 2)
+                           for l in jax.tree_util.tree_leaves(p))
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        rep = NamedSharding(mesh, P())
+        state = {
+            "params": params,
+            "opt": init_opt(params),
+            "loss_scale": jax.device_put(jnp.float32(1024.0), rep),
+            "rng": jax.device_put(
+                jax.random.PRNGKey(7).astype(jnp.uint32), rep),
+        }
+        return train, state
+
+    def flat_prefix_equal(a, b):
+        a, b = np.asarray(a), np.asarray(b)
+        n = min(a.shape[0], b.shape[0])
+        return (np.array_equal(a[:n], b[:n])
+                and not np.any(a[n:]) and not np.any(b[n:]))
+
+    print(f"elastic selftest (dir {directory})", flush=True)
+    train8, state8 = make_state(mesh8, 8)
+    for _ in range(3):
+        state8["params"], state8["opt"] = train8(
+            state8["params"], state8["opt"])
+    integrity.save_checkpoint_verified(directory, 3, state8)
+    manifest = integrity.read_manifest(
+        os.path.join(directory, "step_3")) or {}
+    _check(failures, bool(manifest.get("topology")),
+           "manifest carries the topology block")
+    topo = manifest.get("topology") or {}
+    zero_marked = [l for l in topo.get("leaves", [])
+                   if l.get("zero_shard_axis") == "dp"]
+    _check(failures, len(zero_marked) == 3,
+           "ZeRO master+moment leaves marked zero_shard_axis=dp")
+
+    # 8 -> 4: regroup 232 -> 228
+    train4, target4 = make_state(mesh4, 4)
+    step, state4 = restore_resharded(directory, target4, mesh=mesh4)
+    _check(failures, step == 3, "8->4 restored the saved step")
+    _check(failures, all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(state8["params"]),
+                        jax.tree_util.tree_leaves(state4["params"]))),
+        "8->4 params bit-identical")
+    _check(failures, flat_prefix_equal(
+        state8["opt"].master_shard, state4["opt"].master_shard),
+        "8->4 ZeRO master regrouped (unpadded prefix identical, pads zero)")
+    _check(failures, flat_prefix_equal(
+        state8["opt"].exp_avg, state4["opt"].exp_avg),
+        "8->4 ZeRO exp_avg regrouped")
+    _check(failures, np.asarray(state4["opt"].step) == 3,
+           "8->4 optimizer step counter survived")
+    _check(failures, np.array_equal(
+        np.asarray(state4["rng"]), np.asarray(state8["rng"])),
+        "8->4 RNG key survived")
+    _check(failures, float(state4["loss_scale"]) == 1024.0,
+           "8->4 loss scale survived")
+    # the regrouped state must be LIVE: one more step on the 4-dev mesh
+    try:
+        state4["params"], state4["opt"] = train4(
+            state4["params"], state4["opt"])
+        jax.block_until_ready(state4["params"]["w"])
+        _check(failures, True, "4-dev step on the regrouped state runs")
+    except Exception as e:  # noqa: BLE001 - selftest must report, not die
+        _check(failures, False, f"4-dev step on the regrouped state: {e!r}")
+
+    # 4 -> 8 (the other direction): save the advanced 4-dev state, restore
+    # onto a fresh 8-dev target, values identical on the unpadded prefix
+    integrity.save_checkpoint_verified(directory, 4, state4)
+    _, target8 = make_state(mesh8, 8)
+    step, state8b = restore_resharded(directory, target8, mesh=mesh8)
+    _check(failures, step == 4, "4->8 restored the newer step")
+    _check(failures, flat_prefix_equal(
+        state4["opt"].master_shard, state8b["opt"].master_shard),
+        "4->8 ZeRO master regrouped back")
+    _check(failures, all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(state4["params"]),
+                        jax.tree_util.tree_leaves(state8b["params"]))),
+        "4->8 params bit-identical")
+
+    # refusal: a non-ZeRO global-shape change must NOT be guessed through
+    bad_target = dict(target4)
+    bad_target["params"] = dict(target4["params"])
+    bad_target["params"]["w"] = jax.device_put(
+        jnp.zeros((12, 17), jnp.float32), NamedSharding(mesh4, P()))
+    try:
+        restore_resharded(directory, bad_target, mesh=mesh4)
+        _check(failures, False, "refuses a non-ZeRO shape change")
+    except ElasticRestoreError as e:
+        _check(failures, "refusing to guess" in str(e),
+               "refuses a non-ZeRO shape change (reasoned)")
+
+    # refusal: a target spec naming an axis the restore mesh lacks
+    try:
+        restore_resharded(
+            directory, target4, mesh=mesh4,
+            target_specs=jax.tree_util.tree_map(lambda _: P("tp"), target4),
+        )
+        _check(failures, False, "refuses a spec naming an absent axis")
+    except ElasticRestoreError as e:
+        _check(failures, "absent from the restore mesh" in str(e),
+               "refuses a spec naming an absent axis (reasoned)")
+
+    # refusal: a structure change is a migration, not a reshard
+    extra_target = dict(target4)
+    extra_target["bonus"] = jax.device_put(
+        jnp.zeros((2,), jnp.float32), NamedSharding(mesh4, P()))
+    try:
+        restore_resharded(directory, extra_target, mesh=mesh4)
+        _check(failures, False, "refuses a structure change")
+    except ElasticRestoreError as e:
+        _check(failures, "structure differs" in str(e),
+               "refuses a structure change (reasoned)")
+
+    # corruption: bit-flip the newest step's payload; deep verification
+    # must skip it and the walk falls back to the older verified step
+    from apex_tpu.resilience import chaos
+
+    chaos.corrupt_checkpoint(os.path.join(directory, "step_4"),
+                             mode="bitflip")
+    step, _ = restore_resharded(directory, target4, mesh=mesh4)
+    _check(failures, step == 3,
+           "corrupted newest step skipped; fell back to verified step")
+
+    # pre-upgrade manifest: a newest checkpoint with NO topology block is
+    # skipped with a warning, falling back to the newest that has one
+    from apex_tpu.utils.checkpoint import save_checkpoint
+
+    path5 = save_checkpoint(directory, 5, state4)
+    integrity.write_manifest(path5)  # no tree: no topology block (legacy)
+    step, _ = restore_resharded(directory, target4, mesh=mesh4)
+    _check(failures, step == 3,
+           "pre-topology newest manifest skipped (format-upgrade rollback)")
+
+    if failures:
+        print(f"elastic selftest: {len(failures)} check(s) FAILED:",
+              flush=True)
+        for f in failures:
+            print(f"  - {f}", flush=True)
+        return 1
+    print("elastic selftest: all checks passed", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.resilience.elastic",
+        description="elastic-restart round-trip self-test (exit nonzero "
+                    "on any failed check)",
+    )
+    parser.add_argument("--selftest", action="store_true",
+                        help="run the self-test (the default and only mode)")
+    parser.add_argument("--dir", default=None,
+                        help="checkpoint scratch dir (default: a temp dir, "
+                             "kept for inspection)")
+    args = parser.parse_args(argv)
+    del args.selftest  # the only mode
+    return selftest(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

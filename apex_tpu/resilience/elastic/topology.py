@@ -1,0 +1,152 @@
+"""Checkpoint topology block: the manifest's record of HOW state was laid out.
+
+A checkpoint saved on mesh A is only restorable on mesh B if the restore
+path can answer, per leaf: what was the GLOBAL shape and dtype, how was it
+partitioned (PartitionSpec over which mesh axes, of which sizes), and —
+for ZeRO flat optimizer buffers — which axis the shard count derives from.
+Orbax records global shapes but nothing about the mesh, and the ZeRO flat
+buffers bake the data-parallel size into their very LENGTH (the flat
+param vector is zero-padded to a multiple of the dp size before
+sharding; see ``optimizers.distributed_fused_adam._padded_flatten``), so
+a topology change is invisible until the restore crashes — or worse,
+silently misloads.
+
+The topology block closes that hole. :func:`topology_block` introspects a
+live state pytree at SAVE time (every sharded leaf carries its
+``NamedSharding``) and produces a JSON-serializable dict that
+``resilience.integrity.write_manifest`` embeds in the integrity manifest
+under the ``"topology"`` key:
+
+    {"version": 1,
+     "mesh": {"axes": {"dp": 8, "tp": 1, ...}, "devices": 8},
+     "leaves": [{"path": "['params']['w']", "shape": [64, 64],
+                 "dtype": "float32", "spec": [null, "tp"],
+                 "zero_shard_axis": null}, ...]}
+
+``zero_shard_axis`` marks the flat-buffer convention: a ONE-dimensional
+leaf sharded over exactly one mesh axis is a flat shard buffer whose
+global length is a function of that axis's size (ZeRO master/moment
+buffers). Only leaves carrying this marker may change global shape across
+a topology change — the elastic restore regroups them (truncate/extend
+the zero padding); any other shape change is refused
+(``reshard.restore_resharded``).
+
+Manifests written before this block existed simply lack the key; the
+elastic restore treats those as "predates the manifest-format upgrade"
+and falls back to the newest checkpoint that carries one.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "TOPOLOGY_VERSION",
+    "topology_block",
+    "spec_to_json",
+    "spec_from_json",
+    "mesh_axes",
+]
+
+TOPOLOGY_VERSION = 1
+
+
+def spec_to_json(spec) -> Optional[List[Any]]:
+    """``PartitionSpec`` -> JSON form: one entry per dim, each
+    ``None`` (replicated), an axis name, or a list of axis names."""
+    if spec is None:
+        return None
+    out: List[Any] = []
+    for entry in tuple(spec):
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        else:  # tuple of axis names (multi-axis sharding of one dim)
+            out.append([str(a) for a in entry])
+    return out
+
+
+def spec_from_json(obj):
+    """Inverse of :func:`spec_to_json` (None -> fully replicated ``P()``)."""
+    from jax.sharding import PartitionSpec
+
+    if obj is None:
+        return PartitionSpec()
+    entries = []
+    for entry in obj:
+        if entry is None:
+            entries.append(None)
+        elif isinstance(entry, str):
+            entries.append(entry)
+        else:
+            entries.append(tuple(entry))
+    return PartitionSpec(*entries)
+
+
+def mesh_axes(mesh) -> Dict[str, int]:
+    """``{axis_name: size}`` of a Mesh, JSON-friendly."""
+    return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+
+
+def _leaf_layout(leaf) -> Tuple[Optional[List[Any]], Optional[Dict[str, int]]]:
+    """(spec_json, mesh_axes) of a leaf's NamedSharding, or (None, None)
+    for host arrays / single-device / non-named shardings (treated as
+    replicated — the conservative reading; a reshard onto a named spec
+    is still driven by the RESTORE side's target)."""
+    import jax
+
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        return spec_to_json(sharding.spec), mesh_axes(sharding.mesh)
+    return None, None
+
+
+def _zero_shard_axis(shape, spec_json) -> Optional[str]:
+    """The flat-shard-buffer marker (see module docstring): 1-D leaf
+    sharded over exactly one axis."""
+    if spec_json is None or len(shape) != 1 or len(spec_json) != 1:
+        return None
+    entry = spec_json[0]
+    if isinstance(entry, str):
+        return entry
+    if isinstance(entry, list) and len(entry) == 1:
+        return entry[0]
+    return None
+
+
+def topology_block(tree: Any) -> dict:
+    """Build the manifest topology block from a LIVE state pytree.
+
+    Leaf paths use ``jax.tree_util.keystr`` — the same keys as the
+    integrity fingerprint, so the elastic restore can join the two
+    blocks per leaf. The mesh summary comes from the first
+    ``NamedSharding`` encountered (one state tree lives on one mesh);
+    a tree with no named shardings (host arrays, single device) gets
+    ``mesh: None`` and every leaf replicated.
+    """
+    import jax
+    import numpy as np
+
+    leaves = []
+    mesh: Optional[Dict[str, int]] = None
+    devices: Optional[int] = None
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        spec_json, leaf_mesh = _leaf_layout(leaf)
+        if leaf_mesh is not None and mesh is None:
+            mesh = leaf_mesh
+            sharding = leaf.sharding
+            devices = int(np.asarray(sharding.mesh.devices).size)
+        arr_shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+        arr_dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        leaves.append({
+            "path": jax.tree_util.keystr(path),
+            "shape": [int(d) for d in arr_shape],
+            "dtype": arr_dtype,
+            "spec": spec_json,
+            "zero_shard_axis": _zero_shard_axis(arr_shape, spec_json),
+        })
+    return {
+        "version": TOPOLOGY_VERSION,
+        "mesh": ({"axes": mesh, "devices": devices}
+                 if mesh is not None else None),
+        "leaves": leaves,
+    }

@@ -23,7 +23,9 @@ The manifest closes that hole:
   VERIFIES, skipping torn/corrupt ones instead of crashing on them.
 - ``apply_retention(dir, keep_last_n)`` bounds disk growth, deleting
   oldest steps (and their manifests, and stale orbax tmp dirs) while
-  never touching the newest verified step.
+  never touching the newest verified step — and counting the keep
+  window over VERIFIED steps too, so torn newer dirs can't push real
+  restore points out of it.
 - ``save_with_retry`` wraps the orbax write in bounded retries with
   exponential backoff for transient IO errors.
 
@@ -59,7 +61,11 @@ from apex_tpu.utils.checkpoint import (
 logger = logging.getLogger("apex_tpu.resilience")
 
 MANIFEST_SUFFIX = ".apex-manifest.json"
-MANIFEST_VERSION = 1
+# version 2 added the "topology" block (resilience.elastic.topology) and
+# the "autoresume" save-duration EMAs; verification is version-agnostic
+# (every version-1 field kept its meaning), and the elastic restore
+# treats a manifest WITHOUT a topology block as predating the upgrade
+MANIFEST_VERSION = 2
 
 
 def manifest_path(step_dir: str) -> str:
@@ -135,7 +141,7 @@ def verify_restored(tree: Any, manifest: dict) -> Tuple[bool, str]:
 
 def write_manifest(
     step_dir: str, tree: Any = None, fingerprint: Optional[dict] = None,
-    extra: Optional[dict] = None,
+    extra: Optional[dict] = None, topology: Optional[dict] = None,
 ) -> str:
     """Hash every file under ``step_dir`` and commit the manifest.
 
@@ -143,25 +149,66 @@ def write_manifest(
     returned, or ``AsyncCheckpointWriter.wait()``). ``tree`` (or a
     pre-computed ``fingerprint`` captured at save time, for async saves
     whose source buffers are donated afterwards) adds the pytree
-    fingerprint. The manifest itself is written tmp-then-rename so a
-    crash mid-write never leaves a parseable-but-wrong commit marker.
+    fingerprint; a ``topology`` block (or ``tree``, from which one is
+    derived — see resilience.elastic.topology) records the mesh/spec
+    layout so the elastic restore can reshard across a topology change.
+    The manifest itself is written tmp-then-rename so a crash mid-write
+    never leaves a parseable-but-wrong commit marker.
     """
     step_dir = os.path.abspath(step_dir)
     if not os.path.isdir(step_dir):
         raise FileNotFoundError(f"checkpoint dir missing: {step_dir}")
     if fingerprint is None and tree is not None:
         fingerprint = tree_fingerprint(tree)
+    if topology is None and tree is not None:
+        # best-effort at SAVE time: a topology introspection failure must
+        # never cost the commit marker (the refuse-don't-guess happens at
+        # RESTORE, where a missing block reads as pre-upgrade)
+        try:
+            from apex_tpu.resilience.elastic.topology import topology_block
+
+            topology = topology_block(tree)
+        except Exception as e:  # noqa: BLE001 - save durability outranks it
+            logger.warning("topology block skipped for %s: %s", step_dir, e)
     manifest = {
         "version": MANIFEST_VERSION,
         "files": _file_digests(step_dir),
         "fingerprint": fingerprint,
     }
+    if topology is not None:
+        manifest["topology"] = topology
     if extra:
         manifest.update(extra)
     target = manifest_path(step_dir)
     tmp = target + ".tmp"
     with open(tmp, "w") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    return target
+
+
+def write_abandoned_marker(step_dir: str) -> str:
+    """Tombstone manifest for a DELIBERATELY-uncommitted async save.
+
+    The deadline-budgeted preemption path (utils/autoresume.py) may
+    decide there is no time to finalize an in-flight save. Without a
+    marker the background write could still complete the step dir, and a
+    later verified restore with ``allow_unverified=True`` would accept
+    it as a pre-manifest LEGACY checkpoint — un-fingerprinted state the
+    job explicitly chose not to vouch for. The tombstone is a manifest
+    whose ``"abandoned"`` flag makes :func:`verify_checkpoint` fail it
+    and whose existence defeats the legacy test, so every restore path
+    skips the dir cleanly. Written tmp+rename like the real manifest;
+    safe to write before the background rename lands (it is a sibling
+    file), and a later re-save of the same step overwrites it with a
+    real manifest at finalize.
+    """
+    target = manifest_path(step_dir)
+    tmp = target + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"version": MANIFEST_VERSION, "abandoned": True}, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, target)
@@ -192,6 +239,8 @@ def verify_checkpoint(step_dir: str, deep: bool = True) -> Tuple[bool, str]:
     manifest = read_manifest(step_dir)
     if manifest is None:
         return False, "no manifest (uncommitted or pre-manifest checkpoint)"
+    if manifest.get("abandoned"):
+        return False, "abandoned (deadline-budgeted preemption skip)"
     want = manifest.get("files", {})
     have = {
         os.path.relpath(os.path.join(r, n), step_dir)
@@ -267,13 +316,15 @@ def save_checkpoint_verified(
     retries: int = 3,
     backoff: float = 0.1,
     keep_last_n: Optional[int] = None,
+    extra: Optional[dict] = None,
 ) -> str:
     """Durable save: orbax write (with retry) + manifest + retention.
 
-    Multi-host: orbax coordinates the write across processes; the
-    manifest commit and retention sweep are process-0-only (every host
-    racing ``os.replace`` on the same manifest tmp file would corrupt
-    the commit marker).
+    ``extra`` merges additional keys into the manifest (AutoResume
+    persists its save-duration EMAs this way). Multi-host: orbax
+    coordinates the write across processes; the manifest commit and
+    retention sweep are process-0-only (every host racing ``os.replace``
+    on the same manifest tmp file would corrupt the commit marker).
     """
     path = save_with_retry(
         lambda: save_checkpoint(directory, step, tree),
@@ -282,7 +333,7 @@ def save_checkpoint_verified(
     import jax
 
     if jax.process_index() == 0:
-        write_manifest(path, tree)
+        write_manifest(path, tree, extra=extra)
         if keep_last_n is not None:
             apply_retention(directory, keep_last_n)
     return path
@@ -344,9 +395,18 @@ def apply_retention(directory: str, keep_last_n: int) -> List[int]:
     """Delete all but the newest ``keep_last_n`` steps; returns deleted.
 
     Also sweeps orphaned orbax tmp dirs (crashed async saves) and
-    manifests whose step directory is gone. The newest VERIFIED step is
-    never deleted even if retention math would drop it (shallow check —
-    this runs on the save path).
+    manifests whose step directory is gone. Two safety rules beyond the
+    raw window (shallow verification — this runs on the save path):
+
+    - the keep window is ALSO counted over VERIFIED steps, so torn or
+      uncommitted newer step dirs cannot push verified restore points
+      out of it (with ``keep_last_n=2`` and two torn dirs on top you
+      still keep two *restorable* checkpoints, not two piles of garbage
+      and one checkpoint);
+    - nothing at or past the newest verified step is ever deleted: an
+      unverified NEWER dir may be an in-flight async save whose manifest
+      has not landed yet (finalize commits it after this sweep's
+      ordering point), and sweeping it would tear the save.
     """
     if keep_last_n < 1:
         raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
@@ -354,14 +414,13 @@ def apply_retention(directory: str, keep_last_n: int) -> List[int]:
     if not os.path.isdir(directory):
         return []
     steps = finalized_steps(directory)
-    keep = set(steps[-keep_last_n:])
-    newest_ok = next(
-        (s for s in reversed(steps)
-         if verify_checkpoint(_step_dir(directory, s), deep=False)[0]),
-        None,
-    )
-    if newest_ok is not None:
-        keep.add(newest_ok)
+    verified = [
+        s for s in steps
+        if verify_checkpoint(_step_dir(directory, s), deep=False)[0]
+    ]
+    keep = set(steps[-keep_last_n:]) | set(verified[-keep_last_n:])
+    if verified:
+        keep.update(s for s in steps if s >= verified[-1])
     deleted = []
     for s in steps:
         if s in keep:

@@ -27,17 +27,52 @@ capability is first-class here instead of an external hook:
 
 The consensus collective costs one scalar all-reduce per *polled* step;
 poll every step (it is negligible next to a train step) or at a cadence.
+
+Deadline-budgeted termination saves: preemption grace windows are FIXED
+(the scheduler kills the process ``grace_s`` seconds after SIGTERM,
+saved or not), so blindly starting a full sync save on termination can
+be worse than not saving — a save that outlives the grace window leaves
+a torn, uncommitted step dir AND burned the time that finalizing an
+already-in-flight save would have used. ``AutoResume`` therefore
+measures its own recent save durations (EMAs, persisted in the
+integrity manifest so a restarted job inherits them) and, when a grace
+budget is configured (``grace_s=`` or ``APEX_TPU_PREEMPTION_GRACE_S``),
+picks the most durable action that provably fits the remaining budget:
+
+- ``save``      — full durable save of the CURRENT step (budget covers
+  the measured full-save EMA, or no history/budget to reason from);
+- ``finalize``  — commit only the pending async interval save (budget
+  covers the finalize EMA but not a fresh save): the job loses the
+  steps since the last interval, not the whole run;
+- ``skip``      — abandon even the pending save's manifest commit and
+  rely on the last already-verified checkpoint: a manifest commit that
+  might land after the kill is exactly the torn-but-plausible state the
+  integrity machinery exists to prevent. No torn manifest is ever
+  treated as durable.
+
+The decision is emitted as a ``kind="span"`` ckpt_save slice (with a
+``decision`` field) plus a ``kind="preemption"`` event through the
+goodput stream, so post-mortems can audit what the job chose and why.
+
+Elastic restart: ``restore()`` compares the newest verified manifest's
+topology block against the live mesh and, on a mismatch, routes through
+``resilience.elastic.restore_resharded`` — params re-laid-out onto the
+new mesh, ZeRO flat optimizer state regrouped across the changed dp
+size, refuse-don't-guess on anything else (docs/resilience.md "Elastic
+restart").
 """
 
 import logging
 import os
 import signal as _signal
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.monitor.goodput.spans import get_router as _goodput_router
 from apex_tpu.monitor.goodput.spans import span as _goodput_span
 from apex_tpu.utils.checkpoint import (
     AsyncCheckpointWriter,
@@ -45,9 +80,30 @@ from apex_tpu.utils.checkpoint import (
     load_checkpoint,
 )
 
-__all__ = ["AutoResume"]
+__all__ = ["AutoResume", "GRACE_ENV"]
 
 logger = logging.getLogger("apex_tpu.utils.autoresume")
+
+#: environment default for the preemption grace budget (seconds between
+#: SIGTERM and the scheduler's kill); unset/empty means "no budget" and
+#: termination always attempts the full durable save
+GRACE_ENV = "APEX_TPU_PREEMPTION_GRACE_S"
+
+
+def _env_grace() -> Optional[float]:
+    raw = os.environ.get(GRACE_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring unparseable %s=%r", GRACE_ENV, raw)
+        return None
+
+
+def _ema(old: Optional[float], x: float, alpha: float = 0.5) -> float:
+    """Recent-weighted EMA; seeds from the first sample."""
+    return x if old is None else (1.0 - alpha) * old + alpha * x
 
 
 class AutoResume:
@@ -74,10 +130,28 @@ class AutoResume:
       ``keep_last_n`` retention — before the next save is issued, or
       explicitly via :meth:`finalize` / :meth:`close`;
     - a TERMINATION save is finalized before ``step()`` returns True, so
-      "saved, you may exit" is never claimed for bytes still in flight;
+      "saved, you may exit" is never claimed for bytes still in flight —
+      unless a configured grace budget (``grace_s`` /
+      ``APEX_TPU_PREEMPTION_GRACE_S``) provably cannot fit it, in which
+      case the deadline decision (module docstring) downgrades to
+      finalize-pending-only or skip-and-rely-on-last-verified;
     - ``restore()`` skips torn or corrupt step directories (manifest
-      verification) and falls back to the newest verified checkpoint.
+      verification) and falls back to the newest verified checkpoint;
+      when the saved topology disagrees with the live mesh it reshards
+      through ``resilience.elastic`` (pass ``mesh=`` explicitly if the
+      state leaves carry no ``NamedSharding`` to derive it from).
+
+    Deadline-decision caveat (multi-host): the decision inputs — signal
+    arrival time and save-duration EMAs — are host-local, so hosts could
+    in principle pick different actions. In practice the EMAs track the
+    same collective saves and the grace budget is a cluster constant;
+    deployments that need hard agreement should pin ``grace_s`` and rely
+    on the consensus flag making every host decide at the same step.
     """
+
+    #: headroom multiplier on the measured EMAs before an action is
+    #: considered to fit the remaining grace budget
+    safety_factor = 1.25
 
     def __init__(
         self,
@@ -91,6 +165,8 @@ class AutoResume:
         save_retries: int = 3,
         save_backoff: float = 0.1,
         leaf_fingerprint: bool = True,
+        grace_s: Optional[float] = None,
+        mesh=None,
     ):
         self.directory = os.path.abspath(directory)
         self.interval = interval
@@ -104,15 +180,35 @@ class AutoResume:
         # manifest's per-file digests (computed at finalize, off the saved
         # bytes) still catch disk corruption with this off
         self.leaf_fingerprint = leaf_fingerprint
+        self.grace_s = grace_s if grace_s is not None else _env_grace()
+        self.mesh = mesh
         self._requested = False
         self._saved_for_termination = False
+        #: the deadline decision taken on termination ("save" /
+        #: "finalize" / "skip"; None until then) — callers print it so a
+        #: skipped save is never reported as a checkpoint
+        self.termination_decision: Optional[str] = None
         self._prev_handlers = {}
         self._consensus = None  # lazily-built (sharding, jitted max) pair
         self._writer: Optional[AsyncCheckpointWriter] = None
-        # (step, fingerprint) of an async save whose manifest is not yet
-        # committed — finalized before the next save / restore / close,
-        # and IMMEDIATELY for a termination save (durability claim)
-        self._pending: Optional[Tuple[int, Optional[dict]]] = None
+        # async save whose manifest is not yet committed — finalized
+        # before the next save / restore / close, and IMMEDIATELY for a
+        # termination save (durability claim). Keys: step, fingerprint,
+        # topology (both captured at save time: the caller may donate the
+        # buffers the moment step() returns), issue_s (the synchronous
+        # issuance cost, folded into the save EMA at finalize)
+        self._pending: Optional[dict] = None
+        self._abandoned_step: Optional[int] = None
+        # monotonic arrival time of the first termination signal — the
+        # grace budget counts down from HERE, not from the poll that
+        # noticed it (polls can lag the signal by most of a train step)
+        self._sigterm_t: Optional[float] = None
+        # measured durable-save cost EMAs (seconds): full save and
+        # finalize-only. Persisted in the manifest ("autoresume" block)
+        # and re-seeded by restore(), so a freshly restarted job can make
+        # a deadline decision before its own first save completes.
+        self._save_ema: Optional[float] = None
+        self._finalize_ema: Optional[float] = None
         if install_handlers:
             for sig in signals:
                 self._prev_handlers[sig] = _signal.signal(sig, self._on_signal)
@@ -125,6 +221,12 @@ class AutoResume:
 
         return integrity
 
+    def _manifest_extra(self) -> dict:
+        return {"autoresume": {
+            "save_ema_s": self._save_ema,
+            "finalize_ema_s": self._finalize_ema,
+        }}
+
     def finalize(self) -> None:
         """Block until every issued save is durable AND committed.
 
@@ -136,12 +238,33 @@ class AutoResume:
         """
         if self._pending is None:
             return
-        step, fingerprint = self._pending
+        pending = self._pending
+        step = pending["step"]
+        t0 = time.monotonic()
         # goodput span: host wall time BLOCKED on checkpoint durability
         # (the wait + manifest commit + retention sweep) — the piece of
         # ckpt_save badput the async overlap did NOT hide
         with _goodput_span("ckpt_save", step=step):
             self._writer.wait()
+            # EMAs folded BEFORE the manifest write so THIS save's cost
+            # is already in the persisted block (a restarted job inherits
+            # it from its very first checkpoint). The manifest write +
+            # retention sweep are excluded from the sample — ms-scale
+            # next to the checkpoint bytes.
+            #
+            # The FULL-save EMA only folds UNOVERLAPPED samples
+            # (fold_full: durable saves and the first-save calibration,
+            # where finalize immediately follows issuance). An interval
+            # save finalized many steps later observes wait ~ 0 because
+            # training HID the write — folding that would converge the
+            # EMA to the issuance cost alone, and the deadline decision
+            # would pick "save" for grace budgets a fresh (nothing to
+            # hide behind) termination save cannot fit.
+            wait_s = time.monotonic() - t0
+            self._finalize_ema = _ema(self._finalize_ema, wait_s)
+            if pending["fold_full"]:
+                self._save_ema = _ema(
+                    self._save_ema, pending["issue_s"] + wait_s)
             if jax.process_index() == 0:
                 integrity = self._integrity()
                 # retried, and _pending is only cleared on success: a
@@ -151,7 +274,9 @@ class AutoResume:
                 integrity.save_with_retry(
                     lambda: integrity.write_manifest(
                         os.path.join(self.directory, f"step_{step}"),
-                        fingerprint=fingerprint,
+                        fingerprint=pending["fingerprint"],
+                        topology=pending["topology"],
+                        extra=self._manifest_extra(),
                     ),
                     retries=self.save_retries, backoff=self.save_backoff,
                 )
@@ -160,31 +285,46 @@ class AutoResume:
                                               self.keep_last_n)
         self._pending = None
 
+    def _topology(self, state) -> Optional[dict]:
+        from apex_tpu.resilience.elastic import topology_block
+
+        try:
+            return topology_block(state)
+        except Exception as e:  # noqa: BLE001 - durability outranks metadata
+            logger.warning("topology block skipped: %s", e)
+            return None
+
     def _save(self, step: int, state: Any, durable: bool) -> None:
         integrity = self._integrity()
         if not self.use_async:
+            t0 = time.monotonic()
             with _goodput_span("ckpt_save", step=step):
                 integrity.save_checkpoint_verified(
                     self.directory, step, state,
                     retries=self.save_retries, backoff=self.save_backoff,
                     keep_last_n=(self.keep_last_n
                                  if jax.process_index() == 0 else None),
+                    extra=self._manifest_extra(),
                 )
+            self._save_ema = _ema(self._save_ema, time.monotonic() - t0)
             return
         self.finalize()  # previous pending save first (ordering + bounded lag)
         if self._writer is None:
             self._writer = AsyncCheckpointWriter()
+        t0 = time.monotonic()
         # goodput span: the synchronous slice of an async save — the
         # fingerprint's device->host copy and the write ISSUANCE (the
         # background write itself overlaps training and is accounted by
         # finalize()'s span when it blocks)
         with _goodput_span("ckpt_save", step=step):
-            # fingerprint NOW: the caller may donate/mutate these buffers
-            # the moment step() returns, and the manifest commits later
+            # fingerprint + topology NOW: the caller may donate/mutate
+            # these buffers the moment step() returns, and the manifest
+            # commits later
             fingerprint = (
                 integrity.tree_fingerprint(state)
                 if self.leaf_fingerprint else None
             )
+            topology = self._topology(state)
             # the retry covers save ISSUANCE (snapshot-to-host + handoff);
             # an error in the background write itself surfaces un-retried
             # at the next finalize()'s wait() — by then the source buffers
@@ -193,15 +333,57 @@ class AutoResume:
                 lambda: self._writer.save(self.directory, step, state),
                 retries=self.save_retries, backoff=self.save_backoff,
             )
-        self._pending = (step, fingerprint)
-        if durable:
+        # first-save calibration: with no full-cost sample yet, finalize
+        # immediately so the EMA's seed measures a REAL durable save
+        # (issuance + the whole write, nothing overlapped) — one blocking
+        # save, paid when the run is cheapest to pause
+        calibrate = self._save_ema is None
+        self._pending = {
+            "step": step, "fingerprint": fingerprint, "topology": topology,
+            "issue_s": time.monotonic() - t0,
+            "fold_full": durable or calibrate,
+        }
+        if durable or calibrate:
             self.finalize()
+
+    def _abandon_pending(self) -> None:
+        """Drop the pending save WITHOUT committing its manifest.
+
+        The deadline decision's ``skip`` arm: the background write may
+        still land its bytes, but with no manifest the step dir is
+        uncommitted and every verified restore skips it — torn, but
+        cleanly so. The last verified checkpoint stays the durable one.
+        """
+        if self._pending is None:
+            return
+        self._abandoned_step = self._pending["step"]
+        logger.warning(
+            "abandoning un-finalized async save of step_%d (grace budget): "
+            "no manifest will be committed; restore uses the last verified "
+            "step", self._abandoned_step,
+        )
+        self._pending = None
+        # tombstone manifest: the background write may still complete the
+        # dir, and without this a legacy-tolerant restore would accept
+        # the un-vouched-for state (integrity.write_abandoned_marker)
+        if jax.process_index() == 0:
+            try:
+                self._integrity().write_abandoned_marker(
+                    os.path.join(self.directory,
+                                 f"step_{self._abandoned_step}")
+                )
+            except OSError as e:
+                logger.warning("abandoned-marker write failed: %s", e)
 
     # -- signal plumbing ---------------------------------------------------
 
     def _on_signal(self, signum, frame):
         # flag only: checkpoint IO from inside a signal handler could fire
-        # mid-XLA-dispatch; the training loop polls at a safe boundary
+        # mid-XLA-dispatch; the training loop polls at a safe boundary.
+        # The timestamp is one float store — async-signal-safe — and
+        # anchors the grace-budget countdown at signal ARRIVAL.
+        if self._sigterm_t is None:
+            self._sigterm_t = time.monotonic()
         self._requested = True
 
     def close(self):
@@ -216,6 +398,8 @@ class AutoResume:
 
     def request_resume(self):
         """Programmatic preemption request (ref ADLR ``request_resume``)."""
+        if self._sigterm_t is None:
+            self._sigterm_t = time.monotonic()
         self._requested = True
 
     # -- consensus ---------------------------------------------------------
@@ -257,22 +441,88 @@ class AutoResume:
         anyone = reduce(global_flags)
         return bool(np.asarray(anyone)[()] > 0)
 
+    # -- deadline budget ---------------------------------------------------
+
+    def _emergency_decision(self, now: Optional[float] = None
+                            ) -> Tuple[str, dict]:
+        """(action, info) for the termination save: ``save`` /
+        ``finalize`` / ``skip`` (module docstring). Pure function of the
+        grace budget, signal arrival time, EMAs, and pending state —
+        seedable and unit-testable.
+        """
+        now = time.monotonic() if now is None else now
+        info = {
+            "grace_s": self.grace_s,
+            "save_ema_s": self._save_ema,
+            "finalize_ema_s": self._finalize_ema,
+            "pending_step": (self._pending["step"]
+                             if self._pending else None),
+            "remaining_s": None,
+        }
+        if self.grace_s is None:
+            return "save", info  # no budget: durability wins
+        anchor = self._sigterm_t if self._sigterm_t is not None else now
+        remaining = (anchor + self.grace_s) - now
+        info["remaining_s"] = remaining
+        if self._save_ema is None:
+            # no measured history to reason from: attempt the save (the
+            # conservative-for-durability default; a first-save job has
+            # nothing pending to finalize anyway)
+            return "save", info
+        if remaining >= self.safety_factor * self._save_ema:
+            return "save", info
+        est_fin = (self._finalize_ema
+                   if self._finalize_ema is not None else self._save_ema)
+        if self._pending is not None and remaining >= (
+                self.safety_factor * est_fin):
+            return "finalize", info
+        return "skip", info
+
     # -- loop API ----------------------------------------------------------
 
     def step(self, step: int, state: Any) -> bool:
         """Call after each training step with the POST-step state.
 
         Saves on the periodic interval and on termination request; returns
-        True when the caller should exit (a termination checkpoint was
-        written).
+        True when the caller should exit. On termination the deadline
+        decision (module docstring) picks save / finalize-pending /
+        skip-and-rely-on-last-verified so the manifest commit always
+        lands inside the grace budget; the decision is emitted as a
+        ckpt_save span slice plus a ``kind="preemption"`` event.
         """
         terminating = self.termination_requested()
         if terminating and not self._saved_for_termination:
-            # durable=True: wait for the write AND commit the manifest
-            # BEFORE telling the caller it may exit — an exit on an
-            # un-finalized async save is exactly the torn checkpoint this
-            # machinery exists to prevent
-            self._save(step, state, durable=True)
+            decision, info = self._emergency_decision()
+            self.termination_decision = decision
+            # durable semantics per arm: "save" waits for the write AND
+            # commits the manifest BEFORE telling the caller it may exit
+            # — an exit on an un-finalized async save is exactly the torn
+            # checkpoint this machinery exists to prevent; "finalize"
+            # commits only the in-flight interval save; "skip" abandons
+            # even that commit (a marker racing the kill is worse than a
+            # clean fallback to the last verified step)
+            with _goodput_span("ckpt_save", step=step, decision=decision):
+                if decision == "save":
+                    self._save(step, state, durable=True)
+                    saved_step = step
+                elif decision == "finalize":
+                    saved_step = info["pending_step"]
+                    self.finalize()
+                else:
+                    self._abandon_pending()
+                    saved_step = None
+            router = _goodput_router()
+            if router is not None:
+                router.event(
+                    "preemption", step, decision=decision,
+                    saved_step=saved_step, **info,
+                )
+            logger.info(
+                "termination at step %d: decision=%s saved_step=%s "
+                "(grace_s=%s save_ema_s=%s remaining_s=%s)",
+                step, decision, saved_step, info["grace_s"],
+                info["save_ema_s"], info["remaining_s"],
+            )
             self._saved_for_termination = True
             return True
         if terminating:
@@ -280,6 +530,19 @@ class AutoResume:
         if self.interval and step % self.interval == 0:
             self._save(step, state, durable=False)
         return False
+
+    def _seed_emas(self, step: int) -> None:
+        """Inherit persisted save-duration EMAs from the restored step's
+        manifest (only when this process has no measurements yet)."""
+        manifest = self._integrity().read_manifest(
+            os.path.join(self.directory, f"step_{step}")
+        ) or {}
+        block = manifest.get("autoresume") or {}
+        if self._save_ema is None and block.get("save_ema_s") is not None:
+            self._save_ema = float(block["save_ema_s"])
+        if (self._finalize_ema is None
+                and block.get("finalize_ema_s") is not None):
+            self._finalize_ema = float(block["finalize_ema_s"])
 
     def restore(self, init_state: Any) -> Tuple[int, Any]:
         """(step, state): newest RESTORABLE checkpoint, else (0, init).
@@ -291,8 +554,13 @@ class AutoResume:
         newest-first, checks each integrity manifest, and falls back past
         torn / bit-flipped / uncommitted checkpoints to the newest step
         that verifies (pre-manifest legacy checkpoints are accepted, as
-        their corruption is undetectable). ``verify=False`` restores the
-        raw latest step and lets corruption crash the run.
+        their corruption is undetectable). When the newest verified
+        manifest's topology block disagrees with the live mesh (derived
+        from ``init_state``'s shardings, or passed as ``mesh=``), the
+        restore reshards through ``resilience.elastic`` — and REFUSES
+        (``ElasticRestoreError``) on layout changes it cannot prove
+        resharddable, rather than misloading. ``verify=False`` restores
+        the raw latest step and lets corruption crash the run.
         """
         self.finalize()
         # goodput span: restart recovery cost (badput phase ckpt_restore)
@@ -304,9 +572,27 @@ class AutoResume:
                 return step, load_checkpoint(
                     self.directory, step, target=init_state
                 )
+            from apex_tpu.resilience import elastic
+
+            mesh = self.mesh
+            if mesh is None:
+                mesh = elastic.derive_mesh(init_state)
+            if mesh is not None and elastic.needs_reshard(
+                    self.directory, mesh):
+                step, state = elastic.restore_resharded(
+                    self.directory, init_state, mesh=mesh
+                )
+                logger.info(
+                    "elastic restore: resharded step_%d onto the live "
+                    "mesh %s", step, dict(mesh.shape),
+                )
+                self._seed_emas(step)
+                return step, state
             try:
-                return self._integrity().load_checkpoint_verified(
+                step, state = self._integrity().load_checkpoint_verified(
                     self.directory, target=init_state, allow_unverified=True
                 )
             except FileNotFoundError:
                 return 0, init_state
+            self._seed_emas(step)
+            return step, state

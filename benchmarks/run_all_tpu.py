@@ -60,6 +60,7 @@ BUDGETS = {
     "pair": int(os.environ.get("APEX_TPU_PAIR_BUDGET", "1500")),
     "profile": int(os.environ.get("APEX_TPU_PROFILE_BUDGET", "2000")),
     "sweep": int(os.environ.get("APEX_TPU_SWEEP_BUDGET", "900")),
+    "ckpt": int(os.environ.get("APEX_TPU_CKPT_BUDGET", "900")),
 }
 
 # Sticky relay-liveness verdict for this capture attempt.  A dead relay
@@ -610,6 +611,95 @@ def run_sweep(deadline, out_path):
     return rec
 
 
+def run_ckpt(deadline, out_path):
+    """Checkpoint-path wall times: verified save, verified restore, and
+    elastic reshard (all devices -> half) of a representative
+    params+ZeRO-state tree (~20 MB).  Each lands as a metric-carrying
+    sub-record, so ``emit()`` writes a ``kind="bench"`` twin and the
+    PR-7 perf sentinel gates checkpoint-path regressions exactly like
+    compute benches (``python -m apex_tpu.monitor.goodput --check``).
+    Host wall clock is honest here — the save/restore path is host+disk
+    work, not device dispatch, so the relay's async-dispatch lie
+    (docs/benchmarking.md) does not apply; the one device fetch
+    (fingerprint + orbax snapshot) is part of the measured cost by
+    design."""
+    import functools
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
+    from apex_tpu.resilience import integrity
+    from apex_tpu.resilience.elastic import restore_resharded
+
+    devs = np.asarray(jax.devices())
+    n = int(devs.size)
+    if n < 2:
+        return {"measured_n": 0, "note": f"needs >=2 devices, have {n}"}
+    half = n // 2
+    specs = zero_state_specs("dp")
+
+    def make_state(mesh, dp):
+        rep = NamedSharding(mesh, P())
+        params = {
+            "w": jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(0), (1024, 1024),
+                                  jnp.float32), rep),
+            # odd tail so the ZeRO padded flat length actually CHANGES
+            # across the dp-size change (the regroup path, not a no-op)
+            "b": jax.device_put(jnp.zeros((1019,), jnp.float32), rep),
+        }
+        opt = distributed_fused_adam(lr=1e-3, axis_name="dp", axis_size=dp)
+        init = functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(),), out_specs=specs,
+            check_vma=False,
+        )(opt.init)
+        return {"params": params, "opt": init(params)}
+
+    state = make_state(Mesh(devs[:n], ("dp",)), n)
+    jax.block_until_ready(state["params"]["w"])
+    target = make_state(Mesh(devs[:half], ("dp",)), half)
+    d = tempfile.mkdtemp(prefix="apex_tpu_ckpt_bench_")
+    rec = {"measured_n": 0, "devices": n,
+           "state_mb": round(sum(
+               np.asarray(l).nbytes for l in jax.tree_util.tree_leaves(state)
+           ) / 1e6, 1)}
+    items = [
+        ("save", "ckpt_save_s",
+         lambda: integrity.save_checkpoint_verified(d, 1, state)),
+        ("restore", "ckpt_restore_s",
+         lambda: integrity.load_checkpoint_verified(d, target=state)),
+        ("reshard", "ckpt_reshard_s",
+         lambda: restore_resharded(d, target)),
+    ]
+    incomplete = []
+    try:
+        for name, metric, fn in items:
+            if time.monotonic() >= deadline:
+                incomplete.append(name)
+                rec[metric] = "skipped: section budget exhausted"
+                continue
+            t0 = time.monotonic()
+            fn()
+            dt = round(time.monotonic() - t0, 4)
+            rec[metric] = dt
+            rec["measured_n"] += 1
+            emit(out_path, {"section": f"ckpt_{name}", "ok": True,
+                            "completed": True, "metric": metric,
+                            "value": dt, "unit": "s",
+                            "state_mb": rec["state_mb"]})
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    if incomplete:
+        rec["incomplete"] = incomplete
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(os.path.dirname(__file__), "tpu_results.jsonl"))
@@ -638,6 +728,7 @@ def main():
         ("pair", functools.partial(run_pair, out_path=args.out)),
         ("configs", functools.partial(run_configs, out_path=args.out)),
         ("sweep", functools.partial(run_sweep, out_path=args.out)),
+        ("ckpt", functools.partial(run_ckpt, out_path=args.out)),
     ]
     for name, fn in runners:
         if name not in skip:

@@ -71,6 +71,17 @@ def parse_args():
     p.add_argument("--save-interval", type=int, default=100)
     p.add_argument("--keep-last-n", type=int, default=None,
                    help="checkpoint retention: keep only the newest N steps")
+    p.add_argument("--grace-s", type=float, default=None,
+                   help="preemption grace budget in seconds (default: "
+                        "$APEX_TPU_PREEMPTION_GRACE_S); the SIGTERM save "
+                        "downgrades to finalize-pending or "
+                        "skip-and-rely-on-last-verified when a full save "
+                        "cannot fit (docs/resilience.md)")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-2 optimizer (DistributedFusedAdam): Adam "
+                        "moments + fp32 master sharded 1/dp over the dp "
+                        "axis; checkpoints of this state reshard across a "
+                        "dp-size change via the elastic restore")
     p.add_argument("--seed", type=int, default=0)
     # resilience policy (apex_tpu.resilience; docs/resilience.md)
     p.add_argument("--spike-z", type=float, default=6.0,
@@ -248,8 +259,29 @@ def main():
 
     sample_tokens = jnp.zeros((args.micro_batch, args.seq_len), jnp.int32)
 
-    opt = fused_adam(lr=args.lr, weight_decay=0.01)
-    scaler = GradScaler(loss_scale="dynamic")
+    # --zero: the ZeRO-2 optimizer's psum_scatter IS the dp gradient sync
+    # (average_grads=True completes the mean), so the explicit dp
+    # all-reduce below is skipped; its state crosses the shard_map
+    # boundary dp-SHARDED (zero_state_specs) and the elastic restore
+    # regroups it across a dp-size change (docs/resilience.md)
+    if args.zero:
+        from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
+
+        opt = distributed_fused_adam(
+            lr=args.lr, weight_decay=0.01, axis_name="dp", axis_size=dp,
+            average_grads=True,
+        )
+        opt_specs = zero_state_specs("dp")
+    else:
+        opt = fused_adam(lr=args.lr, weight_decay=0.01)
+        opt_specs = P()
+    # under ZeRO the grads stay per-rank partials until the optimizer's
+    # reduce-scatter, so the overflow flag must join the dp consensus too
+    # (without it one rank could skip while the others step)
+    scaler = GradScaler(
+        loss_scale="dynamic",
+        model_parallel_axes=("tp", "pp", "dp") if args.zero else ("tp", "pp"),
+    )
     sentinel = resilience.AnomalySentinel(
         z_threshold=args.spike_z,
         warmup_steps=args.spike_warmup,
@@ -287,9 +319,9 @@ def main():
     @functools.partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), P(None, "dp"), P(None, "dp"),
-                  P(), P()),
-        out_specs=(P(), P(), P(), P(), P(), P(), P()),
+        in_specs=(P(), opt_specs, P(), P(), P(), P(None, "dp"),
+                  P(None, "dp"), P(), P()),
+        out_specs=(P(), opt_specs, P(), P(), P(), P(), P()),
         check_vma=False,
     )
     def train_step(params, opt_state, scaler_state, sent_state, bag, tokens,
@@ -311,7 +343,10 @@ def main():
         # while the batched collective ships num_micro x the bytes
         with monitor.xray.scaled(num_micro):
             loss, grads = jax.value_and_grad(scaled_total)(params)
-        grads = all_reduce_gradients(grads, axis_name="dp")
+        if not args.zero:
+            # ZeRO's reduce-scatter inside opt.update replaces this
+            # all-reduce (feeding it pre-averaged grads would double-count)
+            grads = all_reduce_gradients(grads, axis_name="dp")
         grads, found_inf = scaler.unscale(scaler_state, grads)
         # the scaler's dynamic schedule reacts to true overflow only; the
         # sentinel's spike gate must NOT halve the scale (a spike is not a
@@ -383,7 +418,17 @@ def main():
     # moment the state round-trips through a checkpoint — restored arrays
     # are committed, and mixed device sets are a hard error
     replicated = jax.sharding.NamedSharding(mesh, P())
-    opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
+    if args.zero:
+        # ZeRO init needs the mesh axis (axis_index slices this rank's
+        # shard); the state leaves come out dp-sharded NamedShardings —
+        # exactly the layout the elastic restore needs as its target
+        init_opt = functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(),), out_specs=opt_specs,
+            check_vma=False,
+        )(opt.init)
+        opt_state = init_opt(params)
+    else:
+        opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
     scaler_state = jax.device_put(scaler.init(), replicated)
     sent_state = jax.device_put(sentinel.init(), replicated)
     bag = jax.device_put(monitor.metric_bag(METRIC_SPEC), replicated)
@@ -432,9 +477,13 @@ def main():
     # current step and breaks the loop; a rerun with the same --save dir
     # resumes — from the newest CHECKSUM-VERIFIED step (torn/corrupt step
     # dirs are skipped; see apex_tpu.resilience.integrity).
+    # mesh= routes a topology-changed restore through the elastic
+    # resharder (8-chip checkpoint resumed on 4, dp-sharded ZeRO state
+    # regrouped); grace_s= arms the deadline-budgeted termination save
     ar = (
         AutoResume(args.save, interval=args.save_interval,
-                   keep_last_n=args.keep_last_n)
+                   keep_last_n=args.keep_last_n, mesh=mesh,
+                   grace_s=args.grace_s)
         if args.save else None
     )
     step0 = 0
@@ -445,7 +494,10 @@ def main():
             )
         except ValueError as e:
             # a --save dir written by an older payload layout: train fresh
-            # rather than crash (old checkpoints stay on disk untouched)
+            # rather than crash (old checkpoints stay on disk untouched).
+            # A refused elastic reshard is ElasticRestoreError — a
+            # RuntimeError, deliberately NOT caught here: resuming fresh
+            # over a refusal would silently discard the run
             print(f"checkpoint in {args.save} has an incompatible layout "
                   f"({e}); starting fresh")
         if step0:
@@ -711,7 +763,15 @@ def main():
             last_emit_t = time.perf_counter()
         plan.maybe_sigterm(step_i)
         if ar is not None and ar.step(step_i + 1, state):
-            print(f"termination checkpoint at step {step_i + 1}; exiting")
+            if ar.termination_decision == "save":
+                print(f"termination checkpoint at step {step_i + 1}; exiting")
+            else:
+                # the grace budget could not fit a fresh save: the
+                # deadline decision downgraded (finalize-pending or
+                # skip-and-rely-on-last-verified) — say so, never claim
+                # a checkpoint that was not committed
+                print(f"termination at step {step_i + 1}: "
+                      f"{ar.termination_decision} (grace budget); exiting")
             break
         # compile accounting LAST in the iteration, so every first-use
         # host-side compile (the interval path is warmed before the

@@ -56,6 +56,13 @@ def parse_args():
     p.add_argument("--metrics-jsonl", default=None,
                    help="write run/span/goodput (and any other) records "
                         "to this jsonl (apex_tpu.monitor schema)")
+    p.add_argument("--save", default=None,
+                   help="checkpoint directory: resume from it at startup "
+                        "and save the trained params + ZeRO opt state at "
+                        "the end (manifest-verified, topology block "
+                        "included) — a rerun on a DIFFERENT device count "
+                        "reshards the dp-sharded ZeRO state elastically "
+                        "(docs/resilience.md \"Elastic restart\")")
     return p.parse_args()
 
 
@@ -101,7 +108,9 @@ def main():
     # backend init BEFORE the header so it resolves the same host index
     # as every later record (the gpt example's multi-process caveat)
     len(jax.devices())
-    run_id = goodput.derive_run_id()
+    # anchor on --save when given: every restart of the same job (even on
+    # a different device count) joins one goodput ledger
+    run_id = goodput.derive_run_id(args.save)
     goodput.run_header(router, run_id, steps=args.steps)
     goodput.set_router(router)
     init_span = goodput.begin_span("init")
@@ -191,6 +200,18 @@ def main():
         return params, opt_state, losses
 
     opt_state = init_opt(variables)
+    step0 = 0
+    ar = None
+    if args.save:
+        from apex_tpu.utils import AutoResume
+
+        # mesh= routes a device-count change through the elastic
+        # resharder: the dp-sharded ZeRO flat buffers (whose LENGTH bakes
+        # in the dp size) are regrouped onto this run's mesh
+        ar = AutoResume(args.save, interval=1, mesh=mesh)
+        step0, (variables, opt_state) = ar.restore((variables, opt_state))
+        if step0:
+            print(f"resumed from step {step0} on {n_dev} device(s)")
     audit_lowered = audit_compiled = audit_module = None
     if args.audit_donation or args.audit_comms:
         # one shared AOT compile + one HLO text/parse for both audits
@@ -275,6 +296,13 @@ def main():
     assert np.isfinite(losses).all()
 
     shutdown_span = goodput.begin_span("shutdown", step=args.steps)
+    if ar is not None:
+        # interval=1 makes this unconditional: one verified save of the
+        # trained state (ckpt_save spans land inside the shutdown span;
+        # priority attribution books them as ckpt_save)
+        ar.step(step0 + args.steps, (params, opt_state))
+        ar.close()
+        print(f"checkpointed step {step0 + args.steps} to {args.save}")
     if args.profile_analyze:
         # device-time timeline (apex_tpu.monitor.xray.timeline,
         # docs/observability.md#timeline). The main run is ONE compiled

@@ -48,6 +48,12 @@ _SLOW_TESTS = {
     "test_gpt_pretrain_example",
     "test_gpt_pretrain_resume",
     "test_gpt_pretrain_chaos",
+    "test_elastic_selftest_gate",
+    "test_gpt_elastic_chaos_drill",
+    "test_gpt_preemption_skip_budget",
+    # subprocess pins: each child pays a fresh jax import (~10 s)
+    "test_sigterm_mid_finalize_still_commits",
+    "test_kill_mid_async_save_leaves_clean_torn_dir",
     "test_gpt_pretrain_xray",
     "test_gpt_pretrain_profile_analyze",
     "test_analysis_cli_subprocess",

@@ -519,6 +519,47 @@ class TestLintFramework:
             "examples/fake.py:2", "examples/fake.py:4",
         ]
 
+    def test_signal_handlers_seeded(self):
+        # raw registration in library code (both the plain and the repo's
+        # `import signal as _signal` spellings) and the import-hiding
+        # `from signal import signal` form are all flagged
+        files = {
+            "apex_tpu/fake.py":
+                "import signal\nimport signal as _signal\n"
+                "signal.signal(signal.SIGTERM, lambda *a: None)\n"
+                "_signal.signal(_signal.SIGINT, lambda *a: None)\n"
+                "from signal import signal\n",
+            "examples/fake.py":
+                "import signal\n"
+                "signal.signal(signal.SIGTERM, lambda *a: None)\n",
+        }
+        fins = run_lint(rules=["lint.signal-handlers"], files=files)
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/fake.py:3", "apex_tpu/fake.py:4",
+            "apex_tpu/fake.py:5", "examples/fake.py:2",
+        ]
+        assert all(f.rule == "lint.signal-handlers" for f in fins)
+
+    def test_signal_handlers_reads_not_flagged(self):
+        # getsignal / SIG constants / os.kill are reads or delivery, not
+        # registration — the rule polices rewiring only
+        files = {
+            "apex_tpu/fake.py":
+                "import os, signal as _signal\n"
+                "h = _signal.getsignal(_signal.SIGTERM)\n"
+                "os.kill(os.getpid(), _signal.SIGTERM)\n",
+        }
+        assert run_lint(rules=["lint.signal-handlers"], files=files) == []
+
+    def test_signal_handlers_blessed_homes_allowlisted(self):
+        # the two homes exist, are flagged by the raw rule, and are the
+        # ONLY apex_tpu/examples sites (require_hit entries go stale if
+        # either registration moves)
+        fins = run_lint(rules=["lint.signal-handlers"])
+        homes = {f.site.rsplit(":", 1)[0] for f in fins}
+        assert homes == {"apex_tpu/utils/autoresume.py",
+                         "apex_tpu/monitor/router.py"}
+
     def test_registered_taps_seeded(self):
         files = {
             "apex_tpu/fake.py":

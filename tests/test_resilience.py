@@ -295,6 +295,43 @@ class TestIntegrity:
         assert 1 in finalized_steps(d)
         assert resilience.load_checkpoint_verified(d, target=self._tree())[0] == 1
 
+    def test_retention_torn_dirs_do_not_push_verified_out(self, tmp_path):
+        """PR 8 satellite pin: torn/uncommitted NEWER step dirs (an
+        interrupted async save: bytes on disk, manifest never committed)
+        must neither push verified restore points out of the keep window
+        nor be swept themselves (they may be an in-flight save)."""
+        d = str(tmp_path)
+        for s in (1, 2, 3):
+            resilience.save_checkpoint_verified(d, s, self._tree(s))
+        for s in (4, 5):
+            sd = tmp_path / f"step_{s}"
+            sd.mkdir()
+            (sd / "payload.bin").write_bytes(b"torn")
+        deleted = resilience.apply_retention(d, keep_last_n=2)
+        # the verified window still holds TWO verified steps (2, 3); the
+        # raw window {4, 5} alone would have left ONE
+        assert deleted == [1]
+        assert finalized_steps(d) == [2, 3, 4, 5]
+        assert resilience.verified_latest_step(d, deep=False) == 3
+        step, _ = resilience.load_checkpoint_verified(
+            d, target=self._tree())
+        assert step == 3
+
+    def test_retention_abandoned_marker_fails_verification(self, tmp_path):
+        """An abandoned async save (deadline-budgeted preemption skip)
+        is tombstoned: the dir may complete on disk, but it must never
+        verify NOR be accepted as a legacy pre-manifest checkpoint."""
+        d = str(tmp_path)
+        resilience.save_checkpoint_verified(d, 1, self._tree(1))
+        save_checkpoint(d, 2, self._tree(2))  # completed, uncommitted
+        resilience.write_abandoned_marker(os.path.join(d, "step_2"))
+        ok, why = resilience.verify_checkpoint(os.path.join(d, "step_2"))
+        assert not ok and "abandoned" in why
+        step, _ = resilience.load_checkpoint_verified(
+            d, target=self._tree(), allow_unverified=True
+        )
+        assert step == 1  # NOT legacy-accepted despite allow_unverified
+
     def test_save_with_retry_recovers_transient_failures(self):
         calls = {"n": 0}
 
@@ -668,6 +705,112 @@ class TestChaosEndToEnd:
         assert res["mgr"].lr_scale == 0.5
         assert res["mgr"].rollbacks_used == 1
         assert len(res["losses"]) == self.STEPS
+
+
+@pytest.mark.chaos
+class TestPreemptionDuringFinalize:
+    """PR 8 satellite: preemption arriving DURING the async-save
+    finalize. A SIGTERM mid-``AsyncCheckpointWriter.wait`` must still
+    commit the manifest (the handler only flips a flag; the wait and
+    commit run to completion); a hard kill mid-write must leave a
+    cleanly-torn dir that the verified walk skips — never a
+    plausible-but-unverified restore source."""
+
+    _CHILD_PRELUDE = """
+import os, threading, time, signal
+import numpy as np
+import jax; jax.config.update('jax_platforms', 'cpu')
+from apex_tpu.utils import AutoResume
+from apex_tpu import resilience
+
+d = {save_dir!r}
+big = {{"w": np.random.RandomState(0).randn(6_000_000).astype(np.float32)}}
+"""
+
+    def _run_child(self, body, save_dir, expect_rc=0, kill_on=None,
+                   kill_sig=None):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        code = self._CHILD_PRELUDE.format(save_dir=save_dir) + body
+        if kill_on is None:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True, env=env,
+                                  timeout=240)
+            assert proc.returncode == expect_rc, (proc.returncode,
+                                                  proc.stdout[-500:],
+                                                  proc.stderr[-800:])
+            return proc.stdout
+        proc = subprocess.Popen([sys.executable, "-c", code],
+                                stdout=subprocess.PIPE, text=True, env=env)
+        try:
+            for line in proc.stdout:
+                if kill_on in line:
+                    proc.send_signal(kill_sig)
+                    break
+        finally:
+            proc.wait(timeout=240)
+        return None
+
+    def test_sigterm_mid_finalize_still_commits(self, tmp_path):
+        """SIGTERM while finalize() blocks in wait(): the AutoResume
+        handler is flag-only, so the wait completes and the manifest
+        commit lands — the checkpoint IS durable, not torn."""
+        body = """
+ar = AutoResume(d, interval=1)  # handlers installed: the real signal path
+ar._save_ema = 1e-3             # defeat first-save calibration: the save
+                                # must still be PENDING when SIGTERM lands
+ar.step(1, big)                 # async save issued, manifest pending
+# deliver a REAL SIGTERM racing the finalize's wait()
+threading.Timer(0.02, lambda: os.kill(os.getpid(), signal.SIGTERM)).start()
+ar.finalize()                   # must run to completion regardless
+ar.close()
+ok, why = resilience.verify_checkpoint(os.path.join(d, "step_1"))
+print(f"COMMITTED ok={ok} why={why}")
+assert ok, why
+assert ar.termination_requested()
+"""
+        out = self._run_child(body, str(tmp_path))
+        assert "COMMITTED ok=True" in out
+        assert resilience.verified_latest_step(str(tmp_path)) == 1
+
+    def test_kill_mid_async_save_leaves_clean_torn_dir(self, tmp_path):
+        """SIGKILL mid-background-write (the preemption the grace window
+        did NOT cover): whatever is left of step_2 — an orbax tmp dir,
+        or a completed dir with no manifest — the verified walk must
+        skip it and restore the previously finalized step."""
+        body = """
+small = {"w": np.ones((4,), np.float32)}
+ar = AutoResume(d, interval=1, install_handlers=False)
+ar.step(1, small)
+ar.finalize()                   # step 1 committed: the durable anchor
+ar.step(2, big)                 # background write starts...
+print("ISSUED", flush=True)
+time.sleep(60)                  # ...and is killed under it
+"""
+        self._run_child(body, str(tmp_path), kill_on="ISSUED",
+                        kill_sig=signal.SIGKILL)
+        d = str(tmp_path)
+        assert resilience.verified_latest_step(d) == 1
+        # strict walk (no legacy tolerance): whether the kill left an
+        # orbax tmp dir or a completed-but-uncommitted step_2, the
+        # restore lands on the finalized step
+        step, tree = resilience.load_checkpoint_verified(
+            d, target={"w": np.ones((4,), np.float32)},
+        )
+        assert step == 1
+        np.testing.assert_array_equal(tree["w"], np.ones((4,), np.float32))
+        # whatever step_2 left behind, it is not offered as restorable
+        s2 = os.path.join(d, "step_2")
+        if os.path.isdir(s2) and s2 in [
+            os.path.join(d, f"step_{s}") for s in finalized_steps(d)
+        ]:
+            ok, _ = resilience.verify_checkpoint(s2)
+            assert not ok
 
 
 class TestSigtermSpanFlush:
