@@ -55,6 +55,15 @@ Plus the new rules this framework exists to host:
   each other — the last registration wins the whole process — and break
   the SIG_DFL-precedence contract those two homes coordinate on (PR 7);
   a third registrant must route through one of them.
+- ``lint.silent-except`` — no bare ``except:`` and no broad
+  ``except Exception/BaseException:`` whose body does NOTHING (only
+  ``pass``/``...``/``continue``) in library code. A silent broad swallow
+  is how a failed span flush, a half-written checkpoint, or a dead sink
+  becomes an invisible non-event; a broad handler that LOGS (or
+  re-raises, or returns a fallback) is fine and not flagged. The two
+  deliberate swallows — the router teardown and the profiler-abort
+  guard, where failures have nowhere left to report — carry
+  ``require_hit`` allowlist entries with exactly that reason.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -424,6 +433,80 @@ def signal_handlers(ctx: LintContext) -> Iterable[Finding]:
                         "router teardown (span flush) instead"
                     ),
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                )
+
+
+#: the broad exception names lint.silent-except polices when the handler
+#: body is empty (bare ``except:`` is flagged regardless of body)
+_BROAD_EXCEPTIONS = frozenset({"Exception", "BaseException"})
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the except body does nothing: only ``pass``,
+    ``continue``, or bare constant expressions (``...``, a string)."""
+    return all(
+        isinstance(stmt, (ast.Pass, ast.Continue))
+        or (isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Constant))
+        for stmt in handler.body
+    )
+
+
+@lint_rule("lint.silent-except", scopes=("apex_tpu/",))
+def silent_except(ctx: LintContext) -> Iterable[Finding]:
+    """Bare ``except:`` / do-nothing broad ``except Exception:`` swallows
+    (module docstring). AST-based: the handler TYPE and BODY are what
+    matter, not spelling — ``except Exception as e: pass`` and
+    ``except BaseException: ...`` both count, a handler that logs or
+    returns a fallback does not."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.silent-except",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            if t is None:
+                yield Finding(
+                    rule="lint.silent-except",
+                    message=(
+                        "bare 'except:' catches BaseException — "
+                        "KeyboardInterrupt and SystemExit included; name "
+                        "the exception class (and if the swallow is "
+                        "deliberate, allowlist it with the reason)"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"form": "bare"},
+                )
+                continue
+            # tuple handlers count too: `except (Exception,):` is the
+            # same swallow wearing parentheses
+            exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+            names = {
+                e.id if isinstance(e, ast.Name)
+                else e.attr if isinstance(e, ast.Attribute)
+                else None
+                for e in exprs
+            }
+            if (names & _BROAD_EXCEPTIONS) and _handler_is_silent(node):
+                name = sorted(names & _BROAD_EXCEPTIONS)[0]
+                yield Finding(
+                    rule="lint.silent-except",
+                    message=(
+                        f"'except {name}:' with a do-nothing body "
+                        f"silently swallows EVERY failure — log it, "
+                        f"narrow the exception, or allowlist the site "
+                        f"with the reason the swallow is safe"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"form": "silent"},
                 )
 
 

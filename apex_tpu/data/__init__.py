@@ -14,3 +14,7 @@ from apex_tpu.data.indexed_dataset import (
 )
 
 __all__ += ["IndexedTokenDataset", "LMDataset", "write_token_file"]
+
+from apex_tpu.data.robust import RobustBatches, SkipBudgetExceeded
+
+__all__ += ["RobustBatches", "SkipBudgetExceeded"]

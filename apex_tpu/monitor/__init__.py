@@ -19,9 +19,12 @@ profiling subsystem (PAPERS.md). Four cooperating pieces:
   slope-based timing primitives in utils/benchmarking.py.
 - ``watchdog`` — :class:`StallWatchdog` (heartbeat thread flagging a step
   that exceeds its deadline; complements the SIGTERM-driven resilience
-  path, which only helps when the cluster TELLS us something died) and
-  :class:`ProfilerTrigger` (snapshots a ``jax.profiler`` trace window at a
-  requested step or when the anomaly sentinel escalates).
+  path, which only helps when the cluster TELLS us something died — its
+  ``escalations`` ladder carries the hung-job incident response in
+  ``apex_tpu.resilience.health``: warn -> forensic dump -> coordinated
+  self-termination) and :class:`ProfilerTrigger` (snapshots a
+  ``jax.profiler`` trace window at a requested step or when the anomaly
+  sentinel escalates).
 - ``taps``     — the registered-taps table every ``sow`` name used in
   ``apex_tpu/`` must appear in (lint-tested, so a layer refactor cannot
   silently drop a metric).
@@ -33,9 +36,10 @@ profiling subsystem (PAPERS.md). Four cooperating pieces:
   ``kind="comms"/"memory"/"compile"`` records through the router.
 - ``goodput``  — the RUN-level ledger over everything above: phase spans
   (``kind="span"``: init/compile/data_wait/step/ckpt/rollback/stall/
-  shutdown) + run headers joining restart incarnations, the goodput/
-  badput accountant, the fleet-health divergence detector, and the
-  perf-regression sentinel (``python -m apex_tpu.monitor.goodput``).
+  incident/shutdown) + run headers joining restart incarnations, the
+  goodput/badput accountant, the fleet-health divergence detector (plus
+  its in-job ``LiveFleetMonitor``), and the perf-regression sentinel
+  (``python -m apex_tpu.monitor.goodput``).
 
 See docs/observability.md for the end-to-end wiring.
 

@@ -12,6 +12,9 @@ shared MetricRouter record schema:
   ``productive + Σ badput + unattributed == wall`` is exact.
 - ``fleet``      — straggler hosts (robust z-score on step duration) and
   silent-corruption suspects (cross-host replicated-value mismatch).
+- ``live``       — the same fleet checks run IN the job over a rolling
+  MemorySink window (``LiveFleetMonitor``), emitting ``kind="fleet"``
+  records while running instead of only offline.
 - ``sentinel``   — the perf-regression gate over the BENCH trajectory
   (``python -m apex_tpu.monitor.goodput --check``).
 
@@ -42,6 +45,7 @@ _EXPORTS = {
     # fleet
     "FleetReport": "fleet",
     "detect_divergence": "fleet",
+    "LiveFleetMonitor": "live",
     # sentinel
     "load_bench_history": "sentinel",
     "measurements_from_records": "sentinel",
@@ -50,7 +54,9 @@ _EXPORTS = {
     "goodput_allowlist": "sentinel",
 }
 
-__all__ = sorted(_EXPORTS) + ["spans", "accountant", "fleet", "sentinel"]
+__all__ = sorted(_EXPORTS) + [
+    "spans", "accountant", "fleet", "live", "sentinel",
+]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
 

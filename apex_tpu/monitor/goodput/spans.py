@@ -77,6 +77,9 @@ __all__ = [
 #: - ``ckpt_restore``  — restoring one at startup
 #: - ``rollback``      — in-memory snapshot restore after an anomaly
 #: - ``stall``         — watchdog-detected dead time (no heartbeat)
+#: - ``incident``      — a stall that escalated: the wedged time from the
+#:   last heartbeat to the incident responder's self-termination
+#:   (resilience.health; docs/resilience.md "Incident response")
 #: - ``init``          — everything else before the loop (model build,
 #:   corpus, audits, banners)
 #: - ``shutdown``      — everything after it (final saves, analysis)
@@ -89,6 +92,7 @@ PHASES = (
     "ckpt_restore",
     "rollback",
     "stall",
+    "incident",
     "shutdown",
 )
 
@@ -100,7 +104,13 @@ PRODUCTIVE_PHASE = "step"
 #: badput books (TorchTitan's off-the-critical-path accounting) and a
 #: ckpt_restore nested inside the broad ``init`` span is not counted
 #: twice. Same union-not-sum discipline as the timeline analyzer.
+#:
+#: ``incident`` outranks even ``step``: an incident span exists only when
+#: the escalating watchdog PROVED the time was dead (a wedged step is
+#: indistinguishable from a long one until the deadline blows), so the
+#: still-open pseudo-step span it overlaps must not book as productive.
 PHASE_PRIORITY = (
+    "incident",
     "step",
     "ckpt_save",
     "ckpt_restore",

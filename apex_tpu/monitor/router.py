@@ -14,8 +14,12 @@ producer parsers:
 exact historical shape — the schema was chosen to match them — the
 xray kinds ("comms", "memory", "compile"), "analysis"
 (static-auditor findings from apex_tpu.analysis: rule/site/severity
-plus the allowlist verdict), and the goodput kinds ("run", "span",
-"stall", "goodput", "fleet", "bench" — apex_tpu.monitor.goodput), so
+plus the allowlist verdict), the goodput kinds ("run", "span",
+"stall", "goodput", "fleet", "bench" — apex_tpu.monitor.goodput), and
+the incident-response kinds ("preemption" — the deadline-budgeted
+termination decision, utils/autoresume.py; "incident" — forensic
+bundles and termination marks from apex_tpu.resilience.health;
+"retry" — transient-IO retry stutter, resilience/retry.py), so
 pre-flight audit results and run-lifecycle accounting land in the same
 jsonl a tailer already reads.
 
@@ -132,6 +136,25 @@ class MemorySink(Sink):
             return
         self.records.append(record)
 
+    def snapshot(self) -> List[dict]:
+        """A list copy of the window, safe against concurrent emits.
+
+        ``records`` is a plain deque and the router's daemon-thread
+        producers (the stall watchdog, a background finalize) may append
+        mid-iteration — CPython then raises "deque mutated during
+        iteration". Consumers that read the window from ANOTHER thread
+        (the incident bundle, the live fleet check) use this: retry the
+        copy a few times, and on a pathologically hot stream return the
+        best-effort empty list rather than raise — a reader must never
+        take down the producer it is observing.
+        """
+        for _ in range(8):
+            try:
+                return list(self.records)
+            except RuntimeError:  # concurrent append mid-copy: retry
+                continue
+        return []
+
 
 class JsonlSink(Sink):
     """One json object per line, append mode (the anomaly-log format)."""
@@ -167,8 +190,11 @@ class CsvSink(Sink):
     """
 
     #: record keys a frozen header may lack without dropping the row:
-    #: schema additions that are plumbing, not data (see class docstring)
-    TOLERATED_EXTRA_KEYS = frozenset({"host"})
+    #: schema additions that are plumbing, not data (see class docstring).
+    #: "data_skipped" (the bounded data-pipeline skip counter,
+    #: apex_tpu/data/robust.py) joined the metrics record after CSVs in
+    #: the wild froze their headers, exactly like "host" before it.
+    TOLERATED_EXTRA_KEYS = frozenset({"host", "data_skipped"})
 
     def __init__(self, path: str, kinds=("metrics",)):
         self.path = path
@@ -207,13 +233,15 @@ class StdoutSink(Sink):
     "metrics" records render as ``step  NNNN loss   X.XXXX k v ...`` —
     the exact prefix the example tests (and human eyeballs) key on; other
     kinds render as ``[kind] step N k=v ...``. ``skip_kinds`` defaults to
-    the goodput plumbing kinds ("span", "run"): they fire per loop
-    iteration and exist for the accountant, not the console — the file
-    sinks carry them. The ``host`` field is likewise plumbing and never
-    rendered.
+    the goodput plumbing kinds ("span", "run") — they fire per loop
+    iteration and exist for the accountant, not the console — plus
+    "incident", whose forensic bundle (all-thread stacks, the record-tail
+    window) is far too large for a one-liner; the incident responder logs
+    a compact summary and the file sinks carry the bundle. The ``host``
+    field is likewise plumbing and never rendered.
     """
 
-    def __init__(self, stream=None, skip_kinds=("span", "run")):
+    def __init__(self, stream=None, skip_kinds=("span", "run", "incident")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 
@@ -327,6 +355,20 @@ def _flush_all_routers() -> None:
             router.close()
         except Exception:
             pass
+
+
+def flush_all_routers() -> None:
+    """Run the flush hooks (open goodput spans land ``interrupted=True``)
+    and close every live router — the atexit/SIGTERM teardown, callable
+    on purpose.
+
+    The incident responder (``apex_tpu.resilience.health``) is the
+    deliberate caller: a wedged main thread can never run signal handlers
+    or atexit hooks, so the responder's self-termination must perform the
+    teardown itself — from the watchdog thread — before ``os._exit``.
+    Best-effort and idempotent like the hooks it wraps.
+    """
+    _flush_all_routers()
 
 
 def _install_teardown() -> None:

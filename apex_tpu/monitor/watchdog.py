@@ -5,7 +5,10 @@ before preemption, NaN verdicts from the sentinel. A wedged collective, a
 deadlocked host thread, or a relay hang delivers nothing: the step simply
 never finishes. :class:`StallWatchdog` is the complement — a daemon
 heartbeat thread that flags a step exceeding its deadline from OUTSIDE
-the (possibly stuck) training thread.
+the (possibly stuck) training thread. Its ``escalations`` ladder carries
+the incident-response runtime (``apex_tpu.resilience.health``): warn at
+the deadline, then arbitrary once-per-episode callbacks at higher
+multiples (forensic dump, coordinated self-termination).
 
 :class:`ProfilerTrigger` closes the observability loop: when the sentinel
 escalates (or at a step requested up front with ``--profile-step``), it
@@ -20,7 +23,7 @@ import logging
 import os
 import threading
 import time
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Sequence, Tuple
 
 logger = logging.getLogger("apex_tpu.monitor")
 
@@ -41,6 +44,18 @@ class StallWatchdog:
     warning log. ``on_stall`` (e.g. a :class:`ProfilerTrigger`) composes
     with the router.
 
+    Escalation ladder: ``escalations`` is an ordered sequence of
+    ``(multiplier, callback)`` pairs. When the overdue time exceeds
+    ``multiplier * deadline_s`` the callback fires ONCE per stall
+    episode, in the watchdog thread, with the same ``info`` dict as
+    ``on_stall`` (plus ``beat_mono``, the monotonic timestamp of the
+    last heartbeat, so an escalation can anchor a span at the start of
+    the dead time). A beat re-arms every level. This is the deadline
+    machinery :class:`~apex_tpu.resilience.health.IncidentResponder`
+    builds the warn → dump → terminate ladder on; a callback that raises
+    is logged and does not stop later levels — the dog must outlive its
+    handlers.
+
     Usable as a context manager; ``beat`` and ``stop`` are thread-safe.
     """
 
@@ -50,6 +65,7 @@ class StallWatchdog:
         on_stall: Optional[Callable[[dict], None]] = None,
         poll_s: Optional[float] = None,
         router=None,
+        escalations: Sequence[Tuple[float, Callable[[dict], None]]] = (),
     ):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
@@ -57,11 +73,24 @@ class StallWatchdog:
         self.poll_s = float(poll_s) if poll_s else min(1.0, self.deadline_s / 4)
         self.on_stall = on_stall
         self.router = router
+        # key= so equal multipliers never fall through to comparing the
+        # (unorderable) callbacks; ties keep registration order
+        self.escalations: List[Tuple[float, Callable[[dict], None]]] = sorted(
+            ((float(mult), cb) for mult, cb in escalations),
+            key=lambda pair: pair[0],
+        )
+        for mult, _ in self.escalations:
+            if mult < 1.0:
+                raise ValueError(
+                    f"escalation multipliers are in units of deadline_s and "
+                    f"must be >= 1.0 (the base warn), got {mult}"
+                )
         self.stalls: List[dict] = []
         self._lock = threading.Lock()
         self._last_beat = time.monotonic()
         self._last_step: Optional[int] = None
         self._fired = False
+        self._fired_levels: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -83,48 +112,99 @@ class StallWatchdog:
             if step is not None:
                 self._last_step = int(step)
             self._fired = False
+            self._fired_levels.clear()
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
+            fire: List[Optional[Callable[[dict], None]]] = []
             with self._lock:
                 overdue = time.monotonic() - self._last_beat
                 beat_mono = self._last_beat
-                fired, step = self._fired, self._last_step
-                if overdue > self.deadline_s and not fired:
-                    self._fired = True
-                else:
+                step = self._last_step
+                if overdue <= self.deadline_s:
                     continue
+                if not self._fired:
+                    self._fired = True
+                    fire.append(None)  # the base warn level
+                for i, (mult, cb) in enumerate(self.escalations):
+                    if (overdue > mult * self.deadline_s
+                            and i not in self._fired_levels):
+                        self._fired_levels.add(i)
+                        fire.append(cb)
+            if not fire:
+                continue
             info = {
                 "step": step,
                 "overdue_s": overdue,
                 "deadline_s": self.deadline_s,
+                "beat_mono": beat_mono,
             }
-            self.stalls.append(info)
-            logger.warning(
-                "stall: no step heartbeat for %.1fs (deadline %.1fs, "
-                "last step %s)", overdue, self.deadline_s, step,
-            )
-            if self.router is not None:
-                try:
-                    self.router.event(
-                        "stall", -1 if step is None else step,
-                        overdue_s=overdue, deadline_s=self.deadline_s,
-                    )
-                    # the stall's duration as a goodput span: measured
-                    # FROM the last heartbeat — the dead time started
-                    # when the loop went quiet, not when the dog barked
-                    from apex_tpu.monitor.goodput.spans import emit_span
+            # each poll's newly-due actions run on their OWN daemon
+            # thread, NOT the poll loop: a handler blocked forever — the
+            # classic case being router.event stuck on the router lock
+            # under a hung sink, the very hung-IO fault the ladder
+            # exists to bound — must not stall the loop, or later levels
+            # (the terminate stage's os._exit) would never fire. Within
+            # one poll the actions run sequentially, preserving ladder
+            # order; levels due at different polls get fresh threads.
+            threading.Thread(
+                target=self._fire, args=(fire, info),
+                name="apex-tpu-watchdog-fire", daemon=True,
+            ).start()
 
-                    emit_span(
-                        self.router, "stall", beat_mono, overdue, step=step,
-                    )
-                except Exception as e:  # the dog must outlive its sinks
-                    logger.warning("stall record emit failed: %s", e)
-            if self.on_stall is not None:
+    def _fire(self, fire: List[Optional[Callable[[dict], None]]],
+              info: dict) -> None:
+        for cb in fire:
+            # staleness gate, re-checked immediately before EACH action:
+            # between the poll snapshot and this thread running, the
+            # episode may have ended — a fresh beat (the step completed
+            # after all) or stop() (the loop stood the dog down before a
+            # deliberate blocking save). A stale terminate would
+            # os._exit a job that already recovered, tombstoning the
+            # very save in progress; skipping is always safe because a
+            # still-dead loop re-blows the deadline and re-fires.
+            with self._lock:
+                if (self._stop.is_set()
+                        or self._last_beat != info["beat_mono"]):
+                    return
+            if cb is None:
+                self._warn(dict(info))
+            else:
                 try:
-                    self.on_stall(info)
-                except Exception as e:  # the dog must outlive its handler
-                    logger.warning("on_stall handler failed: %s", e)
+                    cb(dict(info))
+                except Exception as e:  # outlive the escalation too
+                    logger.warning("watchdog escalation failed: %s", e)
+
+    def _warn(self, info: dict) -> None:
+        """The base (1x deadline) level: log + stall record + stall span."""
+        step, overdue = info["step"], info["overdue_s"]
+        self.stalls.append(info)
+        logger.warning(
+            "stall: no step heartbeat for %.1fs (deadline %.1fs, "
+            "last step %s)", overdue, self.deadline_s, step,
+        )
+        if self.router is not None:
+            try:
+                self.router.event(
+                    "stall", -1 if step is None else step,
+                    overdue_s=overdue, deadline_s=self.deadline_s,
+                )
+                # the stall's duration as a goodput span: measured
+                # FROM the last heartbeat — the dead time started
+                # when the loop went quiet, not when the dog barked
+                from apex_tpu.monitor.goodput.spans import emit_span
+
+                emit_span(
+                    self.router, "stall", info["beat_mono"], overdue,
+                    step=step,
+                )
+            except Exception as e:  # the dog must outlive its sinks
+                logger.warning("stall record emit failed: %s", e)
+        if self.on_stall is not None:
+            try:
+                self.on_stall(info)
+            except Exception as e:  # the dog must outlive its handler
+                logger.warning("on_stall handler failed: %s", e)
 
     def stop(self) -> None:
         self._stop.set()

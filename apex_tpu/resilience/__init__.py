@@ -21,7 +21,18 @@ stakes; see PAPERS.md):
   commit marker), verified restore that skips torn/corrupt step dirs,
   ``keep_last_n`` retention, and save-retry-with-backoff.
 - ``chaos``     — deterministic fault injection for tests: NaN losses
-  at chosen steps, checkpoint truncation/bit-flips, simulated SIGTERM.
+  at chosen steps, checkpoint truncation/bit-flips, simulated SIGTERM,
+  host-loop wedges and per-step straggler delays.
+- ``health``    — in-job incident response for WEDGED jobs (the fault
+  that delivers no signal at all): the warn → dump → terminate ladder
+  over the stall watchdog, ``kind="incident"`` forensic bundles
+  (all-thread stacks + record tail + last verdicts), and coordinated
+  self-termination that flushes spans, tombstones the pending save,
+  and exits with a recognizable code the next incarnation recovers
+  from.
+- ``retry``     — the shared bounded-retry policy (jittered exponential
+  backoff, deadline-aware, ``kind="retry"`` records) every transient-IO
+  loop in the package routes through.
 - ``elastic``   — topology-change checkpoint resharding: the manifest
   topology block plus ``restore_resharded`` (load a checkpoint saved on
   mesh A onto any mesh B, ZeRO flat buffers regrouped across a changed
@@ -63,6 +74,8 @@ from apex_tpu.resilience.integrity import (
 )
 from apex_tpu.resilience import chaos
 from apex_tpu.resilience import elastic
+from apex_tpu.resilience import health
+from apex_tpu.resilience import retry
 
 __all__ = [
     "AnomalySentinel",
@@ -88,4 +101,6 @@ __all__ = [
     "write_manifest",
     "chaos",
     "elastic",
+    "health",
+    "retry",
 ]

@@ -15,8 +15,14 @@ on demand, deterministically, on the 8-device CPU test mesh:
   catch.
 - **Preemption** — ``simulate_sigterm`` delivers a real SIGTERM to this
   process, driving the actual AutoResume signal path, not a mock.
+- **Hangs and slow hosts** — ``wedge`` blocks the calling thread forever
+  (a hung collective / stuck host fetch stand-in that delivers NOTHING:
+  no signal, no exception — exactly the failure class only the stall
+  watchdog's escalation ladder can answer), and ``FaultPlan``'s
+  ``slow_steps`` inject a per-step artificial delay (the straggler /
+  thermal-throttle shape the warn level flags without escalating).
 
-``FaultPlan`` schedules all three by global step with consumed-once
+``FaultPlan`` schedules all of these by global step with consumed-once
 semantics: after a rollback re-winds the loop, the REPLAYED step runs
 clean — which is what makes the recovered trajectory comparable to an
 uninjected run in tests (persistent=True disables that for testing the
@@ -24,9 +30,14 @@ halt path).
 """
 
 import dataclasses
+import logging
 import os
 import signal as _signal
+import threading
+import time
 from typing import FrozenSet, Iterable, Optional, Set, Union
+
+logger = logging.getLogger("apex_tpu.resilience")
 
 import jax.numpy as jnp
 
@@ -65,43 +76,88 @@ def parse_steps(spec: Union[str, Iterable[int], None]) -> FrozenSet[int]:
     return frozenset(int(s) for s in spec)
 
 
+def wedge(timeout_s: Optional[float] = None) -> None:
+    """Block the calling thread on an Event nobody sets — the hung-
+    collective / stuck-host-fetch stand-in.
+
+    Unlike a sleep, the block is indefinite by default (a hung job does
+    not time itself out; the escalating watchdog must end it) and unlike
+    raising, it delivers nothing the ``except`` ladder could catch.
+    ``timeout_s`` bounds the wedge for unit tests only.
+    """
+    logger.warning(
+        "chaos: wedging this thread %s",
+        "forever (incident ladder must end the job)"
+        if timeout_s is None else f"for {timeout_s:.3f}s",
+    )
+    threading.Event().wait(timeout_s)
+
+
 @dataclasses.dataclass
 class FaultPlan:
     """Step-keyed fault schedule with consumed-once semantics.
 
     ``nan_steps``: steps whose loss gets poisoned (see ``poison_loss``).
     ``sigterm_steps``: steps after which a real SIGTERM is delivered.
+    ``hang_steps``: steps at which the host loop wedges (see ``wedge``;
+    ``hang_timeout_s`` bounds it for tests — production drills leave it
+    None so only the incident ladder ends the job).
+    ``slow_steps``: steps delayed by ``slow_s`` wall seconds (straggler
+    injection: slow enough to blow a stall deadline, not a hang).
     ``persistent``: re-arm faults on replay (halt-path testing) instead
     of the default fire-once behavior (recovery-path testing).
     """
 
     nan_steps: FrozenSet[int] = frozenset()
     sigterm_steps: FrozenSet[int] = frozenset()
+    hang_steps: FrozenSet[int] = frozenset()
+    slow_steps: FrozenSet[int] = frozenset()
+    slow_s: float = 0.0
+    hang_timeout_s: Optional[float] = None
     persistent: bool = False
 
     def __post_init__(self):
         self.nan_steps = parse_steps(self.nan_steps)
         self.sigterm_steps = parse_steps(self.sigterm_steps)
+        self.hang_steps = parse_steps(self.hang_steps)
+        self.slow_steps = parse_steps(self.slow_steps)
         self._fired_nan: Set[int] = set()
         self._fired_sigterm: Set[int] = set()
+        self._fired_hang: Set[int] = set()
+        self._fired_slow: Set[int] = set()
+
+    def _due(self, step: int, steps: FrozenSet[int], fired: Set[int]) -> bool:
+        if step in steps and (self.persistent or step not in fired):
+            fired.add(step)
+            return True
+        return False
 
     def take_nan(self, step: int) -> float:
         """1.0 if a NaN should poison this step's loss, else 0.0."""
-        step = int(step)
-        if step in self.nan_steps and (
-            self.persistent or step not in self._fired_nan
-        ):
-            self._fired_nan.add(step)
+        if self._due(int(step), self.nan_steps, self._fired_nan):
             return 1.0
         return 0.0
 
     def maybe_sigterm(self, step: int) -> bool:
-        step = int(step)
-        if step in self.sigterm_steps and (
-            self.persistent or step not in self._fired_sigterm
-        ):
-            self._fired_sigterm.add(step)
+        if self._due(int(step), self.sigterm_steps, self._fired_sigterm):
             simulate_sigterm()
+            return True
+        return False
+
+    def maybe_slow(self, step: int) -> bool:
+        """Inject the per-step artificial delay when scheduled."""
+        if self._due(int(step), self.slow_steps, self._fired_slow):
+            logger.warning(
+                "chaos: slowing step %d by %.3fs", int(step), self.slow_s
+            )
+            time.sleep(self.slow_s)
+            return True
+        return False
+
+    def maybe_hang(self, step: int) -> bool:
+        """Wedge the calling (host-loop) thread when scheduled."""
+        if self._due(int(step), self.hang_steps, self._fired_hang):
+            wedge(self.hang_timeout_s)
             return True
         return False
 

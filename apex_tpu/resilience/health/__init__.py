@@ -1,0 +1,40 @@
+"""In-job incident response: hung-job defense (docs/resilience.md
+"Incident response").
+
+The runtime leg the rest of the resilience package assumes: a *sick*
+step has the sentinel, a *killed* job has elastic restart — a *wedged*
+job (hung collective, stuck host fetch, stalled pipeline) delivers no
+signal at all and needs its stall turned into a bounded restart:
+
+- ``incident``  — forensic bundle capture (:func:`capture_incident`,
+  :func:`thread_stacks`): all-thread stacks, the in-process record-tail
+  window, the last sentinel/rollback verdicts, a best-effort profiler
+  arm, emitted as ``kind="incident"`` records.
+- ``responder`` — :class:`IncidentResponder`, the warn → dump →
+  terminate ladder over :class:`~apex_tpu.monitor.StallWatchdog`'s
+  deadline machinery, ending in a coordinated self-termination
+  (interrupted-span flush + pending-checkpoint tombstone +
+  ``os._exit`` with :data:`INCIDENT_EXIT_CODE`) the next incarnation
+  recovers from via the ordinary verified/elastic restore.
+
+jax-free: the package must work precisely when jax is the thing that is
+wedged.
+"""
+
+from apex_tpu.resilience.health.incident import (
+    VERDICT_KINDS,
+    capture_incident,
+    thread_stacks,
+)
+from apex_tpu.resilience.health.responder import (
+    INCIDENT_EXIT_CODE,
+    IncidentResponder,
+)
+
+__all__ = [
+    "VERDICT_KINDS",
+    "capture_incident",
+    "thread_stacks",
+    "INCIDENT_EXIT_CODE",
+    "IncidentResponder",
+]

@@ -1,0 +1,232 @@
+"""Escalating incident response: warn → dump → coordinated self-exit.
+
+The resilience ladder so far answers faults that ANNOUNCE themselves —
+NaN verdicts (sentinel), SIGTERM (AutoResume), torn checkpoints
+(integrity). A wedged job announces nothing: a hung collective, a stuck
+host fetch, or a deadlocked input pipeline just stops beating, and
+goodput burns forever. :class:`IncidentResponder` turns that infinite
+stall into a bounded, forensically-documented restart, built on the
+:class:`~apex_tpu.monitor.StallWatchdog` escalation ladder:
+
+1. **warn** (``deadline_s``) — the watchdog's base level: a
+   ``kind="stall"`` event + ``phase="stall"`` span, exactly as before.
+2. **dump** (``dump_after × deadline_s``) — a forensic incident bundle
+   (:func:`~apex_tpu.resilience.health.capture_incident`): all-thread
+   stacks, the in-process record-window tail, the last
+   sentinel/rollback verdicts, a best-effort profiler arm — emitted as
+   a ``kind="incident"`` record while the evidence still exists.
+3. **terminate** (``terminate_after × deadline_s``, opt-in) —
+   coordinated self-termination. "Coordinated" because a wedged main
+   thread can run neither signal handlers nor atexit hooks, so the
+   responder performs the teardown ITSELF, from the watchdog thread:
+
+   - emit the ``phase="incident"`` span covering the dead time from the
+     last heartbeat (PHASE_PRIORITY puts ``incident`` first, so the
+     still-open pseudo-step span cannot book the wedge as productive);
+   - abandon the un-committed pending async checkpoint through
+     ``AutoResume.prepare_incident_exit()`` — the PR-8 tombstone path —
+     so the next incarnation restores the last VERIFIED step;
+   - run the router teardown (``monitor.router.flush_all_routers``) —
+     the PR-7 interrupted-span flush — so open spans land
+     ``interrupted=True`` and sinks close with the stream intact;
+   - ``os._exit(exit_code)`` with :data:`INCIDENT_EXIT_CODE`, the
+     recognizable "ended by incident response" status a supervisor
+     restarts on.
+
+   The restarted incarnation elastic-restores the last verified step
+   and, anchored on the same ``--save``-derived run id, joins the same
+   goodput ledger — the partition identity holds exactly across both
+   incarnations, with the wedge booked as ``incident`` badput.
+
+Why ``os._exit`` and not SIGTERM-to-self: Python signal handlers only
+run in the main thread between bytecodes; a main thread parked inside a
+blocking C call (the hung collective) never runs another bytecode, so a
+self-signal would either do nothing or kill the process WITHOUT the
+span flush — the one thing this class exists to guarantee happens.
+"""
+
+import logging
+import os
+import threading
+import time
+from typing import List, Optional
+
+from apex_tpu.monitor.goodput.spans import emit_span
+from apex_tpu.monitor.router import flush_all_routers
+from apex_tpu.monitor.watchdog import StallWatchdog
+from apex_tpu.resilience.health.incident import capture_incident
+
+logger = logging.getLogger("apex_tpu.resilience.health")
+
+__all__ = ["INCIDENT_EXIT_CODE", "IncidentResponder"]
+
+#: the self-termination exit status: distinct from success (0), python
+#: tracebacks (1), argparse (2) and signal deaths (128+N), so a
+#: supervisor (and the chaos drill) can tell "ended by incident
+#: response, restart me" from every other ending
+INCIDENT_EXIT_CODE = 43
+
+
+class IncidentResponder:
+    """The warn → dump → terminate ladder over a step deadline
+    (module docstring).
+
+    Drop-in for the bare watchdog in a training loop::
+
+        responder = IncidentResponder(
+            deadline_s, router=router, window=mem_sink, trigger=trigger,
+            autoresume=ar, terminate_after=3.0)
+        responder.start()          # after the first completed step
+        ...
+        responder.beat(step)       # once per completed step
+
+    ``window`` is the in-process MemorySink the forensic bundle quotes;
+    ``trigger`` a ProfilerTrigger to arm best-effort; ``autoresume`` the
+    AutoResume whose pending save is tombstoned before exit.
+    ``terminate_after=None`` (default) stops the ladder at the dump —
+    detection and forensics without the authority to kill, the safe
+    default for a library. ``exit_fn`` is injectable for tests.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float,
+        router=None,
+        window=None,
+        trigger=None,
+        autoresume=None,
+        dump_after: float = 2.0,
+        terminate_after: Optional[float] = None,
+        window_tail: int = 64,
+        poll_s: Optional[float] = None,
+        exit_code: int = INCIDENT_EXIT_CODE,
+        exit_fn=None,
+        teardown_timeout_s: float = 10.0,
+    ):
+        if dump_after < 1.0:
+            raise ValueError(
+                f"dump_after is a multiple of deadline_s and must be >= 1.0 "
+                f"(the warn level), got {dump_after}"
+            )
+        if terminate_after is not None and terminate_after <= dump_after:
+            raise ValueError(
+                f"terminate_after ({terminate_after}) must exceed "
+                f"dump_after ({dump_after}): termination without the "
+                f"forensic dump defeats the ladder"
+            )
+        self.router = router
+        self.window = window
+        self.trigger = trigger
+        self.autoresume = autoresume
+        self.window_tail = int(window_tail)
+        self.exit_code = int(exit_code)
+        self.teardown_timeout_s = float(teardown_timeout_s)
+        self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self.incidents: List[dict] = []
+        escalations = [(float(dump_after), self._dump)]
+        if terminate_after is not None:
+            escalations.append((float(terminate_after), self._terminate))
+        self.watchdog = StallWatchdog(
+            deadline_s, router=router, poll_s=poll_s,
+            escalations=escalations,
+        )
+
+    # -- watchdog surface (delegation) -------------------------------------
+
+    @property
+    def stalls(self) -> List[dict]:
+        return self.watchdog.stalls
+
+    def start(self) -> "IncidentResponder":
+        self.watchdog.start()
+        return self
+
+    def beat(self, step: Optional[int] = None) -> None:
+        self.watchdog.beat(step)
+
+    def stop(self) -> None:
+        self.watchdog.stop()
+
+    def __enter__(self) -> "IncidentResponder":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- the ladder ---------------------------------------------------------
+
+    def _dump(self, info: dict) -> None:
+        bundle = capture_incident(
+            self.router, info.get("step"), stage="dump",
+            overdue_s=info.get("overdue_s"),
+            deadline_s=info.get("deadline_s"),
+            window=self.window, tail=self.window_tail,
+            trigger=self.trigger,
+        )
+        self.incidents.append(bundle)
+
+    def _terminate(self, info: dict) -> None:
+        step = info.get("step")
+        overdue = info.get("overdue_s")
+        logger.error(
+            "incident: no heartbeat for %.1fs (deadline %.1fs, last step "
+            "%s) — self-terminating with exit code %d; restart resumes "
+            "from the last verified checkpoint",
+            overdue if overdue is not None else float("nan"),
+            info.get("deadline_s", float("nan")), step, self.exit_code,
+        )
+        # the teardown runs on a helper thread bounded by
+        # ``teardown_timeout_s``: when the wedge IS the telemetry path
+        # (a sink hung on dead storage, the router lock held by the
+        # blocked main thread), the abandon/span/flush below would block
+        # forever — and then the one guarantee this class makes, a
+        # bounded exit, would be the thing that wedged. Telemetry is
+        # best-effort; the exit is not.
+        done = threading.Event()
+
+        def teardown() -> None:
+            abandoned = None
+            if self.autoresume is not None:
+                try:
+                    abandoned = self.autoresume.prepare_incident_exit()
+                except Exception as e:  # noqa: BLE001 - exit must proceed
+                    logger.warning(
+                        "incident checkpoint abandon failed: %s", e)
+            if self.router is not None:
+                try:
+                    # the dead time as a goodput span, anchored at the
+                    # last heartbeat (the dog's clock and perf_counter
+                    # share CLOCK_MONOTONIC on linux — the stall span's
+                    # precedent)
+                    beat_mono = info.get("beat_mono")
+                    if beat_mono is not None:
+                        emit_span(
+                            self.router, "incident", beat_mono,
+                            time.monotonic() - beat_mono, step=step,
+                        )
+                    self.router.event(
+                        "incident", -1 if step is None else int(step),
+                        stage="terminate", overdue_s=overdue,
+                        deadline_s=info.get("deadline_s"),
+                        exit_code=self.exit_code,
+                        abandoned_step=abandoned,
+                    )
+                except Exception as e:  # noqa: BLE001 - exit must proceed
+                    logger.warning(
+                        "incident termination record failed: %s", e)
+            # the PR-7 teardown, run by hand (module docstring: a wedged
+            # main thread cannot run handlers or atexit): open spans
+            # flush interrupted=True, sinks close, THEN the process ends
+            flush_all_routers()
+            done.set()
+
+        threading.Thread(
+            target=teardown, name="apex-tpu-incident-teardown", daemon=True,
+        ).start()
+        if not done.wait(self.teardown_timeout_s):
+            logger.error(
+                "incident teardown did not finish within %.1fs (the "
+                "telemetry path may be part of the wedge); exiting anyway",
+                self.teardown_timeout_s,
+            )
+        self._exit_fn(self.exit_code)

@@ -45,7 +45,6 @@ import json
 import logging
 import os
 import shutil
-import time
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
@@ -291,22 +290,18 @@ def save_with_retry(
     """Run ``save_fn`` with bounded retries + exponential backoff.
 
     For transient IO errors (NFS hiccup, GCS 5xx surfaced as OSError).
-    The final failure re-raises — checkpoint loss must be loud.
+    The final failure re-raises — checkpoint loss must be loud. Thin
+    wrapper over the shared :func:`resilience.retry.retry_with_backoff`
+    (jitter pinned to 0 so single-writer save schedules stay
+    deterministic; multi-host callers use the shared helper directly
+    with a nonzero jitter).
     """
-    delay = backoff
-    for attempt in range(retries + 1):
-        try:
-            return save_fn()
-        except Exception as e:  # noqa: BLE001 - orbax wraps IO errors variously
-            if attempt >= retries:
-                raise
-            logger.warning(
-                "checkpoint save failed (attempt %d/%d): %s; retrying in %.2fs",
-                attempt + 1, retries + 1, e, delay,
-            )
-            time.sleep(delay)
-            delay *= backoff_factor
-    raise AssertionError("unreachable")
+    from apex_tpu.resilience.retry import retry_with_backoff
+
+    return retry_with_backoff(
+        save_fn, retries=retries, backoff=backoff,
+        backoff_factor=backoff_factor, jitter=0.0, what="checkpoint save",
+    )
 
 
 def save_checkpoint_verified(

@@ -60,11 +60,32 @@ topology block against the live mesh and, on a mismatch, routes through
 new mesh, ZeRO flat optimizer state regrouped across the changed dp
 size, refuse-don't-guess on anything else (docs/resilience.md "Elastic
 restart").
+
+Async VERIFIED checkpointing: for overlapped interval saves the manifest
+work — the per-leaf crc32 fingerprint and the per-file sha256 digests
+(a full re-read of the checkpoint bytes inside ``write_manifest``) —
+runs in ``AsyncCheckpointWriter.finalize_async``'s background thread,
+AFTER the write is durable and BEFORE the commit marker lands. Issuance
+only pays the device->host snapshot (needed anyway: the caller may
+donate the buffers the moment ``step()`` returns) plus the orbax
+hand-off, so the goodput accountant books ``ckpt_save`` badput at
+issuance-only for training-overlapped saves; a crash mid-fingerprint
+leaves a step dir with no manifest, which every verified restore walk
+already skips. Durable saves (termination, first-save calibration) keep
+the blocking finalize — their EMAs must measure a REAL full save.
+
+Incident exit: ``prepare_incident_exit()`` is the bounded hook the
+hung-job responder (``resilience.health``) calls from its watchdog
+thread before self-terminating — it abandons the un-committed pending
+save (tombstone manifest) WITHOUT ever blocking on the possibly-wedged
+writer, so the next incarnation restores the last verified step.
 """
 
+import functools
 import logging
 import os
 import signal as _signal
+import threading
 import time
 from typing import Any, Optional, Sequence, Tuple
 
@@ -125,10 +146,12 @@ class AutoResume:
 
     Durability & integrity (resilience.integrity wiring):
 
-    - interval saves are ASYNC (the next train step overlaps the write);
-      each is finalized — ``wait()`` + checksum-manifest commit + optional
-      ``keep_last_n`` retention — before the next save is issued, or
-      explicitly via :meth:`finalize` / :meth:`close`;
+    - interval saves are ASYNC (the next train step overlaps the write)
+      and VERIFY in the background: the checksum-manifest fingerprint +
+      commit + optional ``keep_last_n`` retention run on the writer's
+      finalize thread once the write is durable, so issuance is the only
+      blocking slice; :meth:`finalize` / :meth:`close` (and the next
+      save) are the join points;
     - a TERMINATION save is finalized before ``step()`` returns True, so
       "saved, you may exit" is never claimed for bytes still in flight —
       unless a configured grace budget (``grace_s`` /
@@ -167,6 +190,7 @@ class AutoResume:
         leaf_fingerprint: bool = True,
         grace_s: Optional[float] = None,
         mesh=None,
+        background_finalize: bool = True,
     ):
         self.directory = os.path.abspath(directory)
         self.interval = interval
@@ -176,12 +200,24 @@ class AutoResume:
         self.save_retries = save_retries
         self.save_backoff = save_backoff
         # per-leaf crc32 fingerprints enable restore-time deep verification
-        # but cost a synchronous full-state device->host copy per save; the
-        # manifest's per-file digests (computed at finalize, off the saved
-        # bytes) still catch disk corruption with this off
+        # but cost a synchronous full-state device->host copy per save —
+        # and for an overlapped async save that snapshot stays ALIVE in
+        # host RAM until the background finalize fingerprints it (one
+        # extra full host copy for the write's duration, on top of the
+        # one orbax's own async snapshot already holds over the same
+        # window). The manifest's per-file digests (computed at finalize,
+        # off the saved bytes) still catch disk corruption with this off
+        # — hosts sized for one state copy should turn it off.
         self.leaf_fingerprint = leaf_fingerprint
         self.grace_s = grace_s if grace_s is not None else _env_grace()
         self.mesh = mesh
+        # async VERIFIED checkpointing (module docstring): overlapped
+        # interval saves verify + commit their manifest on the writer's
+        # background finalize thread. False restores the pre-incident
+        # blocking behavior (manifest committed at the NEXT finalize
+        # point on the training thread) — a debugging/compat knob and the
+        # deterministic mode the deadline-decision tests pin.
+        self.background_finalize = background_finalize
         self._requested = False
         self._saved_for_termination = False
         #: the deadline decision taken on termination ("save" /
@@ -193,11 +229,16 @@ class AutoResume:
         self._writer: Optional[AsyncCheckpointWriter] = None
         # async save whose manifest is not yet committed — finalized
         # before the next save / restore / close, and IMMEDIATELY for a
-        # termination save (durability claim). Keys: step, fingerprint,
-        # topology (both captured at save time: the caller may donate the
-        # buffers the moment step() returns), issue_s (the synchronous
-        # issuance cost, folded into the save EMA at finalize)
+        # termination save (durability claim). Keys: step, host_state
+        # (device->host snapshot taken at issuance: the caller may donate
+        # the buffers the moment step() returns), fingerprint (computed
+        # from it at finalize — background thread for overlapped saves),
+        # topology, issue_s (the synchronous issuance cost, folded into
+        # the save EMA at finalize), fold_full. The abandon paths swap
+        # self._pending to None (a GIL-atomic store) so _commit's
+        # identity check refuses the marker for a disowned save.
         self._pending: Optional[dict] = None
+        self._bg_thread: Optional[threading.Thread] = None
         self._abandoned_step: Optional[int] = None
         # monotonic arrival time of the first termination signal — the
         # grace budget counts down from HERE, not from the poll that
@@ -227,35 +268,131 @@ class AutoResume:
             "finalize_ema_s": self._finalize_ema,
         }}
 
+    def _retry(self, fn, what: str, deadline_s: Optional[float] = None):
+        """Shared bounded-retry policy (resilience/retry.py), imported
+        lazily — the resilience package init must not run during this
+        module's import. Jittered so multi-host retries de-stampede."""
+        from apex_tpu.resilience.retry import retry_with_backoff
+
+        return retry_with_backoff(
+            fn, retries=self.save_retries, backoff=self.save_backoff,
+            jitter=0.25, deadline_s=deadline_s, what=what,
+        )
+
+    def _fingerprint_pending(self, pending: dict) -> None:
+        """Compute the per-leaf crc32 fingerprint from the issuance-time
+        host snapshot (background thread for overlapped saves) and free
+        the snapshot."""
+        if (pending["fingerprint"] is None
+                and pending["host_state"] is not None):
+            pending["fingerprint"] = self._integrity().tree_fingerprint(
+                pending["host_state"]
+            )
+        pending["host_state"] = None
+
+    def _commit(self, pending: dict) -> None:
+        """Land the manifest commit marker + retention for ``pending``.
+
+        Refuses when ``self._pending`` no longer IS ``pending`` — the
+        abandon paths (deadline ``skip`` arm, incident exit) swap it to
+        None, and a commit marker for a save the job disowned is exactly
+        the torn-but-plausible state the tombstone exists to prevent.
+        The residual race (abandon landing between this check and the
+        marker write) resolves to whichever ``os.replace`` runs last;
+        both outcomes are safe — a tombstoned dir restores from the
+        previous verified step, a committed dir is genuinely durable
+        because the write AND fingerprint completed before the marker.
+        """
+        if self._pending is not pending:
+            return
+        if jax.process_index() == 0:
+            integrity = self._integrity()
+            # retried, and _pending is only cleared on success: a
+            # transient manifest-write failure is re-attempted at the
+            # next finalize point instead of silently losing the
+            # commit marker
+            self._retry(
+                lambda: integrity.write_manifest(
+                    os.path.join(self.directory, f"step_{pending['step']}"),
+                    fingerprint=pending["fingerprint"],
+                    topology=pending["topology"],
+                    extra=self._manifest_extra(),
+                ),
+                what="manifest commit",
+            )
+            if self.keep_last_n is not None:
+                integrity.apply_retention(self.directory, self.keep_last_n)
+        if self._pending is pending:
+            self._pending = None
+
+    def _finalize_pending_background(self, pending: dict) -> None:
+        """The background-finalize body: runs on the writer's finalize
+        thread AFTER the write is durable. Fingerprint + commit marker,
+        entirely off the training thread — the ckpt_save badput of an
+        overlapped save collapses to its issuance slice."""
+        self._fingerprint_pending(pending)
+        self._commit(pending)
+
+    def _bg_finalize_failed(self, pending: dict, error: BaseException) -> None:
+        logger.warning(
+            "background finalize of step_%d failed (%s); the manifest "
+            "commit will be re-attempted synchronously at the next "
+            "finalize point", pending["step"], error,
+        )
+
     def finalize(self) -> None:
         """Block until every issued save is durable AND committed.
 
         ``AsyncCheckpointWriter.wait()``-style finalization plus the
         integrity manifest (the commit marker) and retention sweep. A
         save is only as durable as this call — ``step()`` performs it
-        before reporting a termination save, and interval saves are
-        finalized before the next save is issued (one step of overlap).
+        before reporting a termination save, and interval saves commit in
+        the background (module docstring) with this as the join point.
+
+        Emits a blocking ``ckpt_save`` span ONLY when it actually blocks:
+        joining an already-finished background finalize is free, which is
+        what lets the accountant book an overlapped save at
+        issuance-only.
         """
+        thread = self._bg_thread
+        if thread is not None:
+            if thread.is_alive():
+                pend = self._pending
+                t0 = time.monotonic()
+                # goodput span: host wall time BLOCKED on the background
+                # finalize — the piece the async overlap did NOT hide
+                with _goodput_span(
+                        "ckpt_save",
+                        step=pend["step"] if pend else -1):
+                    thread.join()
+                # the blocked-join cost is the real "finalize the pending
+                # save" sample the deadline decision's finalize arm needs
+                self._finalize_ema = _ema(
+                    self._finalize_ema, time.monotonic() - t0)
+            else:
+                thread.join()
+            self._bg_thread = None
         if self._pending is None:
             return
+        # synchronous commit: durable saves, the first-save calibration,
+        # and the fallback when a background finalize failed
         pending = self._pending
         step = pending["step"]
         t0 = time.monotonic()
         # goodput span: host wall time BLOCKED on checkpoint durability
-        # (the wait + manifest commit + retention sweep) — the piece of
-        # ckpt_save badput the async overlap did NOT hide
+        # (the wait + fingerprint + manifest commit + retention sweep)
         with _goodput_span("ckpt_save", step=step):
             self._writer.wait()
+            self._fingerprint_pending(pending)
             # EMAs folded BEFORE the manifest write so THIS save's cost
             # is already in the persisted block (a restarted job inherits
             # it from its very first checkpoint). The manifest write +
-            # retention sweep are excluded from the sample — ms-scale
-            # next to the checkpoint bytes.
+            # retention sweep are excluded from the sample.
             #
             # The FULL-save EMA only folds UNOVERLAPPED samples
             # (fold_full: durable saves and the first-save calibration,
             # where finalize immediately follows issuance). An interval
-            # save finalized many steps later observes wait ~ 0 because
+            # save finalized after overlap observes wait ~ 0 because
             # training HID the write — folding that would converge the
             # EMA to the issuance cost alone, and the deadline decision
             # would pick "save" for grace budgets a fresh (nothing to
@@ -265,25 +402,7 @@ class AutoResume:
             if pending["fold_full"]:
                 self._save_ema = _ema(
                     self._save_ema, pending["issue_s"] + wait_s)
-            if jax.process_index() == 0:
-                integrity = self._integrity()
-                # retried, and _pending is only cleared on success: a
-                # transient manifest-write failure is re-attempted at the
-                # next finalize point instead of silently losing the
-                # commit marker
-                integrity.save_with_retry(
-                    lambda: integrity.write_manifest(
-                        os.path.join(self.directory, f"step_{step}"),
-                        fingerprint=pending["fingerprint"],
-                        topology=pending["topology"],
-                        extra=self._manifest_extra(),
-                    ),
-                    retries=self.save_retries, backoff=self.save_backoff,
-                )
-                if self.keep_last_n is not None:
-                    integrity.apply_retention(self.directory,
-                                              self.keep_last_n)
-        self._pending = None
+            self._commit(pending)
 
     def _topology(self, state) -> Optional[dict]:
         from apex_tpu.resilience.elastic import topology_block
@@ -313,25 +432,24 @@ class AutoResume:
             self._writer = AsyncCheckpointWriter()
         t0 = time.monotonic()
         # goodput span: the synchronous slice of an async save — the
-        # fingerprint's device->host copy and the write ISSUANCE (the
-        # background write itself overlaps training and is accounted by
-        # finalize()'s span when it blocks)
+        # device->host snapshot and the write ISSUANCE. The fingerprint
+        # crc32s and the manifest's per-file sha256 moved OFF this slice
+        # into the background finalize (module docstring); only the
+        # snapshot stays, because the caller may donate/mutate the
+        # buffers the moment step() returns and the bytes must be
+        # captured before that.
         with _goodput_span("ckpt_save", step=step):
-            # fingerprint + topology NOW: the caller may donate/mutate
-            # these buffers the moment step() returns, and the manifest
-            # commits later
-            fingerprint = (
-                integrity.tree_fingerprint(state)
-                if self.leaf_fingerprint else None
+            host_state = (
+                jax.device_get(state) if self.leaf_fingerprint else None
             )
             topology = self._topology(state)
             # the retry covers save ISSUANCE (snapshot-to-host + handoff);
-            # an error in the background write itself surfaces un-retried
-            # at the next finalize()'s wait() — by then the source buffers
-            # may be donated, so there is nothing left to re-save from
-            integrity.save_with_retry(
+            # an error in the background write itself surfaces at the
+            # finalize — by then the source buffers may be donated, so
+            # there is nothing left to re-save from
+            self._retry(
                 lambda: self._writer.save(self.directory, step, state),
-                retries=self.save_retries, backoff=self.save_backoff,
+                what="checkpoint save issuance",
             )
         # first-save calibration: with no full-cost sample yet, finalize
         # immediately so the EMA's seed measures a REAL durable save
@@ -339,20 +457,34 @@ class AutoResume:
         # save, paid when the run is cheapest to pause
         calibrate = self._save_ema is None
         self._pending = {
-            "step": step, "fingerprint": fingerprint, "topology": topology,
+            "step": step, "host_state": host_state, "fingerprint": None,
+            "topology": topology,
             "issue_s": time.monotonic() - t0,
             "fold_full": durable or calibrate,
         }
         if durable or calibrate:
             self.finalize()
+        elif self.background_finalize:
+            pending = self._pending
+            self._bg_thread = self._writer.finalize_async(
+                functools.partial(self._finalize_pending_background,
+                                  pending),
+                on_error=functools.partial(self._bg_finalize_failed,
+                                           pending),
+            )
 
     def _abandon_pending(self) -> None:
         """Drop the pending save WITHOUT committing its manifest.
 
-        The deadline decision's ``skip`` arm: the background write may
-        still land its bytes, but with no manifest the step dir is
-        uncommitted and every verified restore skips it — torn, but
-        cleanly so. The last verified checkpoint stays the durable one.
+        The deadline decision's ``skip`` arm (and the incident exit's
+        only arm): the background write may still land its bytes, but
+        with no manifest the step dir is uncommitted and every verified
+        restore skips it — torn, but cleanly so. The last verified
+        checkpoint stays the durable one. The ``self._pending = None``
+        store is the (GIL-atomic) handshake with the background
+        finalize's ``_commit`` identity check; never blocks on the
+        writer, so it is safe from the watchdog thread against a wedged
+        save.
         """
         if self._pending is None:
             return
@@ -374,6 +506,24 @@ class AutoResume:
                 )
             except OSError as e:
                 logger.warning("abandoned-marker write failed: %s", e)
+
+    def prepare_incident_exit(self) -> Optional[int]:
+        """Bounded preparation for an incident self-termination.
+
+        Called by the hung-job responder (``resilience.health``) from its
+        WATCHDOG thread just before ``os._exit``: abandon the
+        un-committed pending async save — tombstone manifest included —
+        so the next incarnation restores the last VERIFIED step instead
+        of a maybe-torn one. Deliberately never waits on the writer or
+        joins the background finalize (either may be part of the wedge);
+        a save whose background finalize already committed is left
+        durable (nothing pending, nothing to abandon). Returns the
+        abandoned step, or None when nothing was pending.
+        """
+        if self._pending is None:
+            return None
+        self._abandon_pending()
+        return self._abandoned_step
 
     # -- signal plumbing ---------------------------------------------------
 
@@ -401,6 +551,17 @@ class AutoResume:
         if self._sigterm_t is None:
             self._sigterm_t = time.monotonic()
         self._requested = True
+
+    @property
+    def termination_signaled(self) -> bool:
+        """Host-LOCAL signal hint: True once THIS process saw a
+        termination signal or ``request_resume``. No consensus collective
+        (unlike :meth:`termination_requested`), so it is free to poll —
+        callers use it to stand down machinery that must not misread the
+        upcoming blocking termination save as a fault (the GPT example
+        stops its incident responder on it: a minutes-long durable save
+        is not a wedged step)."""
+        return self._requested
 
     # -- consensus ---------------------------------------------------------
 
@@ -569,8 +730,14 @@ class AutoResume:
                 step = latest_step(self.directory)
                 if step is None:
                     return 0, init_state
-                return step, load_checkpoint(
-                    self.directory, step, target=init_state
+                # retried: a transient IO hiccup on the restore read must
+                # not crash the restart (the verified path gets its
+                # resilience from the newest-first fallback walk instead)
+                return step, self._retry(
+                    lambda: load_checkpoint(
+                        self.directory, step, target=init_state
+                    ),
+                    what="checkpoint restore",
                 )
             from apex_tpu.resilience import elastic
 

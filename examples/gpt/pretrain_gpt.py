@@ -12,7 +12,9 @@ grad norm, loss scale, sentinel z-score and skip counts into an on-device
 (incl. tokens/s and analytic MFU) fan out to stdout and, with
 ``--metrics-jsonl``/``--metrics-csv``/``--tensorboard-dir``, to file
 sinks — the anomaly stream below shares the same record schema. A stall
-watchdog (``--step-deadline``) flags wedged steps and
+watchdog (``--step-deadline``) arms the incident ladder over wedged
+steps (warn -> forensic ``kind="incident"`` dump -> opt-in coordinated
+self-termination, ``apex_tpu.resilience.health``) and
 ``--profile-step`` / sentinel escalation snapshot a profiler trace
 window under ``--profile-dir``.
 
@@ -71,6 +73,15 @@ def parse_args():
     p.add_argument("--save-interval", type=int, default=100)
     p.add_argument("--keep-last-n", type=int, default=None,
                    help="checkpoint retention: keep only the newest N steps")
+    p.add_argument("--background-finalize",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="verify + commit async interval saves on the "
+                        "writer's background thread (ckpt_save badput "
+                        "collapses to issuance-only); "
+                        "--no-background-finalize restores the blocking "
+                        "commit-at-next-save behavior — deterministic for "
+                        "preemption drills whose assertions need the "
+                        "pending save provably un-committed")
     p.add_argument("--grace-s", type=float, default=None,
                    help="preemption grace budget in seconds (default: "
                         "$APEX_TPU_PREEMPTION_GRACE_S); the SIGTERM save "
@@ -127,7 +138,31 @@ def parse_args():
                         "when no capture was otherwise requested")
     p.add_argument("--step-deadline", type=float, default=None,
                    help="stall watchdog: flag a step exceeding this many "
-                        "seconds (default: off)")
+                        "seconds (default: off). Arms the incident ladder "
+                        "(apex_tpu.resilience.health): warn at the "
+                        "deadline, forensic kind='incident' dump at "
+                        "--stall-dump-after x deadline, and — only with "
+                        "--stall-terminate-after set — coordinated "
+                        "self-termination")
+    p.add_argument("--stall-dump-after", type=float, default=2.0,
+                   help="incident ladder: capture the forensic bundle at "
+                        "this multiple of --step-deadline")
+    p.add_argument("--stall-terminate-after", type=float, default=None,
+                   help="incident ladder: self-terminate (exit code 43, "
+                        "spans flushed, pending save tombstoned) at this "
+                        "multiple of --step-deadline; a rerun with the "
+                        "same --save resumes from the last verified step "
+                        "(default: off — warn and dump only)")
+    p.add_argument("--data-skip-budget", type=int, default=16,
+                   help="batches whose host-side load may fail (skipped "
+                        "and logged, surfaced as data_skipped in metrics "
+                        "records) before the run fails loudly")
+    p.add_argument("--fleet-interval", type=int, default=None,
+                   help="run the live fleet-health check (straggler "
+                        "robust-z + cross-host replicated-value "
+                        "divergence) every N steps over the in-process "
+                        "record window, emitting kind='fleet' records "
+                        "(default: off)")
     # X-ray (apex_tpu.monitor.xray; docs/observability.md): static +
     # runtime introspection of the compiled step itself
     p.add_argument("--xray-report", action="store_true",
@@ -153,6 +188,16 @@ def parse_args():
                    help="comma/range list of steps whose loss is NaN-poisoned")
     p.add_argument("--chaos-sigterm-step", type=int, default=None,
                    help="deliver a real SIGTERM after this step")
+    p.add_argument("--chaos-hang-step", type=int, default=None,
+                   help="wedge the host loop mid-step at this step (a "
+                        "hung-collective stand-in that never returns; "
+                        "only the --step-deadline incident ladder can "
+                        "end the job)")
+    p.add_argument("--chaos-slow-steps", default="",
+                   help="comma/range list of steps delayed by "
+                        "--chaos-slow-s (straggler injection)")
+    p.add_argument("--chaos-slow-s", type=float, default=1.0,
+                   help="artificial delay per --chaos-slow-steps step")
     p.add_argument("--chaos-corrupt-latest", default="none",
                    choices=["none", "bitflip", "truncate"],
                    help="corrupt the newest checkpoint BEFORE restoring")
@@ -174,7 +219,10 @@ def synthetic_corpus(vocab: int, n_tokens: int = 200_000):
 def main():
     args = parse_args()
     from apex_tpu.amp import GradScaler
-    from apex_tpu.data import IndexedTokenDataset, LMDataset, MegatronPretrainingSampler
+    from apex_tpu.data import (
+        IndexedTokenDataset, LMDataset, MegatronPretrainingSampler,
+        RobustBatches,
+    )
     from apex_tpu.models import GPTModel, gpt_loss_fn
     from apex_tpu.optimizers import fused_adam
     from apex_tpu.parallel import parallel_state
@@ -209,7 +257,16 @@ def main():
     # accounts THIS run without re-reading (or requiring) a jsonl file;
     # kinds-filtered so metrics/timer traffic doesn't evict the spans
     goodput_mem = monitor.MemorySink(kinds=("run", "span"))
-    router = monitor.MetricRouter(sinks + [goodput_mem])
+    # unfiltered short window for the incident ladder's forensic bundle:
+    # the record tail a kind="incident" dump quotes (what the run looked
+    # like as it wedged — metrics, spans, anomalies alike). Only wired
+    # when the ladder exists to read it; nobody else consumes it.
+    incident_mem = (monitor.MemorySink(max_records=512)
+                    if args.step_deadline else None)
+    router = monitor.MetricRouter(
+        sinks + [goodput_mem]
+        + ([incident_mem] if incident_mem is not None else [])
+    )
 
     # run-level goodput ledger (apex_tpu.monitor.goodput,
     # docs/observability.md "Goodput & fleet health"): this incarnation
@@ -453,15 +510,8 @@ def main():
         args.profile_step = 1
     if args.profile_step is not None:
         trigger.request(step=args.profile_step)
-    # created here, STARTED after the first completed step: the deadline
-    # is a steady-state bound, and arming it across restore + trace +
-    # first-step compile would flag every healthy run as stalled
-    watchdog = None
-    if args.step_deadline:
-        # router-backed: each stall lands as a kind="stall" event PLUS a
-        # phase="stall" span (from the last heartbeat), so detected dead
-        # time shows up in the goodput ledger as badput
-        watchdog = monitor.StallWatchdog(args.step_deadline, router=router)
+    # the incident responder (--step-deadline) is created AFTER AutoResume
+    # below: its terminate stage tombstones ar's pending save
 
     # chaos drill: corrupt the newest checkpoint BEFORE restore — the
     # verified restore must fall back to the previous intact step
@@ -483,7 +533,8 @@ def main():
     ar = (
         AutoResume(args.save, interval=args.save_interval,
                    keep_last_n=args.keep_last_n, mesh=mesh,
-                   grace_s=args.grace_s)
+                   grace_s=args.grace_s,
+                   background_finalize=args.background_finalize)
         if args.save else None
     )
     step0 = 0
@@ -502,6 +553,39 @@ def main():
                   f"({e}); starting fresh")
         if step0:
             print(f"resumed from step {step0}")
+
+    # hung-job defense (apex_tpu.resilience.health, docs/resilience.md
+    # "Incident response"): warn -> forensic kind="incident" dump ->
+    # (opt-in) coordinated self-termination. Created here, STARTED after
+    # the first completed step: the deadline is a steady-state bound, and
+    # arming it across restore + trace + first-step compile would flag
+    # every healthy run as stalled. The warn level is the PR-2 stall
+    # record + span; the terminate level flushes interrupted spans,
+    # tombstones ar's pending save, and exits 43 so a rerun with the
+    # same --save elastic-restores the last VERIFIED step under the same
+    # run id.
+    responder = None
+    if args.step_deadline:
+        responder = resilience.health.IncidentResponder(
+            args.step_deadline, router=router, window=incident_mem,
+            trigger=trigger, autoresume=ar,
+            dump_after=args.stall_dump_after,
+            terminate_after=args.stall_terminate_after,
+        )
+
+    # live fleet health (--fleet-interval): the offline straggler /
+    # replicated-value divergence math run in-job over a rolling window
+    # (kind="fleet" records; single-host runs emit summaries only —
+    # the verdicts need >= 2 hosts to be sound)
+    fleet_mon = None
+    if args.fleet_interval:
+        fleet_win = monitor.MemorySink(
+            max_records=4096, kinds=("span", "metrics")
+        )
+        router.add_sink(fleet_win)
+        fleet_mon = goodput.LiveFleetMonitor(
+            router, fleet_win, interval_steps=args.fleet_interval
+        )
 
     # X-ray startup banners (apex_tpu.monitor.xray, docs/observability.md):
     # what the compiled step IS — collective traffic and HBM footprint —
@@ -631,6 +715,12 @@ def main():
             {args.chaos_sigterm_step}
             if args.chaos_sigterm_step is not None else frozenset()
         ),
+        hang_steps=(
+            {args.chaos_hang_step}
+            if args.chaos_hang_step is not None else frozenset()
+        ),
+        slow_steps=args.chaos_slow_steps,
+        slow_s=args.chaos_slow_s,
     )
 
     # the sampler's own resume mechanism picks the data stream up exactly
@@ -646,6 +736,13 @@ def main():
 
     timers = Timers(write_fn=router.timer_write_fn)
     it = make_iter(step0)
+    # bounded skip-and-log around the host-side load (apex_tpu.data.
+    # robust): a flaky batch is skipped and counted (data_skipped in the
+    # metrics records); blowing --data-skip-budget raises — silent
+    # infinite skipping is the failure mode, not the fix. Reads `it`
+    # late-bound so the rollback path's iterator rewind stays effective.
+    batches = RobustBatches(lambda: lm.batch(next(it)),
+                            max_skips=args.data_skip_budget)
     # seed the ring so an anomaly before the first cadence point can still
     # roll back instead of escalating straight to halt
     mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
@@ -656,10 +753,10 @@ def main():
     last_emit_t = time.perf_counter()
     step_i = step0
     while step_i < args.steps:
-        # host blocked on the input pipeline = data_wait badput
+        # host blocked on the input pipeline = data_wait badput; the
+        # robust loader skips-and-counts flaky loads inside the span
         with goodput.span("data_wait", step=step_i):
-            idx = next(it)
-            x, y = lm.batch(idx)
+            x, y = batches()
             x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
             y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
         trigger.maybe_start(step_i)
@@ -684,18 +781,35 @@ def main():
                 # the loss/verdict fetch below is the step's host sync
                 # point, so the profiler window closes on completed work
                 timers("step").stop(barrier_on=loss)
+            if responder is not None and steps_run == 0:
+                # compile is behind us; deadline arms now — and BEFORE
+                # the first chaos-injection opportunity below, so a
+                # wedge at the very first executed step is still
+                # answered by the ladder instead of hanging unwatched
+                responder.start()
+            # chaos: straggler delay / host-loop wedge, injected INSIDE
+            # the step span so (a) the slow step inflates exactly the
+            # span the stall warn flags and (b) a wedge leaves the span
+            # OPEN — the incident terminate's teardown flushes it
+            # interrupted=True, and the phase="incident" span (which
+            # outranks "step") claims the dead time
+            plan.maybe_slow(step_i)
+            plan.maybe_hang(step_i)
         steps_run += 1
         steps_since_emit += 1
-        if watchdog is not None:
-            if steps_run == 1:
-                watchdog.start()  # compile is behind us; deadline arms now
-            watchdog.beat(step_i)
+        if responder is not None:
+            responder.beat(step_i)
         verdict_code = int(verdict)  # ONE fetch; reused below (relay RTT)
         trigger.on_verdict(step_i, verdict_code)
         trigger.maybe_stop(step_i)
         state = (params, opt_state, scaler_state, sent_state)
         action = mgr.resolve(step_i, verdict_code, loss=float(loss))
         if action == "halt":
+            if responder is not None:
+                # the final durable save below is not a step: a long
+                # checkpoint must not be escalated as a wedge (and the
+                # terminate level must never tombstone it)
+                responder.stop()
             # save the newest KNOWN-GOOD state, not the possibly-corrupt
             # live one, then stop: the anomaly outlived every budget
             good_step, good_state = (
@@ -748,6 +862,9 @@ def main():
                     peak_flops=peak_flops,
                 ),
                 step_ms=1000.0 * sec_per_step,
+                # MetricBag-adjacent HOST metric: batches lost to the
+                # bounded skip-and-log loader this run (data/robust.py)
+                data_skipped=batches.skipped,
             )
             # interval-mean step timer as a kind='timer' record; reset=True
             # (the write-parity fix) so each write covers ITS interval only
@@ -761,7 +878,19 @@ def main():
             bag = jax.device_put(monitor.reset_bag(bag), replicated)
             steps_since_emit = 0
             last_emit_t = time.perf_counter()
+        if fleet_mon is not None:
+            fleet_mon.maybe_check(step_i)
         plan.maybe_sigterm(step_i)
+        if (responder is not None and ar is not None
+                and ar.termination_signaled):
+            # stand the dog down BEFORE ar.step's blocking termination
+            # save: a minutes-long durable save is not a wedged step,
+            # and the terminate level must not tombstone the very
+            # checkpoint the grace-budget decision chose to write.
+            # (Host-local hint only — on a multi-host mesh a host whose
+            # signal has not arrived yet keeps its dog armed through the
+            # consensus; deadline >> save time remains the safe config.)
+            responder.stop()
         if ar is not None and ar.step(step_i + 1, state):
             if ar.termination_decision == "save":
                 print(f"termination checkpoint at step {step_i + 1}; exiting")
@@ -791,8 +920,8 @@ def main():
         rollbacks=mgr.rollbacks_used, lr_scale=mgr.lr_scale,
         profiles=len(trigger.captures),
     )
-    if watchdog is not None:
-        watchdog.stop()
+    if responder is not None:
+        responder.stop()
     trigger.close()  # abort any capture still open (end of run)
     if args.profile_analyze:
         # device-time timeline of the capture(s) just taken

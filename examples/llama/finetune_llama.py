@@ -56,6 +56,15 @@ def parse_args():
     p.add_argument("--metrics-jsonl", default=None,
                    help="write run/span/goodput (and any other) records "
                         "to this jsonl (apex_tpu.monitor schema)")
+    p.add_argument("--run-deadline", type=float, default=None,
+                   help="incident ladder over the compiled scan "
+                        "(apex_tpu.resilience.health): the whole run is "
+                        "ONE scan call, so the deadline bounds it as a "
+                        "unit — no heartbeat within this many seconds "
+                        "means warn, forensic kind='incident' dump at "
+                        "2x, coordinated self-termination (exit 43) at "
+                        "3x; a rerun with the same --save resumes from "
+                        "the last verified step (default: off)")
     p.add_argument("--save", default=None,
                    help="checkpoint directory: resume from it at startup "
                         "and save the trained params + ZeRO opt state at "
@@ -278,6 +287,21 @@ def main():
                 variables, opt_state, tokens, labels
             ).compile()
     init_span.close()
+    # hung-job defense over the scan (docs/resilience.md "Incident
+    # response"): the run is ONE compiled call, so the responder guards
+    # it as a unit — started after the compile (paid above), stopped on
+    # the far side. A wedged collective inside the scan beats nothing;
+    # the ladder dumps all-thread stacks (the scan's execute frame
+    # included) and self-terminates with the spans flushed, and the
+    # restart restores the last verified --save step.
+    responder = None
+    if args.run_deadline:
+        from apex_tpu.resilience.health import IncidentResponder
+
+        responder = IncidentResponder(
+            args.run_deadline, router=router, autoresume=ar,
+            dump_after=2.0, terminate_after=3.0,
+        ).start()
     t0 = time.perf_counter()
     # one span for the whole scan (the step_annotation convention for
     # scanned runs, utils/timers.py): all args.steps steps are inside it,
@@ -288,6 +312,9 @@ def main():
             variables, opt_state, tokens, labels
         )
         losses = np.asarray(losses)
+    if responder is not None:
+        responder.beat(args.steps)  # the scan landed: stand the dog down
+        responder.stop()
     dt = time.perf_counter() - t0
     for i in range(0, args.steps, max(1, args.steps // 5)):
         print(f"step {i:4d} loss {losses[i]:9.4f}")

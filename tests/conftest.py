@@ -51,6 +51,9 @@ _SLOW_TESTS = {
     "test_elastic_selftest_gate",
     "test_gpt_elastic_chaos_drill",
     "test_gpt_preemption_skip_budget",
+    "test_gpt_hang_incident_drill",
+    "test_gpt_slow_host_stall_drill",
+    "test_crash_mid_fingerprint_leaves_unverified_dir",
     # subprocess pins: each child pays a fresh jax import (~10 s)
     "test_sigterm_mid_finalize_still_commits",
     "test_kill_mid_async_save_leaves_clean_torn_dir",

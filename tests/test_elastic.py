@@ -387,7 +387,13 @@ class TestDeadlineBehavior:
     def test_skip_abandons_pending_and_restores_last_verified(
             self, tmp_path, router):
         d = str(tmp_path)
-        ar = AutoResume(d, interval=2, install_handlers=False)
+        # background_finalize=False: the drill needs step 4's manifest
+        # commit DETERMINISTICALLY un-landed when the SIGTERM decision
+        # runs; with the default background verify a tiny state's commit
+        # wins the race and there is nothing left to abandon (that
+        # healthy outcome has its own pin in test_health.py)
+        ar = AutoResume(d, interval=2, install_handlers=False,
+                        background_finalize=False)
         s2, s4, s5 = (_state(_mesh(8), 8, seed=i) for i in (2, 4, 5))
         assert not ar.step(2, s2)        # interval save of step 2 (pending)
         assert not ar.step(3, s2)        # no-op step
@@ -424,7 +430,11 @@ class TestDeadlineBehavior:
 
     def test_finalize_commits_pending_only(self, tmp_path, router):
         d = str(tmp_path)
-        ar = AutoResume(d, interval=2, install_handlers=False)
+        # background_finalize=False for the same determinism reason as
+        # the skip drill above: the "finalize" arm needs a genuinely
+        # pending step-4 commit at decision time
+        ar = AutoResume(d, interval=2, install_handlers=False,
+                        background_finalize=False)
         s2, s4, s5 = (_state(_mesh(8), 8, seed=i) for i in (2, 4, 5))
         assert not ar.step(2, s2)        # first save: calibration commit
         assert not ar.step(3, s2)
@@ -581,10 +591,16 @@ def test_gpt_preemption_skip_budget(tmp_path):
     pending one); the restart restores the last VERIFIED step."""
     save = tmp_path / "ck"
     jsonl = tmp_path / "m.jsonl"
+    # --no-background-finalize: the drill's assertions need step 4's
+    # manifest commit DETERMINISTICALLY pending when the SIGTERM skip
+    # decision runs; with the default background verify a tiny state's
+    # commit can win the race and leave nothing to abandon (the healthy
+    # outcome — pinned separately in test_health.py)
     out = _run_gpt(
         _DRILL_BASE + ["--steps", "8", "--save", str(save),
                        "--save-interval", "2",
                        "--chaos-sigterm-step", "5",
+                       "--no-background-finalize",
                        "--metrics-jsonl", str(jsonl)],
         devices=8,
         extra_env={"APEX_TPU_PREEMPTION_GRACE_S": "0.000001"})

@@ -68,6 +68,7 @@ def test_gpt_pretrain_example(tmp_path):
                ["--steps", "3", "--layers", "2", "--hidden", "64",
                 "--heads", "4", "--seq-len", "32", "--micro-batch", "1",
                 "--global-batch", "16", "--log-interval", "2",
+                "--fleet-interval", "1",
                 "--metrics-jsonl", str(jsonl)],
                extra_env={"APEX_TPU_PEAK_FLOPS": "1e12"})
     assert "step " in out
@@ -77,6 +78,14 @@ def test_gpt_pretrain_example(tmp_path):
     for rec in metrics:
         for key in ("loss", "grad_norm", "loss_scale", "tokens_per_s", "mfu"):
             assert isinstance(rec[key], float), (key, rec)
+        # the bounded skip-and-log loader's host counter rides along
+        assert rec["data_skipped"] == 0
+    # live fleet health (--fleet-interval): the in-job check emits its
+    # summary records into the same stream; a single-host run can never
+    # flag (the verdicts need >= 2 hosts), so summaries are ALL of them
+    fleet = [r for r in records if r["kind"] == "fleet"]
+    assert fleet and all(r["check"] == "summary" for r in fleet)
+    assert all(r["ok"] and r["n_hosts"] <= 1 for r in fleet)
     assert any(r["kind"] == "timer" for r in records)
     assert any(r["kind"] == "summary" for r in records)
     # run-level goodput ledger (PR 7): every record carries the host
@@ -262,10 +271,14 @@ def test_llama_finetune_example(tmp_path):
     import json
 
     jsonl = tmp_path / "metrics.jsonl"
+    # --run-deadline: the incident ladder guards the compiled scan as one
+    # unit (apex_tpu.resilience.health); generous here, so this pins the
+    # wiring (start -> scan -> beat -> stop) without ever escalating
     out = _run("examples/llama/finetune_llama.py",
                ["--steps", "20", "--audit-donation", "--audit-comms",
                 "--profile-analyze", "--profile-steps", "2",
                 "--profile-dir", str(tmp_path / "prof"),
+                "--run-deadline", "300",
                 "--metrics-jsonl", str(jsonl)])
     assert "donation audit: ok" in out
     assert "comms audit: ok" in out
