@@ -69,6 +69,7 @@ _EXPORTS = {
     "repo_allowlist": "allowlist",
     "dp2tp2_mesh": "targets",
     "gpt_step_target": "targets",
+    "gpt_compressed_step_target": "targets",
     "bert_step_target": "targets",
     "all_targets": "targets",
 }

@@ -64,7 +64,9 @@ def main(argv=None) -> int:
                         help="skip the jaxpr passes over the step targets")
     parser.add_argument("--skip-timeline", action="store_true",
                         help="skip the profiler trace-schema smoke check")
-    parser.add_argument("--target", choices=("gpt", "bert"), default=None,
+    parser.add_argument("--target",
+                        choices=("gpt", "gpt-compressed", "bert"),
+                        default=None,
                         help="audit only one step builder")
     args = parser.parse_args(argv)
 
@@ -83,6 +85,10 @@ def main(argv=None) -> int:
         mesh = targets_mod.dp2tp2_mesh()
         builders = {
             "gpt": targets_mod.gpt_step_target,
+            # the int8 quantized dp allreduce variant: the differ must
+            # CONFIRM the compressed pattern (comms.quantized), not
+            # allowlist it away
+            "gpt-compressed": targets_mod.gpt_compressed_step_target,
             "bert": targets_mod.bert_step_target,
         }
         names = [args.target] if args.target else list(builders)

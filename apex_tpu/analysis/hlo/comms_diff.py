@@ -29,6 +29,13 @@ sides:
 - ``comms.unverifiable``(info) — the HLO could not be parsed or no mesh
   is available for attribution; callers promising verification (the
   examples' ``--audit-comms``) must treat this as NOT ok.
+- ``comms.quantized``   (info) — positive confirmation that 8-bit-payload
+  collectives (the ``parallel/compress.py`` quantized decomposition)
+  matched ledger predictions: the int8 pattern was VERIFIED as emitted,
+  not allowlisted away. XLA legalizes a split-dim ``all_to_all`` into
+  tuple form (one operand per participant); same-shaped operands of one
+  all-to-all instruction are folded back into the single logical payload
+  the ledger predicted before matching.
 
 Matching currency is (op-class, mesh axis, OPERAND element count) —
 elements, not bytes, because backends legalize dtypes without changing
@@ -133,6 +140,29 @@ def _emitted_units(module: hlo_parser.HloModule, mesh) -> List[_Unit]:
         if axis == attribution.AXIS_NONE:
             continue  # singleton groups / empty perm: zero bytes, the
             # ledger elides these too
+        if c.kind == "all-to-all" and len(c.operands) > 1:
+            # XLA legalizes a split-dim all_to_all into TUPLE form: one
+            # operand per participant, together ONE logical payload (the
+            # quantized-collective decomposition in parallel/compress.py
+            # traces one (n, chunk) payload and lands here as n (1, chunk)
+            # operands). Fold operands of identical shape back into one
+            # unit whose leading dim is the operand count, so the bucket
+            # keyed on the ledger's full-payload element count matches;
+            # distinct shapes (a combiner merging unrelated all-to-alls)
+            # stay separate logical payloads.
+            by_shape: Dict[Tuple[str, Tuple[int, ...]], List] = {}
+            for op in c.operands:
+                by_shape.setdefault(
+                    (op.shape.dtype, op.shape.dims), []
+                ).append(op)
+            for (dtype, dims), ops in sorted(by_shape.items()):
+                units.append(_Unit(
+                    kind=c.kind, axis=axis,
+                    elements=sum(op.elements for op in ops),
+                    nbytes=sum(op.nbytes for op in ops),
+                    dtype=dtype, dims=(len(ops),) + tuple(dims), instr=c,
+                ))
+            continue
         for op in c.operands:
             units.append(_Unit(
                 kind=c.kind, axis=axis, elements=op.elements,
@@ -234,6 +264,7 @@ def audit_comms(
     # stage 1 — exact bucket matches; ledger-sited instructions consume
     # predictions first so any excess is reported at the site that is
     # NOT the wrapper (the transpose/reshard site a human must look at)
+    matched: List[_Unit] = []
     leftovers: List[_Unit] = []
     for u in sorted(
         units,
@@ -242,6 +273,7 @@ def audit_comms(
         if remaining.get(u.key, 0) > 0:
             remaining[u.key] -= 1
             consumed_any[u.key] = True
+            matched.append(u)
         else:
             leftovers.append(u)
 
@@ -270,6 +302,7 @@ def audit_comms(
             key = (u.kind, u.axis, e)
             remaining[key] -= k
             consumed_any[key] = True
+            matched.append(u)
         else:
             unmatched.append(u)
 
@@ -353,6 +386,30 @@ def audit_comms(
                 site=site0, severity=SEV_WARNING, target=target, count=n,
                 data={"op": cls, "axis": axis, "elements": elements},
             ))
+
+    # stage 5 — POSITIVE confirmation of the quantized-collective pattern
+    # (parallel/compress.py): 8-bit-payload collectives that matched a
+    # ledger prediction are reported per axis, so "the int8 pattern was
+    # verified as emitted" is a record in the stream rather than the
+    # absence of an error. Info severity: confirmation, not a defect.
+    quantized: Dict[str, Dict[str, int]] = {}
+    for u in matched:
+        if not u.dtype.startswith(("s8", "u8", "f8")):
+            continue
+        d = quantized.setdefault(u.axis, {"ops": 0, "bytes": 0})
+        d["ops"] += 1
+        d["bytes"] += u.nbytes
+    for axis, d in sorted(quantized.items()):
+        findings.append(Finding(
+            rule="comms.quantized",
+            message=(
+                f"quantized collective pattern verified over {axis!r}: "
+                f"{d['ops']} 8-bit-payload op(s), {d['bytes']} wire "
+                f"payload bytes, all matched to ledger predictions"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+            data={"axis": axis, "ops": d["ops"], "bytes": d["bytes"]},
+        ))
     return findings
 
 

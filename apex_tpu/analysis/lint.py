@@ -35,6 +35,14 @@ Plus the new rules this framework exists to host:
   f64 at a fraction of rate, and a single f64 literal poisons every
   dtype downstream of it. (Host-side ``np.float64`` index math is fine
   and not flagged.)
+- ``lint.compressed-collective`` — no quantize/dequant + collective
+  composition outside ``parallel/compress.py`` (the ledger-accounting
+  home rule, same shape as ``lint.raw-collective``): a function that
+  both calls a quantize/dequantize primitive AND a ledgered collective
+  is building its own compressed collective, whose wire bytes/error-
+  feedback/found_inf semantics then drift from the audited home.
+  CALLING the blessed wrappers (``quantized_psum`` & co.) is fine and
+  not flagged — only the composition of the primitives is.
 - ``lint.hlo-text``   — no ``.as_text()`` scraping outside
   ``analysis/hlo/parser.py``: the brace-aware parser is the single home
   of HLO/MLIR text parsing (its ``module_text`` helper is the one
@@ -600,6 +608,74 @@ def span_phases(ctx: LintContext) -> Iterable[Finding]:
                         site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                         data={"callee": name, "phase": s},
                     )
+
+
+#: quantize/dequantize primitive call names the compressed-collective
+#: rule keys on: the compress module's own primitives plus any same-
+#: prefixed ad-hoc reimplementation. The PUBLIC wrappers
+#: (quantized_psum / quantized_psum_scatter / quantized_all_gather) are
+#: deliberately NOT in this set — call sites composing with them are the
+#: intended use, not a new compression home.
+_QUANT_PRIMITIVE_PREFIXES = ("quantize_", "dequantize_")
+
+
+@lint_rule("lint.compressed-collective", scopes=("apex_tpu/",))
+def compressed_collective(ctx: LintContext) -> Iterable[Finding]:
+    """Functions composing quantize/dequant primitives with ledgered
+    collectives outside parallel/compress.py (module docstring).
+
+    AST-based, function granularity: for every FunctionDef, collect the
+    terminal names of all calls; a function calling BOTH a
+    ``quantize_*``/``dequantize_*`` primitive and a collective from
+    ``LEDGERED_OPS`` is a compressed-collective composition and belongs
+    in the audited home (compress.py carries the require_hit allowlist
+    entry)."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.compressed-collective",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            quant = None
+            coll = None
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                func = call.func
+                name = (
+                    func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None
+                )
+                if name is None:
+                    continue
+                if name.startswith(_QUANT_PRIMITIVE_PREFIXES):
+                    quant = quant or name
+                elif name in LEDGERED_OPS:
+                    coll = coll or name
+            if quant and coll:
+                yield Finding(
+                    rule="lint.compressed-collective",
+                    message=(
+                        f"{quant} composed with {coll} outside "
+                        f"parallel/compress.py — quantized collectives "
+                        f"have ONE audited home (wire-byte accounting, "
+                        f"error feedback, found_inf poison semantics); "
+                        f"use compress.quantized_psum/"
+                        f"quantized_psum_scatter/quantized_all_gather "
+                        f"or move the composition there"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"quant": quant, "collective": coll,
+                          "function": node.name},
+                )
 
 
 @lint_rule("lint.float64", scopes=("apex_tpu/",))

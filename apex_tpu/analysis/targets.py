@@ -24,7 +24,13 @@ import jax.numpy as jnp
 
 from apex_tpu.analysis.passes import StepTarget
 
-__all__ = ["dp2tp2_mesh", "gpt_step_target", "bert_step_target", "all_targets"]
+__all__ = [
+    "dp2tp2_mesh",
+    "gpt_step_target",
+    "gpt_compressed_step_target",
+    "bert_step_target",
+    "all_targets",
+]
 
 
 def dp2tp2_mesh():
@@ -58,9 +64,17 @@ def _tiny_cfg(**overrides):
     return TransformerConfig(**base)
 
 
-def gpt_step_target(mesh=None) -> StepTarget:
+def gpt_step_target(mesh=None, compression=None) -> StepTarget:
     """The GPT dp2xtp2 train step: bf16 + SP over tp, GradScaler, fused
-    Adam, dp grad allreduce, donated (params, opt_state, scaler_state)."""
+    Adam, dp grad allreduce, donated (params, opt_state, scaler_state).
+
+    ``compression`` (a ``parallel.compress.CompressionConfig``) swaps the
+    dp grad allreduce for the quantized decomposition — the acceptance
+    target of the compressed-collective work: the ledger predicts the
+    int8 wire bytes and the hlo-comms differ must confirm the emitted
+    pattern (``gpt_compressed_step_target`` registers it with the CLI
+    gate). Stateless here (no error-feedback residual): the auditors
+    trace one step; EF only matters across steps."""
     import optax
 
     from apex_tpu.amp import GradScaler
@@ -106,7 +120,9 @@ def gpt_step_target(mesh=None) -> StepTarget:
             )
 
         loss, grads = jax.value_and_grad(scaled_loss)(params)
-        grads = all_reduce_gradients(grads, axis_name="dp")
+        grads = all_reduce_gradients(
+            grads, axis_name="dp", compression=compression
+        )
         grads, found_inf = scaler.unscale(scaler_state, grads)
         new_scaler_state = scaler.update(scaler_state, found_inf)
         updates, new_opt_state = opt.update(grads, opt_state, params)
@@ -115,12 +131,22 @@ def gpt_step_target(mesh=None) -> StepTarget:
         return new_params, new_opt_state, new_scaler_state, unscaled
 
     return StepTarget(
-        name="gpt-dp2tp2",
+        name="gpt-dp2tp2" if compression is None else "gpt-dp2tp2-int8",
         fn=gpt_train_step,
         args=(params, opt_state, scaler_state, tokens, tokens),
         mesh=mesh,
         donate_argnums=(0, 1, 2),
     )
+
+
+def gpt_compressed_step_target(mesh=None) -> StepTarget:
+    """The GPT step with the int8 quantized dp gradient allreduce
+    (parallel/compress.py) — the third CLI-gate target, so every pass
+    (precision, donation, collective safety, host-sync, hlo-comms,
+    hlo-sharding) audits the compressed wire pattern on every run."""
+    from apex_tpu.parallel.compress import CompressionConfig
+
+    return gpt_step_target(mesh, compression=CompressionConfig())
 
 
 def bert_step_target(mesh=None) -> StepTarget:
@@ -183,4 +209,8 @@ def bert_step_target(mesh=None) -> StepTarget:
 
 def all_targets(mesh=None) -> List[StepTarget]:
     mesh = mesh or dp2tp2_mesh()
-    return [gpt_step_target(mesh), bert_step_target(mesh)]
+    return [
+        gpt_step_target(mesh),
+        gpt_compressed_step_target(mesh),
+        bert_step_target(mesh),
+    ]

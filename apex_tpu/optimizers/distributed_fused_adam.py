@@ -44,9 +44,23 @@ class DistributedFusedAdamState(NamedTuple):
     master_shard: jax.Array
     exp_avg: jax.Array  # (padded_total / N,)
     exp_avg_sq: jax.Array  # (padded_total / N,)
+    # error-feedback residual of the compressed grad reduce-scatter
+    # (parallel/compress.py): fp32 (padded_total,) PER RANK — each rank
+    # keeps its OWN phase-1 quantization error over its contribution to
+    # every chunk, so the leaf crosses the shard_map boundary dp-SHARDED
+    # (zero_state_specs: P(axis); global shape (dp * padded_total,)).
+    # A scalar 0 when compression (or its error feedback) is off, so
+    # the state structure — and therefore checkpoints and
+    # zero_state_specs — stays uniform. The manifest
+    # marks it advisory (``ef`` in the topology block): the elastic
+    # restore regroups it like the flat buffers where the padding-only
+    # length change allows, else resets it to zero with a warning.
+    ef_residual: jax.Array
 
 
-def zero_state_specs(axis_name: str = "dp") -> "DistributedFusedAdamState":
+def zero_state_specs(
+    axis_name: str = "dp", compression=None
+) -> "DistributedFusedAdamState":
     """PartitionSpecs for moving DistributedFusedAdamState across the
     shard_map boundary (out_specs on save, in_specs on restore): the
     per-rank shards concatenate into ONE global flat array per field, which
@@ -54,14 +68,24 @@ def zero_state_specs(axis_name: str = "dp") -> "DistributedFusedAdamState":
     the sharded global arrays natively).  Ref: the reference's sharded
     state_dict machinery, contrib/optimizers/distributed_fused_adam.py
     (~:2158 onward) — here the single-controller global-array view replaces
-    all of it."""
+    all of it.
+
+    Pass the optimizer's ``compression`` config when its error feedback is
+    on: each rank then carries its OWN (padded_total,) residual, so the
+    leaf crosses the boundary dp-sharded — global shape
+    ``(dp * padded_total,)`` — instead of the scalar placeholder's
+    replicated ``P()``."""
     from jax.sharding import PartitionSpec as P
 
+    ef_on = compression is not None and getattr(
+        compression, "error_feedback", False
+    )
     return DistributedFusedAdamState(
         step=P(),
         master_shard=P(axis_name),
         exp_avg=P(axis_name),
         exp_avg_sq=P(axis_name),
+        ef_residual=P(axis_name) if ef_on else P(),
     )
 
 
@@ -101,8 +125,23 @@ def zero_init_master_shard(params, axis_name: str, axis_size: int):
     return jax.lax.dynamic_slice(flat, (idx * shard,), (shard,)), shard
 
 
-def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
-    """Shared ZeRO grad reduce-scatter. Returns (grad_shard, spec).
+def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool,
+                       compression=None, ef=None):
+    """Shared ZeRO grad reduce-scatter. Returns (grad_shard, spec) — or,
+    with ``compression`` set, (grad_shard, spec, new_ef).
+
+    ``compression`` (a ``parallel.compress.CompressionConfig``) swaps the
+    fused ``psum_scatter`` for the quantized reduce-scatter of
+    ``parallel/compress.py``: the flat grad buffer travels int8 (+
+    per-block fp32 scales) while the returned shard — and the master
+    update consuming it — stays fp32. ``ef`` is the error-feedback
+    residual (fp32, the flat buffer's padded length; keep it in the
+    optimizer state): the residual is added before quantizing and the
+    new residual is returned third. In the already-reduced regime the
+    summed leaves move no bytes (compression and EF pass through them
+    untouched) and any per-rank STRAGGLER leaves take a stateless
+    quantized psum — mixed trees never silently fall back to full-fat
+    fp32 on the wire.
 
     Two regimes, dispatched on the varying-manual-axes type (the same
     dispatch as ``parallel.ddp.all_reduce_gradients``):
@@ -145,16 +184,40 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
     leaves = jax.tree_util.tree_leaves(grads)
     tracking = vma_tracking_live(axis_name)
     reduced = [grads_already_reduced(l, axis_name, tracking) for l in leaves]
+    new_ef = ef
     if not any(reduced):
         # classic regime: one fused reduce-scatter over the flat buffer
         gflat, spec = _padded_flatten(grads, axis_size)
-        gshard = xlax.psum_scatter(gflat, axis_name, tiled=True)
+        if compression is not None:
+            from apex_tpu.parallel import compress as _compress
+
+            acc = gflat if ef is None else gflat + ef
+            gshard, sent = _compress.quantized_psum_scatter(
+                acc, axis_name, compression, return_transmitted=True
+            )
+            if ef is not None:
+                new_ef = _compress.ef_update(acc, sent)
+        else:
+            gshard = xlax.psum_scatter(gflat, axis_name, tiled=True)
     else:
         # normalize every leaf to "cross-rank sum" BEFORE flattening
-        # (psum the stragglers), then the collective is a local slice
+        # (psum the stragglers), then the collective is a local slice.
+        # With compression on, the straggler psums — the ONLY wire
+        # traffic this regime moves — go quantized too (stateless: the
+        # flat EF residual's positions don't map onto per-leaf psums, so
+        # these bounded one-shot errors are not error-fed; the
+        # already-summed leaves move no bytes either way)
+        if compression is not None:
+            from apex_tpu.parallel import compress as _compress
+
+            def _straggler(l):
+                return _compress.quantized_psum(l, axis_name, compression)
+        else:
+            def _straggler(l):
+                return xlax.psum(l, axis_name)
+
         flat_leaves = [
-            l if r else xlax.psum(l, axis_name)
-            for l, r in zip(leaves, reduced)
+            l if r else _straggler(l) for l, r in zip(leaves, reduced)
         ]
         grads = jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(grads), flat_leaves
@@ -165,7 +228,30 @@ def zero_scatter_grads(grads, axis_name: str, axis_size: int, average: bool):
         gshard = jax.lax.dynamic_slice(gflat, (idx * shard,), (shard,))
     if average:
         gshard = gshard / axis_size
+    if compression is not None:
+        return gshard, spec, new_ef
     return gshard, spec
+
+
+def zero_scatter_with_ef(grads, axis_name: str, axis_size: int,
+                         average: bool, compression, ef_residual):
+    """The ZeRO optimizers' shared scatter dispatch: always returns
+    ``(gshard, spec, new_ef)``, with ``new_ef`` falling back to the
+    caller's current residual when compression (or its error feedback,
+    or the wire itself in the already-reduced regime) leaves it
+    untouched — so adam and lamb cannot drift on the arity handling."""
+    if compression is None:
+        gshard, spec = zero_scatter_grads(
+            grads, axis_name, axis_size, average
+        )
+        return gshard, spec, ef_residual
+    use_ef = getattr(compression, "error_feedback", False)
+    gshard, spec, new_ef = zero_scatter_grads(
+        grads, axis_name, axis_size, average,
+        compression=compression,
+        ef=ef_residual if use_ef else None,
+    )
+    return gshard, spec, ef_residual if new_ef is None else new_ef
 
 
 def zero_regroup_flat(flat, target_len: int):
@@ -229,8 +315,19 @@ def distributed_fused_adam(
     average_grads: bool = True,
     max_grad_norm: float = None,
     store_param_remainders: bool = False,
+    compression=None,
 ) -> optax.GradientTransformation:
     """ZeRO-2 Adam over the ``axis_name`` mesh axis.
+
+    ``compression`` (a ``parallel.compress.CompressionConfig``): the
+    grad reduce-scatter travels block-scaled int8 instead of fp32 —
+    the fp32 master-shard update itself is untouched. With
+    ``compression.error_feedback`` (default) the state carries the
+    residual (``ef_residual``, fp32 at the flat buffer's padded
+    length) so convergence matches the exact path; overflow still
+    reaches found_inf (the poisoned-scale contract,
+    parallel/compress.py), and the caller's found_inf consensus psum
+    stays exact.
 
     ``axis_size`` defaults to the initialized parallel_state data-parallel
     size (parallel_state must be initialized, or pass it explicitly).
@@ -272,17 +369,27 @@ def distributed_fused_adam(
         if store_param_remainders:
             # master == f32(bf16 params) exactly at init -> low bits all 0
             master = jnp.zeros((shard,), jnp.uint16)
+        use_ef = compression is not None and getattr(
+            compression, "error_feedback", False
+        )
         return DistributedFusedAdamState(
             step=jnp.zeros((), jnp.int32),
             master_shard=master,
             exp_avg=jnp.zeros((shard,), jnp.float32),
             exp_avg_sq=jnp.zeros((shard,), jnp.float32),
+            ef_residual=(
+                jnp.zeros((shard * axis_size,), jnp.float32)
+                if use_ef else jnp.zeros((), jnp.float32)
+            ),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("distributed_fused_adam requires params")
-        gshard, spec = zero_scatter_grads(grads, axis_name, axis_size, average_grads)
+        gshard, spec, new_ef = zero_scatter_with_ef(
+            grads, axis_name, axis_size, average_grads, compression,
+            state.ef_residual,
+        )
 
         if max_grad_norm is not None:
             from apex_tpu.optimizers._fused_kernels import sumsq_flat
@@ -336,7 +443,8 @@ def distributed_fused_adam(
             updates = zero_gather_updates(new_master, params, spec, axis_name)
             new_shard_state = new_master
         new_state = DistributedFusedAdamState(
-            step=step, master_shard=new_shard_state, exp_avg=m, exp_avg_sq=v
+            step=step, master_shard=new_shard_state, exp_avg=m,
+            exp_avg_sq=v, ef_residual=new_ef,
         )
         return updates, new_state
 
@@ -368,6 +476,7 @@ class DistributedFusedAdam:
         average_grads: bool = True,
         max_grad_norm: float = None,
         store_param_remainders: bool = False,
+        compression=None,
         **_unused,
     ):
         return distributed_fused_adam(
@@ -382,4 +491,5 @@ class DistributedFusedAdam:
             average_grads=average_grads,
             max_grad_norm=max_grad_norm,
             store_param_remainders=store_param_remainders,
+            compression=compression,
         )

@@ -28,7 +28,7 @@ from apex_tpu.ops.multi_tensor import FlatSpec
 from apex_tpu.optimizers.distributed_fused_adam import (
     zero_gather_updates,
     zero_init_master_shard,
-    zero_scatter_grads,
+    zero_scatter_with_ef,
 )
 
 
@@ -37,6 +37,9 @@ class DistributedFusedLAMBState(NamedTuple):
     master_shard: jax.Array  # fp32 params shard
     exp_avg: jax.Array
     exp_avg_sq: jax.Array
+    # compressed-reduce error-feedback residual — same contract as
+    # DistributedFusedAdamState.ef_residual (scalar 0 when off)
+    ef_residual: jax.Array
 
 
 def _segment_ids(spec: FlatSpec) -> np.ndarray:
@@ -61,13 +64,22 @@ def distributed_fused_lamb(
     axis_name: str = "dp",
     axis_size: int = None,
     average_grads: bool = True,
+    compression=None,
 ) -> optax.GradientTransformation:
-    """ZeRO LAMB over the ``axis_name`` mesh axis; use inside shard_map."""
+    """ZeRO LAMB over the ``axis_name`` mesh axis; use inside shard_map.
+
+    ``compression``: same contract as ``distributed_fused_adam`` — the
+    grad reduce-scatter travels block-scaled int8 with error feedback in
+    ``state.ef_residual``; the trust-ratio/master math stays fp32.
+    """
     beta1, beta2 = betas
     if axis_size is None:
         from apex_tpu.parallel import parallel_state
 
         axis_size = parallel_state.get_data_parallel_world_size()
+    use_ef = compression is not None and getattr(
+        compression, "error_feedback", False
+    )
 
     def init_fn(params):
         master, shard = zero_init_master_shard(params, axis_name, axis_size)
@@ -76,12 +88,19 @@ def distributed_fused_lamb(
             master_shard=master,
             exp_avg=jnp.zeros((shard,), jnp.float32),
             exp_avg_sq=jnp.zeros((shard,), jnp.float32),
+            ef_residual=(
+                jnp.zeros((shard * axis_size,), jnp.float32)
+                if use_ef else jnp.zeros((), jnp.float32)
+            ),
         )
 
     def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("distributed_fused_lamb requires params")
-        gshard, spec = zero_scatter_grads(grads, axis_name, axis_size, average_grads)
+        gshard, spec, new_ef = zero_scatter_with_ef(
+            grads, axis_name, axis_size, average_grads, compression,
+            state.ef_residual,
+        )
         shard = gshard.shape[0]
 
         # local shard's segment ids (static slice per rank)
@@ -139,7 +158,8 @@ def distributed_fused_lamb(
 
         updates = zero_gather_updates(new_master, params, spec, axis_name)
         new_state = DistributedFusedLAMBState(
-            step=step, master_shard=new_master, exp_avg=m, exp_avg_sq=v
+            step=step, master_shard=new_master, exp_avg=m, exp_avg_sq=v,
+            ef_residual=new_ef,
         )
         return updates, new_state
 
@@ -163,6 +183,7 @@ class DistributedFusedLAMB:
         axis_name: str = "dp",
         axis_size: int = None,
         average_grads: bool = True,
+        compression=None,
         **_unused,
     ):
         return distributed_fused_lamb(
@@ -176,4 +197,5 @@ class DistributedFusedLAMB:
             axis_name=axis_name,
             axis_size=axis_size,
             average_grads=average_grads,
+            compression=compression,
         )

@@ -20,6 +20,8 @@ from apex_tpu.parallel.layers import (
     VocabParallelEmbedding,
 )
 from apex_tpu.parallel.cross_entropy import vocab_parallel_cross_entropy
+from apex_tpu.parallel import compress
+from apex_tpu.parallel.compress import CompressionConfig
 from apex_tpu.parallel import mappings
 from apex_tpu.parallel import pipeline
 from apex_tpu.optimizers.larc import LARC, larc
@@ -70,4 +72,6 @@ __all__ = [
     "scan_carry_fixed_point",
     "vma_cond",
     "split_tensor_along_last_dim",
+    "compress",
+    "CompressionConfig",
 ]

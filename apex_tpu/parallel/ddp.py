@@ -67,10 +67,25 @@ def all_reduce_gradients(
     gradient_average: bool = True,
     gradient_predivide_factor: float = 1.0,
     allreduce_always_fp32: bool = False,
+    compression: Optional[Any] = None,
+    ef_state: Optional[Any] = None,
 ) -> Any:
     """psum-average a grad pytree over the data-parallel axis.
 
     Call inside shard_map/pmap over ``axis_name`` after ``jax.grad``.
+
+    ``compression`` (a :class:`~apex_tpu.parallel.compress
+    .CompressionConfig`) replaces each classic-regime psum with the
+    block-scaled quantized all-reduce of ``parallel/compress.py`` —
+    gradients travel int8 (+ per-block fp32 scales) instead of
+    fp32/bf16. ``ef_state`` (a matching fp32 residual pytree from
+    ``compress.ef_init``) enables error feedback: when given, the
+    return value is ``(grads, new_ef_state)`` instead of ``grads``.
+    Non-finite grads still propagate (poisoned scales dequantize to
+    NaN), so the grad scaler's found_inf consensus — which is never
+    compressed — fires exactly as on the exact path. Leaves in the
+    ALREADY-REDUCED regime carry no wire traffic and pass through
+    compression untouched (their residual stays zero).
 
     TWO REGIMES, dispatched per-leaf on the varying-manual-axes type
     (``jax.typeof(g).vma``):
@@ -101,10 +116,15 @@ def all_reduce_gradients(
     grads of a PER-RANK (shard-local) loss; tests/test_ddp.py pins both
     regimes.
     """
+    if compression is None and ef_state is not None:
+        raise ValueError(
+            "ef_state without compression: the exact psum has no "
+            "quantization error to feed back"
+        )
     n = xlax.axis_size(axis_name)
     tracking = vma_tracking_live(axis_name)
 
-    def _one(g):
+    def _one(g, ef):
         orig = g.dtype
         if allreduce_always_fp32:
             g = g.astype(jnp.float32)
@@ -117,15 +137,43 @@ def all_reduce_gradients(
                 g = g / n
             elif gradient_predivide_factor != 1.0:
                 g = g / gradient_predivide_factor
-            return g.astype(orig)
+            return g.astype(orig), ef
         if gradient_predivide_factor != 1.0:
             g = g / gradient_predivide_factor
-        g = xlax.psum(g, axis_name)
+        if compression is not None:
+            from apex_tpu.parallel import compress as _compress
+
+            acc = g.astype(jnp.float32) if ef is None else (
+                g.astype(jnp.float32) + ef
+            )
+            g, sent = _compress.quantized_psum(
+                acc, axis_name, compression, return_transmitted=True
+            )
+            if ef is not None:
+                ef = _compress.ef_update(acc, sent)
+        else:
+            g = xlax.psum(g, axis_name)
         if gradient_average:
             g = g * (gradient_predivide_factor / n)
-        return g.astype(orig)
+        return g.astype(orig), ef
 
-    return jax.tree_util.tree_map(_one, grads)
+    if ef_state is None:
+        return jax.tree_util.tree_map(lambda g: _one(g, None)[0], grads)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    ef_leaves, ef_treedef = jax.tree_util.tree_flatten(ef_state)
+    if ef_treedef != treedef:
+        # a positional zip over mismatched trees would silently pair
+        # residuals with the WRONG gradients — corrupt error feedback,
+        # not an error; build ef_state with compress.ef_init(grads)
+        raise ValueError(
+            f"ef_state structure {ef_treedef} does not match grads "
+            f"{treedef}"
+        )
+    pairs = [_one(g, e) for g, e in zip(leaves, ef_leaves)]
+    return (
+        jax.tree_util.tree_unflatten(treedef, [p[0] for p in pairs]),
+        jax.tree_util.tree_unflatten(treedef, [p[1] for p in pairs]),
+    )
 
 
 def broadcast_params(params: Any, axis_name: str = "dp") -> Any:
@@ -156,20 +204,26 @@ class DistributedDataParallel:
         gradient_average: bool = True,
         gradient_predivide_factor: float = 1.0,
         allreduce_always_fp32: bool = False,
+        compression: Optional[Any] = None,
     ):
         self.loss_fn = loss_fn
         self.axis_name = axis_name
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
+        self.compression = compression
 
-    def reduce(self, grads: Any) -> Any:
+    def reduce(self, grads: Any, ef_state: Optional[Any] = None) -> Any:
+        """Sync grads; with ``compression`` + ``ef_state`` returns
+        ``(grads, new_ef_state)`` (see ``all_reduce_gradients``)."""
         return all_reduce_gradients(
             grads,
             self.axis_name,
             self.gradient_average,
             self.gradient_predivide_factor,
             self.allreduce_always_fp32,
+            compression=self.compression,
+            ef_state=ef_state,
         )
 
     def value_and_grad(self, *args, **kwargs):

@@ -186,18 +186,36 @@ def _scatter_seq_bwd(axis_name, res, g):
 scatter_to_sequence_parallel_region.defvjp(_scatter_seq_fwd, _scatter_seq_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def gather_from_sequence_parallel_region(x, axis_name="tp", to_model_parallel=True):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def gather_from_sequence_parallel_region(
+    x, axis_name="tp", to_model_parallel=True, defer_sync=False
+):
+    """SP activation gather (fwd all-gather over the sequence dim).
+
+    ``defer_sync=True`` is the EXPERIMENTAL arXiv:2506.19645 relaxation
+    (Tensor-Parallelism with Partially Synchronized Activations), off by
+    default: the backward pass SKIPS the cross-rank reduce-scatter and
+    keeps only the local shard of the cotangent — the gradient
+    synchronization this gather owes is deferred to the surrounding dp
+    sync instead of paid per-layer on the tp axis. Gradients become
+    approximate (cross-rank activation-grad terms are dropped), so this
+    is only sound for syncs the paper's analysis shows are relaxable;
+    convergence must be re-pinned per model. The skipped collective is
+    neither executed nor ledger-predicted, so the hlo-comms differ stays
+    clean either way.
+    """
     return _all_gather_dim(x, axis_name, 0)
 
 
-def _gather_seq_fwd(x, axis_name, to_model_parallel):
+def _gather_seq_fwd(x, axis_name, to_model_parallel, defer_sync):
     return _all_gather_dim(x, axis_name, 0), None
 
 
-def _gather_seq_bwd(axis_name, to_model_parallel, _, g):
-    if to_model_parallel:
+def _gather_seq_bwd(axis_name, to_model_parallel, defer_sync, _, g):
+    if to_model_parallel and not defer_sync:
         return (_reduce_scatter_dim(g, axis_name, 0),)
+    # defer_sync relaxation (or plain data movement): local shard only,
+    # no cross-rank reduction — zero tp-axis bytes in the backward
     return (_split_along_axis(g, axis_name, 0),)
 
 

@@ -91,22 +91,77 @@ def needs_reshard(directory: str, mesh, step: Optional[int] = None
     return None
 
 
-def _host_restore(directory: str, step: int, target: Any) -> Any:
+def _plain_key(entry):
+    """A jax key-path entry's key in orbax's serialized-container form
+    (serialize_tree: dicts stay dicts, NamedTuples/dataclasses become
+    dicts keyed by field name, sequences become lists)."""
+    if hasattr(entry, "key"):
+        return entry.key     # DictKey
+    if hasattr(entry, "name"):
+        return entry.name    # GetAttrKey
+    if hasattr(entry, "idx"):
+        return entry.idx     # SequenceKey
+    return None
+
+
+def _prune_to(plain, plain_target) -> None:
+    """Drop entries of the restored ``plain`` containers absent from
+    ``plain_target`` (checkpoint-only advisory EF leaves), in place."""
+    if isinstance(plain, dict) and isinstance(plain_target, dict):
+        for k in list(plain):
+            if k not in plain_target:
+                plain.pop(k)
+            else:
+                _prune_to(plain[k], plain_target[k])
+
+
+def _host_restore(directory: str, step: int, target: Any,
+                  fill: Optional[dict] = None,
+                  drop_extra: bool = False) -> Any:
     """The checkpoint's GLOBAL arrays as host numpy, in ``target``'s
     structure. Explicit ``restore_type=np.ndarray`` per leaf: orbax's
     default path re-applies the sharding recorded in the checkpoint,
-    which is exactly wrong across a topology change."""
+    which is exactly wrong across a topology change.
+
+    The compression-toggle migration hooks (advisory EF leaves only —
+    the caller validates): ``fill`` maps jax key-path tuples to host
+    arrays for TARGET leaves the checkpoint does not carry (compression
+    newly ON) — those entries are pruned from the restore request and
+    the arrays spliced back in; ``drop_extra`` restores over the
+    CHECKPOINT's own structure and prunes leaves the target does not
+    want (compression turned OFF) — orbax refuses a request tree
+    missing an on-disk entry, so the subset must be cut after the read."""
     import orbax.checkpoint as ocp
     from orbax.checkpoint.utils import deserialize_tree, serialize_tree
     import jax
 
     plain_target = serialize_tree(target, keep_empty_nodes=True)
-    restore_args = jax.tree_util.tree_map(
-        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), plain_target
+    spliced = []
+    for path, arr in (fill or {}).items():
+        keys = [_plain_key(k) for k in path]
+        node = plain_target
+        for k in keys[:-1]:
+            node = node[k]
+        node.pop(keys[-1])
+        spliced.append((keys, arr))
+    ckptr = ocp.PyTreeCheckpointer()
+    args_tree = (
+        ckptr.metadata(_step_dir(directory, step))
+        if drop_extra else plain_target
     )
-    plain = ocp.PyTreeCheckpointer().restore(
+    restore_args = jax.tree_util.tree_map(
+        lambda _: ocp.RestoreArgs(restore_type=np.ndarray), args_tree
+    )
+    plain = ckptr.restore(
         _step_dir(directory, step), restore_args=restore_args
     )
+    if drop_extra:
+        _prune_to(plain, plain_target)
+    for keys, arr in spliced:
+        node = plain
+        for k in keys[:-1]:
+            node = node[k]
+        node[keys[-1]] = arr
     return deserialize_tree(plain, target, keep_empty_nodes=True)
 
 
@@ -190,27 +245,78 @@ def _reshard_step(directory: str, step: int, target: Any, mesh,
     topo_leaves = topology.get("leaves", [])
     got = [jax.tree_util.keystr(p) for p, _ in target_paths]
     want = [l["path"] for l in topo_leaves]
+    ef_fill: dict = {}
     if got != want:
-        extra = sorted(set(got) - set(want))[:3]
-        missing = sorted(set(want) - set(got))[:3]
-        raise ElasticRestoreError(
-            f"step_{step}: restore target structure differs from the saved "
-            f"topology (target-only leaves {extra}, checkpoint-only leaves "
-            f"{missing}) — a state-layout change needs a migration, not a "
-            f"reshard"
+        from apex_tpu.resilience.elastic.topology import is_ef_path
+
+        extra = sorted(set(got) - set(want))
+        missing = sorted(set(want) - set(got))
+        # migration shim across the compression toggle, BOTH directions
+        # (EF state is advisory — never refuse over it, topology.py):
+        # target-only EF leaves (compression newly ON; pre-upgrade
+        # checkpoint) are zero-filled, checkpoint-only EF leaves
+        # (compression turned OFF) are simply not restored — the
+        # target-driven orbax restore never reads them. Any non-EF
+        # structure diff still refuses. Zero-fill needs dict/attr-keyed
+        # leaves (orbax's serialized form; a list-final key's pop/splice
+        # would shift sibling indices), so that case refuses too.
+        ok_shim = (
+            (extra or missing)
+            and all(is_ef_path(p) for p in extra)
+            and all(is_ef_path(p) for p in missing)
         )
+        if ok_shim and extra:
+            fill = {}
+            for path_key, tgt_leaf in target_paths:
+                p = jax.tree_util.keystr(path_key)
+                if p not in extra:
+                    continue
+                if hasattr(path_key[-1], "idx"):
+                    ok_shim = False
+                    break
+                fill[path_key] = np.zeros(
+                    tuple(np.shape(tgt_leaf)),
+                    np.dtype(getattr(tgt_leaf, "dtype", np.float32)),
+                )
+            ef_fill = fill if ok_shim else {}
+        if not ok_shim:
+            raise ElasticRestoreError(
+                f"step_{step}: restore target structure differs from the "
+                f"saved topology (target-only leaves {extra[:3]}, "
+                f"checkpoint-only leaves {missing[:3]}) — a state-layout "
+                f"change needs a migration, not a reshard"
+            )
+        if extra:
+            logger.warning(
+                "elastic restore step_%d: checkpoint predates the "
+                "compressed-collective EF state; zero-filling advisory "
+                "residual leaves %s", step, extra)
+        if missing:
+            logger.warning(
+                "elastic restore step_%d: checkpoint carries EF residual "
+                "leaves %s the (compression-off) target does not — "
+                "advisory state, not restored", step, missing)
 
     manifest = integrity.read_manifest(_step_dir(directory, step)) or {}
     fp = manifest.get("fingerprint") or {}
     fp_crc = {l["path"]: l["crc32"] for l in fp.get("leaves", [])}
+    topo_by_path = {l["path"]: l for l in topo_leaves}
 
-    host = _host_restore(directory, step, target)
+    host = _host_restore(directory, step, target, fill=ef_fill,
+                         drop_extra=bool(set(want) - set(got)))
     host_flat = jax.tree_util.tree_leaves(host)
     out_flat = []
-    for (path_key, tgt_leaf), host_arr, topo, spec in zip(
-            target_paths, host_flat, topo_leaves, specs_flat):
+    for (path_key, tgt_leaf), host_arr, spec in zip(
+            target_paths, host_flat, specs_flat):
         path = jax.tree_util.keystr(path_key)
         arr = np.asarray(host_arr)
+        topo = topo_by_path.get(path)
+        if topo is None:
+            # zero-filled advisory EF leaf (pre-compression checkpoint):
+            # nothing on disk to verify — ship the zeros
+            _check_spec_fits(path, arr.shape, spec, axes)
+            out_flat.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+            continue
         saved_shape = tuple(topo["shape"])
         if arr.shape != saved_shape or str(arr.dtype) != topo["dtype"]:
             raise ElasticRestoreError(
@@ -239,6 +345,35 @@ def _reshard_step(directory: str, step: int, target: Any, mesh,
                     f"bytes differ from the state that was saved"
                 )
         if tgt_shape != saved_shape:
+            if topo.get("ef"):
+                # error-feedback residual (topology.py docstring): the
+                # compressed-collective residual is ADVISORY — regroup it
+                # like a ZeRO flat buffer when the length change is
+                # padding-only, otherwise reset to zero with a warning.
+                # NEVER a refusal: one step of re-accumulated
+                # quantization error beats a dead restore. (The common
+                # dp-change case IS a reset: per-rank residuals
+                # concatenate over dp, so the global length change is
+                # not padding-only.)
+                if arr.ndim == 1 and len(tgt_shape) == 1:
+                    try:
+                        arr = zero_regroup_flat(arr, int(tgt_shape[0]))
+                    except ValueError as e:
+                        logger.warning(
+                            "elastic restore: EF residual %s not "
+                            "regroupable (%s); resetting to zero — the "
+                            "compressed path re-accumulates it", path, e)
+                        arr = np.zeros(tgt_shape, arr.dtype)
+                else:
+                    logger.warning(
+                        "elastic restore: EF residual %s shape changed "
+                        "%s -> %s; resetting to zero — the compressed "
+                        "path re-accumulates it", path, saved_shape,
+                        tgt_shape)
+                    arr = np.zeros(tgt_shape, arr.dtype)
+                _check_spec_fits(path, arr.shape, spec, axes)
+                out_flat.append(jax.device_put(arr, NamedSharding(mesh, spec)))
+                continue
             if topo.get("zero_shard_axis") is None or arr.ndim != 1:
                 raise ElasticRestoreError(
                     f"leaf {path}: global shape changed "

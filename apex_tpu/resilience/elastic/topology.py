@@ -31,6 +31,16 @@ a topology change — the elastic restore regroups them (truncate/extend
 the zero padding); any other shape change is refused
 (``reshard.restore_resharded``).
 
+``ef`` marks error-feedback residual state (the compressed-collective
+residuals of ``parallel/compress.py`` — detected by the ``ef_residual``
+naming contract in the leaf path: the ZeRO optimizers' state field and
+the DDP examples' residual tree both use it). EF state is ADVISORY: it
+only accelerates convergence of the compressed path, so the elastic
+restore must NEVER refuse over it — it regroups like a ZeRO flat buffer
+where the length change is padding-only, and otherwise resets the
+residual to zero with a logged warning (one step of re-accumulated
+quantization error, not a correctness loss).
+
 Manifests written before this block existed simply lack the key; the
 elastic restore treats those as "predates the manifest-format upgrade"
 and falls back to the newest checkpoint that carries one.
@@ -44,9 +54,22 @@ __all__ = [
     "spec_to_json",
     "spec_from_json",
     "mesh_axes",
+    "is_ef_path",
 ]
 
 TOPOLOGY_VERSION = 1
+
+
+def is_ef_path(path_str: str) -> bool:
+    """Is a keystr path an error-feedback residual leaf?
+
+    Exact FINAL-segment match on the ``ef_residual`` naming contract —
+    a NamedTuple/dataclass field (``.ef_residual``) or a dict key
+    (``['ef_residual']``). A substring test would mark unrelated leaves
+    that merely contain the name (``chef_residual``) advisory and let
+    the restore reset REAL state to zero.
+    """
+    return path_str.endswith((".ef_residual", "['ef_residual']"))
 
 
 def spec_to_json(spec) -> Optional[List[Any]]:
@@ -137,12 +160,16 @@ def topology_block(tree: Any) -> dict:
             devices = int(np.asarray(sharding.mesh.devices).size)
         arr_shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
         arr_dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+        path_str = jax.tree_util.keystr(path)
         leaves.append({
-            "path": jax.tree_util.keystr(path),
+            "path": path_str,
             "shape": [int(d) for d in arr_shape],
             "dtype": arr_dtype,
             "spec": spec_json,
             "zero_shard_axis": _zero_shard_axis(arr_shape, spec_json),
+            # error-feedback residual marker (module docstring): advisory
+            # state the restore may reset rather than refuse over
+            "ef": is_ef_path(path_str),
         })
     return {
         "version": TOPOLOGY_VERSION,

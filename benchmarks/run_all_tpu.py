@@ -61,6 +61,7 @@ BUDGETS = {
     "profile": int(os.environ.get("APEX_TPU_PROFILE_BUDGET", "2000")),
     "sweep": int(os.environ.get("APEX_TPU_SWEEP_BUDGET", "900")),
     "ckpt": int(os.environ.get("APEX_TPU_CKPT_BUDGET", "900")),
+    "comms": int(os.environ.get("APEX_TPU_COMMS_BUDGET", "900")),
 }
 
 # Sticky relay-liveness verdict for this capture attempt.  A dead relay
@@ -700,6 +701,130 @@ def run_ckpt(deadline, out_path):
     return rec
 
 
+def run_comms(deadline, out_path):
+    """Exact vs int8 gradient all-reduce on a ~18 MB tree, chain-slope
+    timed (apex_tpu.utils.benchmarking — the only measurement the relay
+    can't lie to) over the full device mesh.  This is the third referee
+    of the compressed-collective acceptance (ISSUE 11): the ledger
+    predicts the per-iteration dp-axis wire bytes for BOTH paths, the
+    slope gives measured seconds, and their quotient is achieved
+    bytes/s — emitted as metric-carrying sub-records whose
+    ``kind="bench"`` twins let the PR-7 perf sentinel gate
+    compression-path regressions exactly like compute benches.  The
+    quantized path must show measured seconds strictly below the exact
+    capture on real ICI; on CPU fallback the numbers are still recorded
+    but say nothing about the wire (platform is stamped on the twins)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.parallel.compress import CompressionConfig
+    from apex_tpu.parallel.ddp import all_reduce_gradients
+    from apex_tpu.utils.benchmarking import (
+        chained_seconds_per_iter, full_reduce,
+    )
+
+    devs = np.asarray(jax.devices())
+    n = int(devs.size)
+    if n < 2:
+        return {"measured_n": 0, "note": f"needs >=2 devices, have {n}"}
+    mesh = Mesh(devs, ("dp",))
+    cfg = CompressionConfig()
+    key = jax.random.PRNGKey(0)
+    # ~18 MB fp32 grad tree: an embedding-ish matrix, a flat tail, a bias
+    tree = {
+        "w": jax.random.normal(key, (1536, 2048), jnp.float32) * 1e-2,
+        "e": jax.random.normal(jax.random.fold_in(key, 1),
+                               (1_500_000,), jnp.float32) * 1e-2,
+        "b": jnp.zeros((4096,), jnp.float32),
+    }
+    tree_mb = sum(
+        np.prod(v.shape) * 4 for v in tree.values()) / 1e6
+
+    def reducer(mode):
+        def one(c):
+            if mode == "int8":
+                return all_reduce_gradients(c, "dp", compression=cfg)
+            return all_reduce_gradients(c, "dp")
+
+        return one
+
+    def build(mode):
+        def b(k):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            )
+            def run(t):
+                # averaging keeps the carry bounded across k chained
+                # reduces (mean of replicated values is idempotent);
+                # the data dependence keeps XLA from eliding any
+                t = jax.lax.fori_loop(
+                    0, k, lambda i, c: reducer(mode)(c), t
+                )
+                return full_reduce(t)
+
+            return run
+
+        return b
+
+    # predicted per-iteration dp wire bytes for each path — the ledger
+    # is the denominator of achieved bytes/s and the byte-drop record
+    def dp_wire_bytes(mode):
+        fn = functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )(reducer(mode))
+        led = xlax.predict_comms(fn, tree)
+        return led.per_axis().get("dp", {}).get("ici_bytes", 0)
+
+    wire = {m: dp_wire_bytes(m) for m in ("exact", "int8")}
+    rec = {"measured_n": 0, "devices": n, "tree_mb": round(tree_mb, 1),
+           "predicted_dp_wire_bytes": wire,
+           "predicted_byte_drop": round(wire["exact"] / wire["int8"], 3)}
+
+    def mode_fn(mode):
+        def f(item_deadline):
+            sec = chained_seconds_per_iter(
+                build(mode), (tree,), deadline=item_deadline
+            )
+            return round(wire[mode] / sec, 0)  # achieved wire bytes/s
+
+        return f
+
+    items = [
+        # "_per_sec", NOT "_per_s": the sentinel's suffix rule reads a
+        # bare "_s" ending as lower-is-better (a time); achieved
+        # throughput must gate higher-is-better
+        (mode, mode_fn(mode),
+         {"metric": f"comms_dp_allreduce_{mode}_bytes_per_sec",
+          "unit": "B/s", "tree_mb": round(tree_mb, 1),
+          "wire_bytes_per_iter": wire[mode]})
+        for mode in ("exact", "int8")
+    ]
+    results, measured, incomplete = run_items(
+        items, deadline, out_path, "comms")
+    rec["measured_n"] = measured
+    for mode in ("exact", "int8"):
+        rec[f"{mode}_bytes_per_s"] = results[mode]
+    if all(isinstance(results[m], (int, float)) for m in ("exact", "int8")):
+        # seconds per iteration back out of bytes/s; the acceptance
+        # claim on hardware is this ratio > 1 (int8 strictly faster)
+        sec = {m: wire[m] / results[m] for m in ("exact", "int8")}
+        rec["dp_seconds_per_iter"] = {
+            m: round(v, 6) for m, v in sec.items()}
+        rec["measured_speedup_int8"] = round(
+            sec["exact"] / sec["int8"], 3)
+    if incomplete:
+        rec["incomplete"] = incomplete
+    return rec
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(os.path.dirname(__file__), "tpu_results.jsonl"))
@@ -729,6 +854,7 @@ def main():
         ("configs", functools.partial(run_configs, out_path=args.out)),
         ("sweep", functools.partial(run_sweep, out_path=args.out)),
         ("ckpt", functools.partial(run_ckpt, out_path=args.out)),
+        ("comms", functools.partial(run_comms, out_path=args.out)),
     ]
     for name, fn in runners:
         if name not in skip:

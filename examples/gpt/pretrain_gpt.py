@@ -93,6 +93,17 @@ def parse_args():
                         "moments + fp32 master sharded 1/dp over the dp "
                         "axis; checkpoints of this state reshard across a "
                         "dp-size change via the elastic restore")
+    p.add_argument("--compression", default="none",
+                   choices=["none", "int8", "fp8"],
+                   help="quantized gradient collectives "
+                        "(apex_tpu.parallel.compress, docs/parallel.md "
+                        "'Compressed collectives'): the dp gradient sync "
+                        "travels block-scaled int8/fp8 + fp32 scales with "
+                        "an error-feedback residual carried in the "
+                        "optimizer-state slot; found_inf consensus and "
+                        "the master update stay exact")
+    p.add_argument("--compression-block", type=int, default=128,
+                   help="elements per fp32 scale block for --compression")
     p.add_argument("--seed", type=int, default=0)
     # resilience policy (apex_tpu.resilience; docs/resilience.md)
     p.add_argument("--spike-z", type=float, default=6.0,
@@ -321,17 +332,34 @@ def main():
     # all-reduce below is skipped; its state crosses the shard_map
     # boundary dp-SHARDED (zero_state_specs) and the elastic restore
     # regroups it across a dp-size change (docs/resilience.md)
+    # --compression: the dp gradient sync travels block-scaled int8/fp8
+    # (parallel/compress.py). Under --zero the optimizer owns the
+    # compressed reduce-scatter AND its error-feedback residual (a state
+    # field); under plain DDP the residual rides in the opt_state SLOT as
+    # {"opt", "ef_residual"} so every checkpoint/rollback/restore site
+    # carries it opaquely — the manifest's ef marker makes the elastic
+    # restore reset (never refuse) it across a topology change
+    compress_cfg = None
+    if args.compression != "none":
+        from apex_tpu.parallel.compress import CompressionConfig
+
+        compress_cfg = CompressionConfig(
+            dtype=args.compression, block_size=args.compression_block
+        )
+    ddp_compressed = compress_cfg is not None and not args.zero
     if args.zero:
         from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
 
         opt = distributed_fused_adam(
             lr=args.lr, weight_decay=0.01, axis_name="dp", axis_size=dp,
-            average_grads=True,
+            average_grads=True, compression=compress_cfg,
         )
-        opt_specs = zero_state_specs("dp")
+        opt_specs = zero_state_specs("dp", compression=compress_cfg)
     else:
         opt = fused_adam(lr=args.lr, weight_decay=0.01)
-        opt_specs = P()
+        # per-rank EF residuals cross the boundary with a leading dp dim
+        opt_specs = ({"opt": P(), "ef_residual": P("dp")}
+                     if ddp_compressed else P())
     # under ZeRO the grads stay per-rank partials until the optimizer's
     # reduce-scatter, so the overflow flag must join the dp consensus too
     # (without it one rank could skip while the others step)
@@ -383,6 +411,14 @@ def main():
     )
     def train_step(params, opt_state, scaler_state, sent_state, bag, tokens,
                    labels, inject_nan, lr_scale):
+        if ddp_compressed:
+            # unpack the slot: adam state + this rank's EF residuals
+            # (leading dp dim sliced off by shard_map's in_specs)
+            ef = jax.tree_util.tree_map(
+                lambda e: e[0], opt_state["ef_residual"]
+            )
+            opt_state = opt_state["opt"]
+
         # tokens: (num_micro, micro*dp, seq) -> this dp shard's microbatches
         def micro_loss(p, tok, lab):
             return gpt_loss_fn(model.apply(p, tok, labels=lab))
@@ -400,10 +436,20 @@ def main():
         # while the batched collective ships num_micro x the bytes
         with monitor.xray.scaled(num_micro):
             loss, grads = jax.value_and_grad(scaled_total)(params)
+        new_ef = None
         if not args.zero:
             # ZeRO's reduce-scatter inside opt.update replaces this
             # all-reduce (feeding it pre-averaged grads would double-count)
-            grads = all_reduce_gradients(grads, axis_name="dp")
+            if ddp_compressed:
+                # error-compensated quantized all-reduce: grads travel
+                # int8 + scales; non-finite grads poison the scales and
+                # still reach found_inf below (the exact consensus path)
+                grads, new_ef = all_reduce_gradients(
+                    grads, axis_name="dp", compression=compress_cfg,
+                    ef_state=ef,
+                )
+            else:
+                grads = all_reduce_gradients(grads, axis_name="dp")
         grads, found_inf = scaler.unscale(scaler_state, grads)
         # the scaler's dynamic schedule reacts to true overflow only; the
         # sentinel's spike gate must NOT halve the scale (a spike is not a
@@ -432,6 +478,16 @@ def main():
         new_params, new_opt_state = vma_cond(
             gate, lambda: (params, opt_state), apply
         )
+        if ddp_compressed:
+            # the residual updates even on gated steps (poisoned leaves
+            # RESET inside ef_update, so a skipped step cannot freeze a
+            # NaN residual); re-pack with the leading dp dim restored
+            new_opt_state = {
+                "opt": new_opt_state,
+                "ef_residual": jax.tree_util.tree_map(
+                    lambda e: e[None], new_ef
+                ),
+            }
         new_sent_state, verdict = sentinel.update(
             sent_state, unscaled, anomaly=gate,
             bad_params=tree_any_non_finite(new_params),
@@ -486,6 +542,17 @@ def main():
         opt_state = init_opt(params)
     else:
         opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
+        if ddp_compressed:
+            # zero EF residuals, one per rank per param leaf (leading dp
+            # dim, dp-sharded — the opt_specs slot layout above)
+            ef0 = jax.tree_util.tree_map(
+                lambda p: jax.device_put(
+                    np.zeros((dp,) + tuple(p.shape), np.float32),
+                    jax.sharding.NamedSharding(mesh, P("dp")),
+                ),
+                params,
+            )
+            opt_state = {"opt": opt_state, "ef_residual": ef0}
     scaler_state = jax.device_put(scaler.init(), replicated)
     sent_state = jax.device_put(sentinel.init(), replicated)
     bag = jax.device_put(monitor.metric_bag(METRIC_SPEC), replicated)
@@ -551,6 +618,40 @@ def main():
             # over a refusal would silently discard the run
             print(f"checkpoint in {args.save} has an incompatible layout "
                   f"({e}); starting fresh")
+        if step0 == 0 and ddp_compressed:
+            # --compression newly enabled on an existing same-topology
+            # checkpoint: the saved opt slot is the plain adam state
+            # without the ef_residual wrapper, so the verified walk
+            # found nothing restorable under the NEW structure. Retry
+            # with the pre-compression target and start the advisory
+            # residuals at zero instead of discarding the run (the
+            # reshard path's zero-fill rule, applied here). A no-
+            # checkpoint dir just returns 0 again — harmless.
+            try:
+                step0, (params, plain_opt, scaler_state, sent_state) = (
+                    ar.restore((params, opt_state["opt"],
+                                scaler_state, sent_state)))
+            except ValueError:
+                plain_opt = None  # genuinely incompatible: stay fresh
+            if step0:
+                opt_state = {"opt": plain_opt,
+                             "ef_residual": opt_state["ef_residual"]}
+                print("resumed a pre-compression checkpoint; "
+                      "error-feedback residuals start at zero")
+        if step0 == 0:
+            from apex_tpu.utils.checkpoint import latest_step
+
+            if latest_step(args.save) is not None:
+                # checkpoints exist but none restored: most likely a
+                # state-LAYOUT change across an upgrade (e.g. the ZeRO
+                # state gained its ef_residual field) — the verified
+                # walk logs per-step warnings, but a silent fresh start
+                # on a long run deserves one loud line
+                print(f"WARNING: checkpoints exist under {args.save} "
+                      f"but none restored under the current state "
+                      f"layout; training starts FRESH (a pre-upgrade "
+                      f"state layout needs a migration — "
+                      f"docs/resilience.md)")
         if step0:
             print(f"resumed from step {step0}")
 

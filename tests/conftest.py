@@ -48,6 +48,8 @@ _SLOW_TESTS = {
     "test_gpt_pretrain_example",
     "test_gpt_pretrain_resume",
     "test_gpt_pretrain_chaos",
+    "test_gpt_compression_parity",
+    "test_gpt_compression_resume_migration",
     "test_elastic_selftest_gate",
     "test_gpt_elastic_chaos_drill",
     "test_gpt_preemption_skip_budget",

@@ -259,6 +259,203 @@ class TestRestoreResharded:
 
 
 # ---------------------------------------------------------------------------
+# error-feedback residual state (compressed collectives, PR "quantized
+# gradient collectives"): marked advisory in the manifest, regrouped
+# where the layout matches, reset-to-zero (never refused) otherwise
+
+
+class TestErrorFeedbackReshard:
+    """The satellite contract (ISSUE 11): EF leaves are marked ``ef`` in
+    the topology block; across a topology change they regroup like ZeRO
+    flat buffers when the length change is padding-only and otherwise
+    reset to zero with a logged warning — a hard refusal is never the
+    answer, EF state is advisory."""
+
+    def _ef_state(self, mesh, dp, seed=0, zeros=False, ef_len=None,
+                  ef_sharded=False):
+        state = _state(mesh, dp, seed=seed, zeros=zeros)
+        rng = np.random.RandomState(seed + 100)
+        ef_len = _padded(TOTAL, dp) if ef_len is None else ef_len
+        ef = np.zeros(ef_len, np.float32)
+        if not zeros and ef_sharded:
+            # per-rank residuals are nonzero EVERYWHERE (each rank's own
+            # error) — truncation can never pass off as padding removal
+            ef[:] = rng.randn(ef_len) * 1e-3
+        elif not zeros:
+            ef[:TOTAL] = rng.randn(TOTAL) * 1e-3
+        spec = P("dp") if ef_sharded else P()
+        state["ef_residual"] = jax.device_put(
+            ef, NamedSharding(mesh, spec))
+        return state
+
+    def test_topology_block_marks_ef(self):
+        topo = topology_block(self._ef_state(_mesh(8), 8))
+        leaves = {l["path"]: l for l in topo["leaves"]}
+        assert leaves["['ef_residual']"]["ef"] is True
+        assert leaves["['master']"]["ef"] is False
+
+    def test_8_to_4_regroups_padding_only_ef(self, tmp_path):
+        """A replicated DDP-style flat residual (padding-only length
+        change, zero tail) REGROUPS — the accumulated error survives."""
+        d = str(tmp_path)
+        state8 = self._ef_state(_mesh(8), 8, seed=3)
+        # zero tail: only the padding region beyond TOTAL is zero
+        integrity.save_checkpoint_verified(d, 1, state8)
+        target = self._ef_state(_mesh(4), 4, zeros=True)
+        step, out = restore_resharded(d, target, mesh=_mesh(4))
+        assert step == 1
+        ef = np.asarray(out["ef_residual"])
+        assert ef.shape == (_padded(TOTAL, 4),)
+        np.testing.assert_array_equal(
+            ef[:TOTAL], np.asarray(state8["ef_residual"])[:TOTAL])
+
+    def test_nonregroupable_ef_resets_to_zero_with_warning(self, tmp_path):
+        """A dp-SHARDED per-rank residual concatenates over dp, so the
+        global length change is NOT padding-only: reset to zero, warn,
+        and restore everything else — never ElasticRestoreError."""
+        import logging
+
+        d = str(tmp_path)
+        # sharded over dp=8: global length 8 * padded -> nonzero tail
+        state8 = self._ef_state(_mesh(8), 8, seed=4, ef_len=8 * 232,
+                                ef_sharded=True)
+        np.asarray(state8["ef_residual"])  # materialize
+        integrity.save_checkpoint_verified(d, 1, state8)
+        target = self._ef_state(_mesh(4), 4, zeros=True, ef_len=4 * 228,
+                                ef_sharded=True)
+        # the elastic logger carries its own handlers; listen directly
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        elog = logging.getLogger("apex_tpu.resilience.elastic")
+        elog.addHandler(handler)
+        try:
+            step, out = restore_resharded(d, target, mesh=_mesh(4))
+        finally:
+            elog.removeHandler(handler)
+        assert step == 1
+        ef = np.asarray(out["ef_residual"])
+        assert ef.shape == (4 * 228,) and not ef.any()
+        assert any("resetting to zero" in r.getMessage() for r in records)
+        # the REST of the state still restored with values
+        np.testing.assert_array_equal(
+            np.asarray(out["master"])[:TOTAL],
+            np.asarray(state8["master"])[:TOTAL])
+
+    def test_pre_compression_checkpoint_zero_fills_ef(self, tmp_path):
+        """Migration shim: a checkpoint saved BEFORE the compressed
+        collectives existed has no EF leaf at all — restoring it into a
+        compression-enabled target zero-fills the advisory residual
+        (with a warning) instead of refusing on the structure diff."""
+        d = str(tmp_path)
+        state8 = _state(_mesh(8), 8, seed=7)  # pre-upgrade: no ef leaf
+        integrity.save_checkpoint_verified(d, 1, state8)
+        target = self._ef_state(_mesh(4), 4, zeros=False)  # nonzero ef
+        step, out = restore_resharded(d, target, mesh=_mesh(4))
+        assert step == 1
+        ef = np.asarray(out["ef_residual"])
+        assert ef.shape == (_padded(TOTAL, 4),) and not ef.any()
+        np.testing.assert_array_equal(
+            np.asarray(out["master"])[:TOTAL],
+            np.asarray(state8["master"])[:TOTAL])
+        # a NON-advisory structure diff still refuses
+        target2 = self._ef_state(_mesh(4), 4, zeros=True)
+        target2["stray"] = jax.device_put(
+            np.zeros(3, np.float32), NamedSharding(_mesh(4), P()))
+        with pytest.raises(ElasticRestoreError, match="migration"):
+            restore_resharded(d, target2, mesh=_mesh(4))
+
+    def test_compression_off_drops_saved_ef_with_warning(self, tmp_path):
+        """The reverse migration: a checkpoint saved WITH compression
+        restores into a compression-off target — the checkpoint-only EF
+        leaves are simply not restored (warning), everything else lands;
+        and the ef marker is an EXACT segment match, so a leaf merely
+        CONTAINING the name still refuses."""
+        d = str(tmp_path)
+        state8 = self._ef_state(_mesh(8), 8, seed=9)
+        integrity.save_checkpoint_verified(d, 1, state8)
+        target = _state(_mesh(4), 4, zeros=True)  # no ef leaf at all
+        step, out = restore_resharded(d, target, mesh=_mesh(4))
+        assert step == 1
+        assert "ef_residual" not in out
+        np.testing.assert_array_equal(
+            np.asarray(out["master"])[:TOTAL],
+            np.asarray(state8["master"])[:TOTAL])
+        # near-miss name: NOT advisory -> structure diff refuses
+        d2 = str(tmp_path / "near")
+        state = _state(_mesh(8), 8, seed=10)
+        state["chef_residual"] = jax.device_put(
+            np.ones(4, np.float32), NamedSharding(_mesh(8), P()))
+        topo = topology_block(state)
+        assert all(not l["ef"] for l in topo["leaves"])
+        integrity.save_checkpoint_verified(d2, 1, state)
+        with pytest.raises(ElasticRestoreError, match="migration"):
+            restore_resharded(d2, _state(_mesh(4), 4, zeros=True),
+                              mesh=_mesh(4))
+
+    def test_8_to_4_resume_with_compression_on(self, tmp_path):
+        """ACCEPTANCE (satellite): a REAL compressed-ZeRO optimizer
+        state — DistributedFusedAdamState with an error-feedback
+        residual — saved on 8 devices resumes on 4: master/moments
+        regroup via zero_shard_axis, the per-rank residual resets to
+        zero (logged), nothing refuses."""
+        import functools
+
+        import jax.numpy as jnp
+        from apex_tpu.compat import shard_map
+        from apex_tpu.optimizers import (
+            distributed_fused_adam, zero_state_specs,
+        )
+        from apex_tpu.parallel.compress import CompressionConfig
+
+        cfg = CompressionConfig()
+        d = str(tmp_path)
+        params = {"w": np.arange(225, dtype=np.float32)}
+
+        def make(mesh, dp):
+            opt = distributed_fused_adam(
+                lr=1e-3, axis_name="dp", axis_size=dp, compression=cfg)
+            specs = zero_state_specs("dp", compression=cfg)
+            rep = NamedSharding(mesh, P())
+            init = functools.partial(
+                shard_map, mesh=mesh, in_specs=(P(),), out_specs=specs,
+                check_vma=False,
+            )(opt.init)
+            p = {"w": jax.device_put(jnp.asarray(params["w"]), rep)}
+            return {"params": p, "opt": init(p)}
+
+        state8 = make(_mesh(8), 8)
+        # make the per-rank residual NONZERO (as after a real compressed
+        # step) so the non-regroupable reset is observable: the global
+        # view concatenates 8 per-rank buffers
+        ef_global = np.asarray(state8["opt"].ef_residual)
+        assert ef_global.ndim == 1 and ef_global.shape[0] % 8 == 0
+        nonzero_ef = (np.random.RandomState(9)
+                      .randn(ef_global.shape[0]).astype(np.float32) * 1e-3)
+        state8["opt"] = state8["opt"]._replace(ef_residual=jax.device_put(
+            nonzero_ef,
+            NamedSharding(_mesh(8), P("dp"))))
+        topo = topology_block(state8)
+        leaves = {l["path"]: l for l in topo["leaves"]}
+        assert leaves["['opt'].ef_residual"]["ef"] is True
+        assert leaves["['opt'].ef_residual"]["spec"] == ["dp"]
+        integrity.save_checkpoint_verified(d, 2, state8)
+
+        target = make(_mesh(4), 4)
+        step, out = restore_resharded(d, target, mesh=_mesh(4))
+        assert step == 2
+        # master/moments: the flat padded length is CHUNK_SIZE-dominated
+        # here, so the global shape is dp-invariant and restores verbatim
+        np.testing.assert_array_equal(
+            np.asarray(out["opt"].master_shard),
+            np.asarray(state8["opt"].master_shard))
+        # the per-rank residual could not regroup (nonzero truncation):
+        # reset to zero at the NEW dp's global length, not refused
+        ef = np.asarray(out["opt"].ef_residual)
+        assert ef.shape == (ef_global.shape[0] // 2,) and not ef.any()
+
+
+# ---------------------------------------------------------------------------
 # AutoResume integration: elastic routing + EMA persistence
 
 
