@@ -245,6 +245,34 @@ class AmpOptimizer:
             info["grad_norm"] = global_grad_norm(grads_f32)
         return new_params, new_state, info
 
+    @staticmethod
+    def journal_fields(info: dict) -> dict:
+        """The flight-recorder slice of a :meth:`step` ``info`` dict.
+
+        Replay-relevant per-step fingerprints in journal-ready (host
+        scalar) form: loss scale, the overflow/skip gates, the verdict
+        when the sentinel is wired, and the grad norm when
+        ``collect_metrics=True`` collected it. Feed the result straight
+        into ``resilience.replay.FlightRecorder.step(step, **fields)`` —
+        one fetch per scalar, so callers that already fetch the verdict
+        pay one extra round trip at most::
+
+            params, state, info = amp_opt.step(..., sentinel=...)
+            recorder.step(i, loss=float(loss),
+                          **AmpOptimizer.journal_fields(info))
+        """
+        import numpy as np
+
+        out = {}
+        for key in ("loss_scale", "found_inf", "skipped", "verdict",
+                    "grad_norm"):
+            if key in info:
+                v = np.asarray(info[key])
+                out[key] = (int(v) if key == "verdict"
+                            else bool(v) if key in ("found_inf", "skipped")
+                            else float(v))
+        return out
+
     # -- checkpointing parity (amp.state_dict, frontend.py:367-404) -------
 
     def state_dict(self, state: AmpOptimizerState) -> dict:

@@ -72,6 +72,20 @@ Plus the new rules this framework exists to host:
   deliberate swallows — the router teardown and the profiler-abort
   guard, where failures have nowhere left to report — carry
   ``require_hit`` allowlist entries with exactly that reason.
+- ``lint.nondeterminism`` — no unseeded process-global RNG reads
+  (``random.random()``-style draws on the stdlib module singleton,
+  ``np.random.*`` draws on numpy's global generator) and no wall-clock
+  reads (``time.time``/``time.time_ns``) in library code. The replay
+  subsystem's bitwise claim (resilience/replay) rests on every
+  nondeterminism input being journaled; a stray singleton draw or a
+  wall-clock branch inside step-path code is invisible to the journal
+  and diverges unreproducibly. Seeded constructors
+  (``np.random.RandomState(seed)``, ``random.Random(seed)``,
+  ``default_rng``) and seeding calls are fine — they PIN determinism;
+  monotonic clocks (``perf_counter``/``monotonic``) are durations, not
+  inputs. The legitimate host-side homes — the retry jitter and the
+  record-timestamp clock — carry require_hit allowlist entries with
+  exactly those reasons.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -675,6 +689,121 @@ def compressed_collective(ctx: LintContext) -> Iterable[Finding]:
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                     data={"quant": quant, "collective": coll,
                           "function": node.name},
+                )
+
+
+#: stdlib ``random`` draw functions the nondeterminism rule polices when
+#: called through the module singleton (seeding and seeded-instance
+#: construction are exempt — they establish determinism, not break it)
+_STDLIB_RANDOM_DRAWS = frozenset({
+    "random", "uniform", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "lognormvariate", "getrandbits", "randbytes",
+})
+
+#: ``np.random`` attributes that are NOT singleton draws: seeded
+#: constructors/classes and state plumbing
+_NP_RANDOM_SEEDED = frozenset({
+    "RandomState", "default_rng", "Generator", "SeedSequence", "PCG64",
+    "Philox", "MT19937", "SFC64", "BitGenerator", "get_state",
+    "set_state", "seed",
+})
+
+
+@lint_rule("lint.nondeterminism", scopes=("apex_tpu/",))
+def nondeterminism(ctx: LintContext) -> Iterable[Finding]:
+    """Unseeded singleton RNG draws and wall-clock reads in library code
+    (module docstring). AST-based:
+
+    - a call whose attribute is a stdlib draw name and whose base
+      expression mentions the bare name ``random`` (so
+      ``random.uniform(...)`` AND ``(rng or random).random(...)`` are
+      caught, while ``jax.random.uniform`` — whose base is the
+      attribute ``jax.random``, not the name — is not);
+    - a call on ``np.random``/``numpy.random`` whose attribute is not a
+      seeded constructor;
+    - ``time.time(...)`` / ``time.time_ns(...)`` calls.
+    """
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.nondeterminism",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            attr = func.attr
+            base = func.value
+            # time.time / time.time_ns on the stdlib module name
+            if (attr in ("time", "time_ns")
+                    and isinstance(base, ast.Name)
+                    and base.id == "time"):
+                yield Finding(
+                    rule="lint.nondeterminism",
+                    message=(
+                        f"wall-clock read time.{attr}() in library code "
+                        f"— unreproducible input the replay journal "
+                        f"cannot capture; use time.monotonic/"
+                        f"perf_counter for durations, or allowlist the "
+                        f"site with the reason the value never feeds "
+                        f"step math"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"call": f"time.{attr}"},
+                )
+                continue
+            # np.random.<draw> / numpy.random.<draw> on the singleton
+            if (isinstance(base, ast.Attribute) and base.attr == "random"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id in ("np", "numpy")
+                    and attr not in _NP_RANDOM_SEEDED):
+                yield Finding(
+                    rule="lint.nondeterminism",
+                    message=(
+                        f"np.random.{attr}() draws from numpy's GLOBAL "
+                        f"generator — unseeded, process-shared, invisible "
+                        f"to the replay journal; construct a seeded "
+                        f"np.random.RandomState/default_rng instead"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"call": f"np.random.{attr}"},
+                )
+                continue
+            # stdlib singleton draws: a bare name `random` in the base
+            # expression (catches `(rng or random).random()`) — but NOT
+            # one inside a nested Call, which is a seeded-instance
+            # construction (`random.Random(3).random()` is exactly what
+            # this rule's message recommends, not a violation)
+            in_call = set()
+            for sub in ast.walk(base):
+                if isinstance(sub, ast.Call):
+                    for n2 in ast.walk(sub):
+                        if isinstance(n2, ast.Name):
+                            in_call.add(id(n2))
+            if attr in _STDLIB_RANDOM_DRAWS and any(
+                isinstance(n, ast.Name) and n.id == "random"
+                and id(n) not in in_call
+                for n in ast.walk(base)
+            ):
+                yield Finding(
+                    rule="lint.nondeterminism",
+                    message=(
+                        f"random.{attr}() draws from the stdlib module "
+                        f"singleton — unseeded and process-shared; use a "
+                        f"seeded random.Random(seed) instance (or "
+                        f"allowlist the host-side site with its reason)"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"call": f"random.{attr}"},
                 )
 
 

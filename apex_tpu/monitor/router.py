@@ -19,9 +19,13 @@ plus the allowlist verdict), the goodput kinds ("run", "span",
 the incident-response kinds ("preemption" — the deadline-budgeted
 termination decision, utils/autoresume.py; "incident" — forensic
 bundles and termination marks from apex_tpu.resilience.health;
-"retry" — transient-IO retry stutter, resilience/retry.py), so
-pre-flight audit results and run-lifecycle accounting land in the same
-jsonl a tailer already reads.
+"retry" — transient-IO retry stutter, resilience/retry.py), and the
+replay kinds ("journal" — the flight recorder's per-step
+nondeterminism inputs and fingerprints; "replay" — a re-execution
+segment's comparison outcome; "divergence" — the bisector's forensic
+verdict, all from apex_tpu.resilience.replay), so pre-flight audit
+results and run-lifecycle accounting land in the same jsonl a tailer
+already reads.
 
 ``host`` is the producing process's index (``jax.process_index()``) so
 merged multi-host streams stay attributable; it defaults to 0 and is
@@ -237,11 +241,14 @@ class StdoutSink(Sink):
     iteration and exist for the accountant, not the console — plus
     "incident", whose forensic bundle (all-thread stacks, the record-tail
     window) is far too large for a one-liner; the incident responder logs
-    a compact summary and the file sinks carry the bundle. The ``host``
-    field is likewise plumbing and never rendered.
+    a compact summary and the file sinks carry the bundle. "journal"
+    (the replay flight recorder, resilience.replay) is skipped for the
+    same per-iteration reason: the sidecar jsonl is its durable home.
+    The ``host`` field is likewise plumbing and never rendered.
     """
 
-    def __init__(self, stream=None, skip_kinds=("span", "run", "incident")):
+    def __init__(self, stream=None,
+                 skip_kinds=("span", "run", "incident", "journal")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 

@@ -23,7 +23,11 @@ REGISTERED_TAPS = {
         "transformer/layer.py ParallelTransformerLayer (when "
         "TransformerConfig.collect_layer_metrics): fp32 RMS of the "
         "layer's output hidden states — the per-layer activation-scale "
-        "series that makes divergence onsets attributable to a depth"
+        "series that makes divergence onsets attributable to a depth. "
+        "Consumed per-step by the replay flight recorder "
+        "(resilience/replay/targets.py stacks the sows into a (layers,) "
+        "vector, cross-rank-aggregated) so the divergence bisector can "
+        "localize a corruption to the first divergent layer"
     ),
 }
 

@@ -38,6 +38,12 @@ stakes; see PAPERS.md):
   mesh A onto any mesh B, ZeRO flat buffers regrouped across a changed
   dp size, refuse-don't-guess on layout mismatch) and the
   ``python -m apex_tpu.resilience.elastic`` exit-nonzero self-test.
+- ``replay``    — deterministic replay & divergence forensics: the
+  step-level flight recorder (``kind="journal"`` records + a
+  checkpoint-anchored sidecar), checkpoint-anchored re-execution with
+  bitwise/tolerance fingerprint comparison, and the corruption bisector
+  that pins a silent fault to the exact step and leaf — the
+  ``python -m apex_tpu.resilience.replay`` CLI and ``--selftest`` gate.
 
 End-to-end wiring: ``AmpOptimizer.step(..., sentinel=...)``,
 ``AutoResume`` (verified restore + async-finalized saves + retention),
@@ -75,6 +81,7 @@ from apex_tpu.resilience.integrity import (
 from apex_tpu.resilience import chaos
 from apex_tpu.resilience import elastic
 from apex_tpu.resilience import health
+from apex_tpu.resilience import replay
 from apex_tpu.resilience import retry
 
 __all__ = [
@@ -102,5 +109,6 @@ __all__ = [
     "chaos",
     "elastic",
     "health",
+    "replay",
     "retry",
 ]

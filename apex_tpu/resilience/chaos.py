@@ -21,6 +21,16 @@ on demand, deterministically, on the 8-device CPU test mesh:
   watchdog's escalation ladder can answer), and ``FaultPlan``'s
   ``slow_steps`` inject a per-step artificial delay (the straggler /
   thermal-throttle shape the warn level flags without escalating).
+- **Silent in-memory corruption** — ``bitflip_leaf`` XORs one bit of
+  one element of one live param/opt-state leaf (seeded, sharding-
+  preserving): the SDC shape that sails PAST the anomaly sentinel (a
+  low mantissa bit moves the loss by parts-per-thousand, nothing
+  spikes) and past every checkpoint-file check (the corrupt state is
+  faithfully saved and faithfully fingerprinted). Only the replay
+  referee (``resilience.replay``) catches it — the clean re-execution
+  diverges from the journaled trajectory at the flip — and the
+  bisector pins the step and the leaf. ``FaultPlan.bitflip_steps``
+  schedules it.
 
 ``FaultPlan`` schedules all of these by global step with consumed-once
 semantics: after a rollback re-winds the loop, the REPLAYED step runs
@@ -104,6 +114,10 @@ class FaultPlan:
     None so only the incident ladder ends the job).
     ``slow_steps``: steps delayed by ``slow_s`` wall seconds (straggler
     injection: slow enough to blow a stall deadline, not a hang).
+    ``bitflip_steps``: steps AFTER which one live param/opt-state bit is
+    flipped in memory (see ``bitflip_leaf``; ``bitflip_bit`` /
+    ``bitflip_seed`` pick the bit and the leaf) — the silent-corruption
+    fault the replay bisector exists to localize.
     ``persistent``: re-arm faults on replay (halt-path testing) instead
     of the default fire-once behavior (recovery-path testing).
     """
@@ -112,8 +126,11 @@ class FaultPlan:
     sigterm_steps: FrozenSet[int] = frozenset()
     hang_steps: FrozenSet[int] = frozenset()
     slow_steps: FrozenSet[int] = frozenset()
+    bitflip_steps: FrozenSet[int] = frozenset()
     slow_s: float = 0.0
     hang_timeout_s: Optional[float] = None
+    bitflip_bit: int = 12
+    bitflip_seed: int = 0
     persistent: bool = False
 
     def __post_init__(self):
@@ -121,10 +138,12 @@ class FaultPlan:
         self.sigterm_steps = parse_steps(self.sigterm_steps)
         self.hang_steps = parse_steps(self.hang_steps)
         self.slow_steps = parse_steps(self.slow_steps)
+        self.bitflip_steps = parse_steps(self.bitflip_steps)
         self._fired_nan: Set[int] = set()
         self._fired_sigterm: Set[int] = set()
         self._fired_hang: Set[int] = set()
         self._fired_slow: Set[int] = set()
+        self._fired_bitflip: Set[int] = set()
 
     def _due(self, step: int, steps: FrozenSet[int], fired: Set[int]) -> bool:
         if step in steps and (self.persistent or step not in fired):
@@ -161,11 +180,87 @@ class FaultPlan:
             return True
         return False
 
+    def maybe_bitflip(self, step: int, tree, path_filter=None):
+        """``(new_tree, info)`` with one bit flipped when scheduled for
+        ``step``, else ``(tree, None)`` — apply to the live state AFTER
+        the step completes (the flip then lands in any checkpoint saved
+        at the next boundary, which is what lets the replay bisector
+        pin the exact leaf)."""
+        if self._due(int(step), self.bitflip_steps, self._fired_bitflip):
+            return bitflip_leaf(tree, bit=self.bitflip_bit,
+                                seed=self.bitflip_seed,
+                                path_filter=path_filter)
+        return tree, None
+
 
 def simulate_sigterm() -> None:
     """Deliver a real SIGTERM to this process (drives the actual
     AutoResume handler, unlike setting its flag directly)."""
     os.kill(os.getpid(), _signal.SIGTERM)
+
+
+def bitflip_leaf(tree, bit: int = 12, seed: int = 0,
+                 path_filter: Optional[str] = None):
+    """Flip one bit of one element of one leaf of a LIVE pytree.
+
+    Returns ``(new_tree, info)`` where ``info`` records the flipped
+    leaf's key path (``jax.tree_util.keystr``, the
+    ``integrity.tree_fingerprint`` path convention — directly comparable
+    to a manifest fingerprint's leaf paths and to the replay bisector's
+    verdict), the flat element index, the bit, and the before/after
+    values. Deterministic: the leaf is chosen by ``seed`` among the
+    (optionally ``path_filter``-matching) float leaves, the element by a
+    seeded multiplicative hash (the ``corrupt_checkpoint`` idiom applied
+    to memory instead of disk). Sharding-preserving: the patched array
+    is ``device_put`` back under the leaf's own sharding, so a sharded
+    ZeRO/TP state survives the injection.
+
+    ``bit`` indexes from the LSB of the element's integer view; the
+    default 12 lands in a float32's low mantissa — a parts-per-thousand
+    value change that no loss-spike sentinel will notice, which is the
+    point: this is the silent-corruption shape only the replay referee
+    catches.
+    """
+    import jax
+    import numpy as np
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    candidates = [
+        (path, leaf) for path, leaf in flat
+        if np.issubdtype(np.asarray(leaf).dtype, np.floating)
+        and np.asarray(leaf).size > 0
+        and (path_filter is None
+             or path_filter in jax.tree_util.keystr(path))
+    ]
+    if not candidates:
+        raise ValueError(
+            f"no float leaf to flip (path_filter={path_filter!r})"
+        )
+    path, leaf = candidates[seed % len(candidates)]
+    keystr = jax.tree_util.keystr(path)
+    host = np.array(jax.device_get(leaf))
+    idx = (seed * 2654435761 + host.size // 2) % host.size
+    view = host.reshape(-1).view(
+        {2: np.uint16, 4: np.uint32, 8: np.uint64}[host.dtype.itemsize]
+    )
+    before = host.reshape(-1)[idx].item()
+    view[idx] ^= type(view[idx])(1) << bit
+    after = host.reshape(-1)[idx].item()
+    sharding = getattr(leaf, "sharding", None)
+    patched = (jax.device_put(host, sharding) if sharding is not None
+               else jax.device_put(host))
+    info = {
+        "path": keystr, "element": int(idx), "bit": int(bit),
+        "before": before, "after": after,
+        "dtype": str(host.dtype), "shape": list(host.shape),
+    }
+    logger.warning("chaos: bit-flipped %s[%d] bit %d (%r -> %r)",
+                   keystr, idx, bit, before, after)
+
+    def replace(p, l):
+        return patched if jax.tree_util.keystr(p) == keystr else l
+
+    return jax.tree_util.tree_map_with_path(replace, tree), info
 
 
 def _payload_files(step_dir: str):

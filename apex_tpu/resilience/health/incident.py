@@ -18,6 +18,12 @@ because the training thread is the one that is stuck:
 - **last verdicts** — the sentinel/rollback/preemption-shaped records
   filtered out of that tail, so the ladder's history is first-class in
   the bundle instead of buried in it;
+- **the journal tail** — the last flight-recorder records
+  (``kind="journal"``, resilience.replay) likewise filtered out of the
+  window: the steps, batches, and anchors the run executed as it
+  wedged, so a post-mortem can go straight from the bundle to
+  ``python -m apex_tpu.resilience.replay`` without hunting the sidecar
+  (``AutoResume.prepare_incident_exit`` flushes the sidecar itself);
 - **a best-effort profiler request** — arming the
   :class:`~apex_tpu.monitor.ProfilerTrigger` costs nothing and pays off
   whenever the loop is merely crawling rather than fully wedged (a
@@ -107,6 +113,9 @@ def capture_incident(
     verdicts = [
         r for r in tail_records if r.get("kind") in VERDICT_KINDS
     ][-8:]
+    journal_tail = [
+        r for r in tail_records if r.get("kind") == "journal"
+    ][-8:]
     profile_requested = False
     if trigger is not None:
         try:
@@ -125,6 +134,7 @@ def capture_incident(
         stacks=stacks,
         record_tail=tail_records,
         verdicts=verdicts,
+        journal_tail=journal_tail,
         profile_requested=profile_requested,
         **extra,
     )

@@ -1,0 +1,540 @@
+"""Replayable training targets: the ONE home of the GPT example's step.
+
+Bit-exact replay only works when the recorder and the replayer execute
+the SAME compiled computation — the same model, optimizer, scaler,
+sentinel, sharding, donation and chaos-injection plumbing, built from
+the same code. This module is that single home:
+``examples/gpt/pretrain_gpt.py`` builds its training step through
+:func:`build_gpt_training`, the flight recorder journals the
+:class:`GPTTargetConfig` in its header, and the replayer
+(``replayer.py``) rebuilds an identical step from that header — identity
+by construction, not by hoping two copies of the code stayed in sync.
+
+Everything numerical the example's step used to define inline lives
+here unchanged: the bf16 TP/SP GPT model, fused Adam or ZeRO-2
+``DistributedFusedAdam`` (``zero=True``), optional int8/fp8 compressed
+dp gradient sync with the error-feedback residual riding the opt-state
+slot, dynamic loss scaling with the dp-consensus ``found_inf`` under
+ZeRO, the anomaly sentinel gate through ``vma_cond``, the chaos
+``poison_loss`` arm, the escalation policy's ``lr_scale`` input, and
+the on-device MetricBag taps. New here: ``collect_layer_rms=True``
+additionally threads the per-layer ``layer_out_rms`` taps
+(monitor/taps.py) out of the step as a ``(layers,)`` fp32 vector — the
+depth series the divergence bisector localizes a corruption with.
+
+The step signature (``collect_layer_rms`` appends ``layer_rms`` to the
+outputs)::
+
+    (params, opt_state, scaler_state, sent_state, bag,
+     tokens, labels, inject_nan, lr_scale)
+      -> (params, opt_state, scaler_state, sent_state, bag,
+          loss, verdict[, layer_rms])
+
+``build_gpt_training`` initializes ``parallel_state`` (process-global,
+the example/CLI convention) and returns a :class:`GPTTraining` holding
+the jitted step plus the init recipes. The donating jit constructed
+here is an AUDITED entrypoint (allowlist ``lint.jit-donate`` entry; the
+GPT example verifies it with ``--audit-donation``).
+"""
+
+import dataclasses
+import functools
+import os
+import tempfile
+from typing import Any, Tuple
+
+import numpy as np
+
+__all__ = [
+    "GPTTargetConfig",
+    "GPTTraining",
+    "build_gpt_training",
+    "synthetic_corpus",
+]
+
+
+def synthetic_corpus(vocab: int, n_tokens: int = 200_000) -> str:
+    """Deterministic synthetic token corpus (seeded markov-ish stream).
+
+    Moved here from the GPT example so the replayer can REGENERATE the
+    recording run's data when the journal header says the corpus was
+    synthetic: same seed, same stream, verified per step by the journaled
+    ``batch_crc``.
+    """
+    from apex_tpu.data import write_token_file
+
+    tmp = tempfile.mkdtemp(prefix="apex_tpu_corpus_")
+    prefix = os.path.join(tmp, "synthetic")
+    rng = np.random.RandomState(0)
+    # markov-ish stream so the LM has structure to learn
+    toks = np.cumsum(rng.randint(1, 5, size=(n_tokens,)), dtype=np.int64) % vocab
+    write_token_file(prefix, toks.astype(np.int32))
+    return prefix
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTTargetConfig:
+    """Everything the compiled GPT step depends on — the journal-header
+    replay recipe. Field defaults mirror the example's CLI defaults."""
+
+    vocab: int = 512
+    seq_len: int = 128
+    layers: int = 4
+    hidden: int = 256
+    heads: int = 8
+    tp: int = 1
+    sequence_parallel: bool = True
+    micro_batch: int = 4
+    global_batch: int = 16
+    lr: float = 3e-4
+    weight_decay: float = 0.01
+    seed: int = 0
+    zero: bool = False
+    compression: str = "none"
+    compression_block: int = 128
+    spike_z: float = 6.0
+    spike_warmup: int = 10
+    skip_budget: int = 1
+    rollback_budget: int = 2
+    collect_layer_rms: bool = False
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: dict) -> "GPTTargetConfig":
+        """Tolerant of extra keys (an older replayer reading a newer
+        journal must fail on MISSING semantics, not added ones)."""
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})
+
+
+@dataclasses.dataclass
+class GPTTraining:
+    """The built pieces :func:`build_gpt_training` returns."""
+
+    cfg: GPTTargetConfig
+    mesh: Any
+    dp: int
+    num_micro: int
+    model: Any
+    transformer_config: Any
+    opt: Any
+    opt_specs: Any
+    scaler: Any
+    sentinel: Any
+    train_step: Any          # jitted + shard_mapped, donate_argnums (0..3)
+    metric_spec: dict
+    replicated: Any          # NamedSharding(mesh, P())
+    ddp_compressed: bool
+
+    def init_state(self) -> Tuple[Any, Any, Any, Any]:
+        """(params, opt_state, scaler_state, sent_state) — the donated
+        carried state, sharded exactly as the step expects (the example's
+        init block, verbatim)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.compat import shard_map
+
+        cfg = self.cfg
+        sample_tokens = jnp.zeros((cfg.micro_batch, cfg.seq_len), jnp.int32)
+
+        # tp-sharded init must run under the mesh like the step
+        @functools.partial(
+            shard_map, mesh=self.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        def init_params(tokens):
+            return self.model.init(jax.random.PRNGKey(cfg.seed), tokens)
+
+        params = init_params(sample_tokens)
+        # optimizer/scaler state is pinned to the SAME mesh-replicated
+        # sharding as the params: plain jit would leave its scalar leaves
+        # committed to device 0, which breaks the moment the state
+        # round-trips through a checkpoint (restored arrays are
+        # committed, and mixed device sets are a hard error)
+        if cfg.zero:
+            # ZeRO init needs the mesh axis (axis_index slices this
+            # rank's shard); the state leaves come out dp-sharded
+            # NamedShardings — the elastic restore's target layout
+            init_opt = functools.partial(
+                shard_map, mesh=self.mesh, in_specs=(P(),),
+                out_specs=self.opt_specs, check_vma=False,
+            )(self.opt.init)
+            opt_state = init_opt(params)
+        else:
+            opt_state = jax.jit(
+                self.opt.init, out_shardings=self.replicated
+            )(params)
+            if self.ddp_compressed:
+                # zero EF residuals, one per rank per param leaf (leading
+                # dp dim, dp-sharded — the opt_specs slot layout)
+                ef0 = jax.tree_util.tree_map(
+                    lambda p: jax.device_put(
+                        np.zeros((self.dp,) + tuple(p.shape), np.float32),
+                        jax.sharding.NamedSharding(self.mesh, P("dp")),
+                    ),
+                    params,
+                )
+                opt_state = {"opt": opt_state, "ef_residual": ef0}
+        scaler_state = jax.device_put(self.scaler.init(), self.replicated)
+        sent_state = jax.device_put(self.sentinel.init(), self.replicated)
+        return params, opt_state, scaler_state, sent_state
+
+    def init_bag(self):
+        """A fresh replicated on-device MetricBag."""
+        import jax
+
+        from apex_tpu import monitor
+
+        return jax.device_put(
+            monitor.metric_bag(self.metric_spec), self.replicated
+        )
+
+    def batch_struct(self):
+        """ShapeDtypeStruct of the (num_micro, micro*dp, seq) token/label
+        arrays the step consumes."""
+        import jax
+        import jax.numpy as jnp
+
+        return jax.ShapeDtypeStruct(
+            (self.num_micro, self.cfg.micro_batch * self.dp,
+             self.cfg.seq_len), jnp.int32,
+        )
+
+    def reshape_batch(self, x, y):
+        """Host (global_batch, seq) arrays -> the step's microbatch
+        layout."""
+        shape = (self.num_micro, self.cfg.micro_batch * self.dp,
+                 self.cfg.seq_len)
+        return x.reshape(shape), y.reshape(shape)
+
+
+def build_gpt_training(cfg: GPTTargetConfig) -> GPTTraining:
+    """Build the GPT training step (module docstring).
+
+    Initializes ``parallel_state`` for ``cfg.tp`` (process-global, like
+    the example always did) and validates the batch geometry with the
+    example's exact error messages.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import monitor, resilience
+    from apex_tpu.amp import GradScaler
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models import GPTModel, gpt_loss_fn
+    from apex_tpu.optimizers import fused_adam
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.parallel.ddp import all_reduce_gradients
+    from apex_tpu.parallel.utils import vma_cond
+    from apex_tpu.resilience import chaos
+    from apex_tpu.transformer import TransformerConfig, calc_params_l2_norm
+    from apex_tpu.utils.pytree import tree_any_non_finite
+
+    import optax
+
+    mesh = parallel_state.initialize_model_parallel(
+        tensor_model_parallel_size=cfg.tp
+    )
+    dp = parallel_state.get_data_parallel_world_size()
+    num_micro = cfg.global_batch // (cfg.micro_batch * dp)
+    assert num_micro >= 1, "global batch too small for micro batch x dp"
+    assert cfg.global_batch % (cfg.micro_batch * dp) == 0, (
+        f"global batch {cfg.global_batch} must divide evenly into "
+        f"micro_batch ({cfg.micro_batch}) x dp ({dp}) microbatches"
+    )
+
+    tcfg = TransformerConfig(
+        num_layers=cfg.layers,
+        hidden_size=cfg.hidden,
+        num_attention_heads=cfg.heads,
+        vocab_size=cfg.vocab,
+        max_position_embeddings=cfg.seq_len,
+        hidden_dropout=0.0,
+        attention_dropout=0.0,
+        sequence_parallel=cfg.sequence_parallel and cfg.tp > 1,
+        compute_dtype=jnp.bfloat16,
+        collect_layer_metrics=cfg.collect_layer_rms,
+    )
+    model = GPTModel(config=tcfg)
+
+    # --zero: the ZeRO-2 optimizer's psum_scatter IS the dp gradient sync
+    # (average_grads=True completes the mean), so the explicit dp
+    # all-reduce below is skipped; its state crosses the shard_map
+    # boundary dp-SHARDED (zero_state_specs) and the elastic restore
+    # regroups it across a dp-size change (docs/resilience.md)
+    # compression: the dp gradient sync travels block-scaled int8/fp8
+    # (parallel/compress.py). Under ZeRO the optimizer owns the
+    # compressed reduce-scatter AND its error-feedback residual (a state
+    # field); under plain DDP the residual rides in the opt_state SLOT as
+    # {"opt", "ef_residual"} so every checkpoint/rollback/restore site
+    # carries it opaquely
+    compress_cfg = None
+    if cfg.compression != "none":
+        from apex_tpu.parallel.compress import CompressionConfig
+
+        compress_cfg = CompressionConfig(
+            dtype=cfg.compression, block_size=cfg.compression_block
+        )
+    ddp_compressed = compress_cfg is not None and not cfg.zero
+    if cfg.zero:
+        from apex_tpu.optimizers import (
+            distributed_fused_adam, zero_state_specs,
+        )
+
+        opt = distributed_fused_adam(
+            lr=cfg.lr, weight_decay=cfg.weight_decay, axis_name="dp",
+            axis_size=dp, average_grads=True, compression=compress_cfg,
+        )
+        opt_specs = zero_state_specs("dp", compression=compress_cfg)
+    else:
+        opt = fused_adam(lr=cfg.lr, weight_decay=cfg.weight_decay)
+        # per-rank EF residuals cross the boundary with a leading dp dim
+        opt_specs = ({"opt": P(), "ef_residual": P("dp")}
+                     if ddp_compressed else P())
+    # under ZeRO the grads stay per-rank partials until the optimizer's
+    # reduce-scatter, so the overflow flag must join the dp consensus too
+    # (without it one rank could skip while the others step)
+    scaler = GradScaler(
+        loss_scale="dynamic",
+        model_parallel_axes=("tp", "pp", "dp") if cfg.zero else ("tp", "pp"),
+    )
+    sentinel = resilience.AnomalySentinel(
+        z_threshold=cfg.spike_z,
+        warmup_steps=cfg.spike_warmup,
+        skip_budget=cfg.skip_budget,
+        rollback_budget=cfg.rollback_budget,
+    )
+
+    # tp-replicated params (counted once in the tp-aware grad norm, not
+    # per rank): norms, position table, and row-parallel biases — the
+    # Megatron tensor_model_parallel-attribute convention
+    def tp_duplicated(path):
+        return ("layernorm" in path or "position_embeddings" in path
+                or path.endswith("dense/bias")
+                or path.endswith("dense_4h_to_h/bias"))
+
+    # in-step metric taps: every scalar the host wants to SEE (as opposed
+    # to branch on) accumulates on device and crosses once per interval
+    metric_spec = {
+        "loss": "mean",          # unscaled, dp-averaged
+        "grad_norm": "mean",     # global L2 of the unscaled grads
+        "loss_scale": "last",    # dynamic-scaler gauge
+        "loss_z": "last",        # sentinel z-score of this loss
+        "skipped": "sum",        # updates suppressed this interval
+        "anomalies": "last",     # sentinel's running total this run
+    }
+
+    out_specs = (P(), opt_specs, P(), P(), P(), P(), P())
+    if cfg.collect_layer_rms:
+        out_specs = out_specs + (P(),)
+
+    # donated carried state: params/opt/scaler/sentinel buffers are reused
+    # in place across the Python step loop instead of double-buffering the
+    # full parameter set in HBM. The metric bag is deliberately NOT
+    # donated: its leaves are a handful of scalars, and donating
+    # host-rebuilt interval resets risks buffer aliasing across leaves
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), opt_specs, P(), P(), P(), P(None, "dp"),
+                  P(None, "dp"), P(), P()),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def train_step(params, opt_state, scaler_state, sent_state, bag, tokens,
+                   labels, inject_nan, lr_scale):
+        if ddp_compressed:
+            # unpack the slot: adam state + this rank's EF residuals
+            # (leading dp dim sliced off by shard_map's in_specs)
+            ef = jax.tree_util.tree_map(
+                lambda e: e[0], opt_state["ef_residual"]
+            )
+            opt_state = opt_state["opt"]
+
+        # tokens: (num_micro, micro*dp, seq) -> this dp shard's microbatches
+        def micro_loss(p, tok, lab):
+            if not cfg.collect_layer_rms:
+                return gpt_loss_fn(model.apply(p, tok, labels=lab)), None
+            # per-layer activation-RMS taps (monitor/taps.py
+            # layer_out_rms): read via mutable intermediates, stacked
+            # into a (layers,) depth series — the divergence bisector's
+            # localization signal. The forward math is identical; only
+            # the sown scalars are additionally returned.
+            out, inter = model.apply(
+                p, tok, labels=lab, mutable=["intermediates"]
+            )
+            return gpt_loss_fn(out), _layer_rms_vector(
+                inter["intermediates"], cfg.layers
+            )
+
+        def scaled_total(p):
+            losses, rms = jax.vmap(
+                lambda t, l: micro_loss(p, t, l)
+            )(tokens, labels)
+            # multiplicative NaN poison (chaos harness): both the loss and
+            # every grad through it go non-finite, like a real blowup
+            scaled = chaos.poison_loss(
+                scaler.scale(scaler_state, jnp.mean(losses)), inject_nan
+            )
+            # carry MEAN-OF-SQUARES per layer (shape (layers,)): the sown
+            # rms is shard-local (this rank's dp batch slice, and under
+            # SP this rank's sequence slice), and equal-size shards mean
+            # the global mean-of-squares is just the pmean of the local
+            # ones — the sqrt happens after the cross-rank reduction
+            aux = (None if rms is None
+                   else jnp.mean(jnp.square(rms.astype(jnp.float32)),
+                                 axis=0))
+            return scaled, aux
+
+        # comms-ledger weighting: collectives inside the vmapped model
+        # (fwd AND the custom_vjp bwds) trace with per-MICROBATCH avals
+        # while the batched collective ships num_micro x the bytes
+        with monitor.xray.scaled(num_micro):
+            (loss, layer_rms), grads = jax.value_and_grad(
+                scaled_total, has_aux=True
+            )(params)
+        if layer_rms is not None:
+            # global per-layer RMS: mean-of-squares pmean'ed over both
+            # mesh axes (the out_specs claim P() replication, which the
+            # shard-local tap values would silently violate), then sqrt.
+            # Size-1 axes elide to nothing; ledger-routed so the comms
+            # prediction and the hlo differ both see the (tiny) traffic.
+            layer_rms = jnp.sqrt(
+                monitor.xray.ledger.pmean(
+                    monitor.xray.ledger.pmean(layer_rms, "tp"), "dp"
+                )
+            )
+        new_ef = None
+        if not cfg.zero:
+            # ZeRO's reduce-scatter inside opt.update replaces this
+            # all-reduce (feeding it pre-averaged grads would double-count)
+            if ddp_compressed:
+                # error-compensated quantized all-reduce: grads travel
+                # int8 + scales; non-finite grads poison the scales and
+                # still reach found_inf below (the exact consensus path)
+                grads, new_ef = all_reduce_gradients(
+                    grads, axis_name="dp", compression=compress_cfg,
+                    ef_state=ef,
+                )
+            else:
+                grads = all_reduce_gradients(grads, axis_name="dp")
+        grads, found_inf = scaler.unscale(scaler_state, grads)
+        # the scaler's dynamic schedule reacts to true overflow only; the
+        # sentinel's spike gate must NOT halve the scale (a spike is not a
+        # precision problem)
+        new_scaler_state = scaler.update(scaler_state, found_inf)
+
+        # the loss is tp-replicated even under SP: model.apply gathers the
+        # sequence before the head and vocab_parallel_cross_entropy psums
+        # over tp internally — only the dp average is needed
+        unscaled = monitor.xray.ledger.pmean(loss / scaler_state.scale, "dp")
+        gate = jnp.logical_or(
+            found_inf, sentinel.is_anomalous_loss(sent_state, unscaled)
+        )
+
+        # the skip must gate the OPTIMIZER STATE too: opt.update on inf
+        # grads would fold inf into the Adam moments permanently, nan-ing
+        # every later step even after the scaler backs off — same
+        # both-or-neither rule as AmpOptimizer.step
+        def apply():
+            updates, new_opt = opt.update(grads, opt_state, params)
+            # rollback escalation dampens the effective LR through here
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+            return optax.apply_updates(params, updates), new_opt
+
+        new_params, new_opt_state = vma_cond(
+            gate, lambda: (params, opt_state), apply
+        )
+        if ddp_compressed:
+            # the residual updates even on gated steps (poisoned leaves
+            # RESET inside ef_update, so a skipped step cannot freeze a
+            # NaN residual); re-pack with the leading dp dim restored
+            new_opt_state = {
+                "opt": new_opt_state,
+                "ef_residual": jax.tree_util.tree_map(
+                    lambda e: e[None], new_ef
+                ),
+            }
+        new_sent_state, verdict = sentinel.update(
+            sent_state, unscaled, anomaly=gate,
+            bad_params=tree_any_non_finite(new_params),
+        )
+        # metric taps: cheap scalars folded into the on-device bag; the
+        # z-score reuses the sentinel's pre-update EMA/var, so the record
+        # shows exactly the statistic the verdict was computed from
+        new_bag = bag.add(
+            loss=unscaled,
+            # tp-AWARE global norm: grads of tp-sharded weights are local
+            # shards inside shard_map, so the partial sums psum over tp
+            # (replicated params counted on rank 0 only); a plain
+            # global_grad_norm here would report one shard's norm
+            grad_norm=calc_params_l2_norm(
+                grads, tp_duplicate_predicate=tp_duplicated, axis_name="tp"
+            ),
+            loss_scale=new_scaler_state.scale,
+            loss_z=jnp.where(
+                sent_state.count > 0,  # cold-start var=0 makes z garbage
+                (unscaled - sent_state.ema)
+                * jax.lax.rsqrt(sent_state.var + 1e-12),
+                0.0,
+            ),
+            skipped=jnp.asarray(gate, jnp.float32),
+            anomalies=jnp.asarray(new_sent_state.anomalies, jnp.float32),
+        )
+        out = (new_params, new_opt_state, new_scaler_state, new_sent_state,
+               new_bag, unscaled, verdict)
+        if cfg.collect_layer_rms:
+            out = out + (layer_rms,)
+        return out
+
+    return GPTTraining(
+        cfg=cfg, mesh=mesh, dp=dp, num_micro=num_micro, model=model,
+        transformer_config=tcfg, opt=opt, opt_specs=opt_specs,
+        scaler=scaler, sentinel=sentinel, train_step=train_step,
+        metric_spec=metric_spec,
+        replicated=jax.sharding.NamedSharding(mesh, P()),
+        ddp_compressed=ddp_compressed,
+    )
+
+
+def _layer_rms_vector(intermediates, n_layers: int):
+    """Stack the per-layer ``layer_out_rms`` sows into a (layers,) vector
+    in DEPTH order (natural sort on the module-path digits — flax names
+    layers ``..._10`` after ``..._9``, and lexicographic order would
+    interleave them)."""
+    import re
+
+    import jax.numpy as jnp
+
+    found = []
+
+    def visit(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                visit(v, path + (str(k),))
+            return
+        if path and path[-1] == "layer_out_rms":
+            vals = node if isinstance(node, (tuple, list)) else (node,)
+            for v in vals:
+                found.append(("/".join(path), v))
+
+    visit(intermediates, ())
+
+    def natural(key):
+        return [int(t) if t.isdigit() else t
+                for t in re.split(r"(\d+)", key[0])]
+
+    found.sort(key=natural)
+    if len(found) != n_layers:
+        raise ValueError(
+            f"expected {n_layers} layer_out_rms taps, found {len(found)} "
+            f"({[p for p, _ in found]}) — did a layer refactor rename the "
+            f"tap registered in monitor/taps.py?"
+        )
+    return jnp.stack([jnp.asarray(v, jnp.float32) for _, v in found])

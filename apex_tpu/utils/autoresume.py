@@ -191,6 +191,7 @@ class AutoResume:
         grace_s: Optional[float] = None,
         mesh=None,
         background_finalize: bool = True,
+        journal=None,
     ):
         self.directory = os.path.abspath(directory)
         self.interval = interval
@@ -211,6 +212,12 @@ class AutoResume:
         self.leaf_fingerprint = leaf_fingerprint
         self.grace_s = grace_s if grace_s is not None else _env_grace()
         self.mesh = mesh
+        # optional flight recorder (resilience.replay.FlightRecorder, or
+        # anything with .anchor/.event/.flush): every save becomes a
+        # replay ANCHOR, and the sidecar is flushed wherever a manifest
+        # commits — the journal is durable exactly when the checkpoint
+        # is, including the termination-save and incident-exit paths
+        self.journal = journal
         # async VERIFIED checkpointing (module docstring): overlapped
         # interval saves verify + commit their manifest on the writer's
         # background finalize thread. False restores the pre-incident
@@ -322,6 +329,11 @@ class AutoResume:
             )
             if self.keep_last_n is not None:
                 integrity.apply_retention(self.directory, self.keep_last_n)
+        if self.journal is not None:
+            # the checkpoint is now durable — make its journal anchor
+            # durable too (sidecar fsync; may run on the background
+            # finalize thread, FlightRecorder is thread-safe)
+            self.journal.flush()
         if self._pending is pending:
             self._pending = None
 
@@ -415,6 +427,13 @@ class AutoResume:
 
     def _save(self, step: int, state: Any, durable: bool) -> None:
         integrity = self._integrity()
+        if self.journal is not None:
+            # replay-anchor convention: the checkpoint labeled ``step``
+            # holds the state ENTERING step ``step`` (the caller passes
+            # the post-step state as step+1). The replayer re-verifies
+            # the manifest before trusting the anchor, so recording at
+            # issuance (before the async commit lands) is safe.
+            self.journal.anchor(step)
         if not self.use_async:
             t0 = time.monotonic()
             with _goodput_span("ckpt_save", step=step):
@@ -506,6 +525,12 @@ class AutoResume:
                 )
             except OSError as e:
                 logger.warning("abandoned-marker write failed: %s", e)
+        if self.journal is not None:
+            # the anchor recorded at issuance now points at a tombstoned
+            # dir (the replayer's verification rejects it anyway) — note
+            # the abandonment for forensics and make the journal durable
+            self.journal.event(self._abandoned_step, "anchor_abandoned")
+            self.journal.flush()
 
     def prepare_incident_exit(self) -> Optional[int]:
         """Bounded preparation for an incident self-termination.
@@ -521,6 +546,11 @@ class AutoResume:
         abandoned step, or None when nothing was pending.
         """
         if self._pending is None:
+            if self.journal is not None:
+                # even with nothing pending, the incident post-mortem
+                # needs the journal durable (the wedged main thread may
+                # never reach the recorder's own close)
+                self.journal.flush()
             return None
         self._abandon_pending()
         return self._abandoned_step

@@ -27,6 +27,19 @@ back past torn or bit-flipped step dirs. ``--chaos-*`` flags inject all
 three fault classes so the whole recovery ladder is drivable from the
 command line:
 
+Replay & forensics (apex_tpu.resilience.replay, docs/resilience.md
+"Replay & forensics"): with ``--save`` the run journals by default — the
+training step itself is built by the ONE shared builder
+(``resilience.replay.targets.build_gpt_training``, recorded in the
+journal header), every step's batch ids/crc + chaos arms + lr_scale +
+loss/verdict/layer_rms fingerprints land as ``kind="journal"`` records
+plus the ``<save>/replay-journal.jsonl`` sidecar, and every checkpoint
+is a replay anchor. A flagged run is then mechanically reproducible:
+``python -m apex_tpu.resilience.replay <save-dir> --bisect`` re-executes
+from the nearest verified checkpoint and pins a divergence to the step
+and leaf (drivable here with ``--chaos-bitflip-step``, the silent
+in-memory corruption the sentinel misses).
+
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \\
     python examples/gpt/pretrain_gpt.py --steps 12 --hidden 64 --layers 2 \\
         --seq-len 64 --micro-batch 2 --global-batch 16 --save /tmp/ck \\
@@ -40,7 +53,6 @@ CPU smoke (8 virtual devices, synthetic corpus):
 """
 
 import argparse
-import functools
 import os
 import tempfile
 import time
@@ -48,8 +60,6 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
-from apex_tpu.compat import shard_map
-from jax.sharding import PartitionSpec as P
 
 
 def parse_args():
@@ -105,6 +115,21 @@ def parse_args():
     p.add_argument("--compression-block", type=int, default=128,
                    help="elements per fp32 scale block for --compression")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--journal", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="flight-recorder journaling "
+                        "(apex_tpu.resilience.replay): per-step batch "
+                        "ids/crc, chaos arms, lr_scale, and "
+                        "loss/verdict/layer_rms fingerprints as "
+                        "kind='journal' records + the "
+                        "<save>/replay-journal.jsonl sidecar; every "
+                        "checkpoint becomes a replay anchor and the "
+                        "per-layer rms taps turn on. Default: on when "
+                        "--save is set, RECORDING the current numerics "
+                        "flags (--no-journal to disable); passing "
+                        "--journal explicitly also PINS the "
+                        "determinism_guard flags (matmul 'highest', x64 "
+                        "off) for cross-setup stability")
     # resilience policy (apex_tpu.resilience; docs/resilience.md)
     p.add_argument("--spike-z", type=float, default=6.0,
                    help="loss z-score above the running EMA that counts as a spike")
@@ -212,41 +237,36 @@ def parse_args():
     p.add_argument("--chaos-corrupt-latest", default="none",
                    choices=["none", "bitflip", "truncate"],
                    help="corrupt the newest checkpoint BEFORE restoring")
+    p.add_argument("--chaos-bitflip-step", type=int, default=None,
+                   help="flip one low-mantissa bit of one live param "
+                        "leaf in memory AFTER this step (silent "
+                        "corruption: the sentinel misses it and the next "
+                        "checkpoint faithfully saves it — only "
+                        "'python -m apex_tpu.resilience.replay --bisect' "
+                        "can pin it)")
+    p.add_argument("--chaos-bitflip-bit", type=int, default=12,
+                   help="bit index (from the LSB) for "
+                        "--chaos-bitflip-step")
     return p.parse_args()
-
-
-def synthetic_corpus(vocab: int, n_tokens: int = 200_000):
-    from apex_tpu.data import write_token_file
-
-    tmp = tempfile.mkdtemp(prefix="apex_tpu_corpus_")
-    prefix = os.path.join(tmp, "synthetic")
-    rng = np.random.RandomState(0)
-    # markov-ish stream so the LM has structure to learn
-    toks = np.cumsum(rng.randint(1, 5, size=(n_tokens,)), dtype=np.int64) % vocab
-    write_token_file(prefix, toks.astype(np.int32))
-    return prefix
 
 
 def main():
     args = parse_args()
-    from apex_tpu.amp import GradScaler
     from apex_tpu.data import (
         IndexedTokenDataset, LMDataset, MegatronPretrainingSampler,
         RobustBatches,
     )
-    from apex_tpu.models import GPTModel, gpt_loss_fn
-    from apex_tpu.optimizers import fused_adam
-    from apex_tpu.parallel import parallel_state
-    from apex_tpu.parallel.ddp import all_reduce_gradients
-    from apex_tpu.parallel.utils import vma_cond
-    from apex_tpu.transformer import TransformerConfig, calc_params_l2_norm
     from apex_tpu.utils import AutoResume, Timers, step_annotation
-    from apex_tpu.utils.pytree import tree_any_non_finite
     from apex_tpu import monitor, resilience
     from apex_tpu.monitor import goodput
     from apex_tpu.resilience import chaos
-
-    import optax
+    from apex_tpu.resilience.replay import (
+        FlightRecorder, batch_crc, journal_path,
+    )
+    from apex_tpu.resilience.replay.replayer import determinism_guard
+    from apex_tpu.resilience.replay.targets import (
+        GPTTargetConfig, build_gpt_training, synthetic_corpus,
+    )
 
     # host half of the telemetry, FIRST: one router, every producer
     # (metric bag, timers, anomaly stream, goodput spans) emits the same
@@ -297,270 +317,75 @@ def main():
     goodput.set_router(router)
     init_span = goodput.begin_span("init")
 
-    mesh = parallel_state.initialize_model_parallel(
-        tensor_model_parallel_size=args.tp
+    # flight-recorder journaling (apex_tpu.resilience.replay): default on
+    # when the run has the checkpoints replay anchors to. The
+    # determinism_guard records the numerics flags (matmul precision,
+    # x64) BEFORE any compile so the replayer can apply the identical
+    # ones — and only PINS them when --journal was passed explicitly:
+    # merely adding --save must never change a run's compiled numerics
+    # (same-platform bitwise replay needs matching flags, not any
+    # particular value).
+    journal_on = (args.journal if args.journal is not None
+                  else bool(args.save))
+    guard_flags = (determinism_guard(pin=args.journal is True)
+                   if journal_on else {})
+
+    # the training step itself comes from the ONE shared builder the
+    # replayer also uses (resilience/replay/targets.py): identical
+    # compiled computation by construction, not by code duplication
+    tcfg = GPTTargetConfig(
+        vocab=args.vocab, seq_len=args.seq_len, layers=args.layers,
+        hidden=args.hidden, heads=args.heads, tp=args.tp,
+        sequence_parallel=args.sequence_parallel,
+        micro_batch=args.micro_batch, global_batch=args.global_batch,
+        lr=args.lr, seed=args.seed, zero=args.zero,
+        compression=args.compression,
+        compression_block=args.compression_block,
+        spike_z=args.spike_z, spike_warmup=args.spike_warmup,
+        skip_budget=args.skip_budget,
+        rollback_budget=args.rollback_budget,
+        collect_layer_rms=journal_on,
     )
-    dp = parallel_state.get_data_parallel_world_size()
+    training = build_gpt_training(tcfg)
+    mesh, dp, num_micro = training.mesh, training.dp, training.num_micro
+    train_step = training.train_step
+    replicated = training.replicated
+    ddp_compressed = training.ddp_compressed
     print(f"mesh: dp={dp} tp={args.tp} devices={len(jax.devices())}")
 
     prefix = args.corpus or synthetic_corpus(args.vocab)
     lm = LMDataset(IndexedTokenDataset(prefix), seq_len=args.seq_len)
-    num_micro = args.global_batch // (args.micro_batch * dp)
-    assert num_micro >= 1, "global batch too small for micro batch x dp"
-    assert args.global_batch % (args.micro_batch * dp) == 0, (
-        f"global batch {args.global_batch} must divide evenly into "
-        f"micro_batch ({args.micro_batch}) x dp ({dp}) microbatches"
-    )
 
-    cfg = TransformerConfig(
-        num_layers=args.layers,
-        hidden_size=args.hidden,
-        num_attention_heads=args.heads,
-        vocab_size=args.vocab,
-        max_position_embeddings=args.seq_len,
-        hidden_dropout=0.0,
-        attention_dropout=0.0,
-        sequence_parallel=args.sequence_parallel and args.tp > 1,
-        compute_dtype=jnp.bfloat16,
-    )
-    model = GPTModel(config=cfg)
-
-    sample_tokens = jnp.zeros((args.micro_batch, args.seq_len), jnp.int32)
-
-    # --zero: the ZeRO-2 optimizer's psum_scatter IS the dp gradient sync
-    # (average_grads=True completes the mean), so the explicit dp
-    # all-reduce below is skipped; its state crosses the shard_map
-    # boundary dp-SHARDED (zero_state_specs) and the elastic restore
-    # regroups it across a dp-size change (docs/resilience.md)
-    # --compression: the dp gradient sync travels block-scaled int8/fp8
-    # (parallel/compress.py). Under --zero the optimizer owns the
-    # compressed reduce-scatter AND its error-feedback residual (a state
-    # field); under plain DDP the residual rides in the opt_state SLOT as
-    # {"opt", "ef_residual"} so every checkpoint/rollback/restore site
-    # carries it opaquely — the manifest's ef marker makes the elastic
-    # restore reset (never refuse) it across a topology change
-    compress_cfg = None
-    if args.compression != "none":
-        from apex_tpu.parallel.compress import CompressionConfig
-
-        compress_cfg = CompressionConfig(
-            dtype=args.compression, block_size=args.compression_block
+    recorder = None
+    if journal_on:
+        # sidecar next to the checkpoints when --save is set (flushed
+        # with every manifest commit); kind="journal" records join the
+        # router stream either way
+        recorder = FlightRecorder(
+            journal_path(args.save) if args.save else None, router=router
         )
-    ddp_compressed = compress_cfg is not None and not args.zero
-    if args.zero:
-        from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
-
-        opt = distributed_fused_adam(
-            lr=args.lr, weight_decay=0.01, axis_name="dp", axis_size=dp,
-            average_grads=True, compression=compress_cfg,
-        )
-        opt_specs = zero_state_specs("dp", compression=compress_cfg)
-    else:
-        opt = fused_adam(lr=args.lr, weight_decay=0.01)
-        # per-rank EF residuals cross the boundary with a leading dp dim
-        opt_specs = ({"opt": P(), "ef_residual": P("dp")}
-                     if ddp_compressed else P())
-    # under ZeRO the grads stay per-rank partials until the optimizer's
-    # reduce-scatter, so the overflow flag must join the dp consensus too
-    # (without it one rank could skip while the others step)
-    scaler = GradScaler(
-        loss_scale="dynamic",
-        model_parallel_axes=("tp", "pp", "dp") if args.zero else ("tp", "pp"),
-    )
-    sentinel = resilience.AnomalySentinel(
-        z_threshold=args.spike_z,
-        warmup_steps=args.spike_warmup,
-        skip_budget=args.skip_budget,
-        rollback_budget=args.rollback_budget,
-    )
-
-    # tp-replicated params (counted once in the tp-aware grad norm, not
-    # per rank): norms, position table, and row-parallel biases — the
-    # Megatron tensor_model_parallel-attribute convention
-    def tp_duplicated(path):
-        return ("layernorm" in path or "position_embeddings" in path
-                or path.endswith("dense/bias")
-                or path.endswith("dense_4h_to_h/bias"))
-
-    # in-step metric taps: every scalar the host wants to SEE (as opposed
-    # to branch on) accumulates on device and crosses once per interval
-    METRIC_SPEC = {
-        "loss": "mean",          # unscaled, dp-averaged
-        "grad_norm": "mean",     # global L2 of the unscaled grads
-        "loss_scale": "last",    # dynamic-scaler gauge
-        "loss_z": "last",        # sentinel z-score of this loss
-        "skipped": "sum",        # updates suppressed this interval
-        "anomalies": "last",     # sentinel's running total this run
-    }
-
-    # donated carried state: params/opt/scaler/sentinel buffers are reused
-    # in place across the Python step loop instead of double-buffering the
-    # full parameter set in HBM (the torch reference mutates in place for
-    # free; under jit, donation is the explicit equivalent). The metric
-    # bag is deliberately NOT donated: its leaves are a handful of
-    # scalars (no HBM to save), and donating host-rebuilt interval resets
-    # risks buffer aliasing across leaves
-    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-    @functools.partial(
-        shard_map,
-        mesh=mesh,
-        in_specs=(P(), opt_specs, P(), P(), P(), P(None, "dp"),
-                  P(None, "dp"), P(), P()),
-        out_specs=(P(), opt_specs, P(), P(), P(), P(), P()),
-        check_vma=False,
-    )
-    def train_step(params, opt_state, scaler_state, sent_state, bag, tokens,
-                   labels, inject_nan, lr_scale):
-        if ddp_compressed:
-            # unpack the slot: adam state + this rank's EF residuals
-            # (leading dp dim sliced off by shard_map's in_specs)
-            ef = jax.tree_util.tree_map(
-                lambda e: e[0], opt_state["ef_residual"]
-            )
-            opt_state = opt_state["opt"]
-
-        # tokens: (num_micro, micro*dp, seq) -> this dp shard's microbatches
-        def micro_loss(p, tok, lab):
-            return gpt_loss_fn(model.apply(p, tok, labels=lab))
-
-        def scaled_total(p):
-            losses = jax.vmap(lambda t, l: micro_loss(p, t, l))(tokens, labels)
-            # multiplicative NaN poison (chaos harness): both the loss and
-            # every grad through it go non-finite, like a real blowup
-            return chaos.poison_loss(
-                scaler.scale(scaler_state, jnp.mean(losses)), inject_nan
-            )
-
-        # comms-ledger weighting: collectives inside the vmapped model
-        # (fwd AND the custom_vjp bwds) trace with per-MICROBATCH avals
-        # while the batched collective ships num_micro x the bytes
-        with monitor.xray.scaled(num_micro):
-            loss, grads = jax.value_and_grad(scaled_total)(params)
-        new_ef = None
-        if not args.zero:
-            # ZeRO's reduce-scatter inside opt.update replaces this
-            # all-reduce (feeding it pre-averaged grads would double-count)
-            if ddp_compressed:
-                # error-compensated quantized all-reduce: grads travel
-                # int8 + scales; non-finite grads poison the scales and
-                # still reach found_inf below (the exact consensus path)
-                grads, new_ef = all_reduce_gradients(
-                    grads, axis_name="dp", compression=compress_cfg,
-                    ef_state=ef,
-                )
-            else:
-                grads = all_reduce_gradients(grads, axis_name="dp")
-        grads, found_inf = scaler.unscale(scaler_state, grads)
-        # the scaler's dynamic schedule reacts to true overflow only; the
-        # sentinel's spike gate must NOT halve the scale (a spike is not a
-        # precision problem)
-        new_scaler_state = scaler.update(scaler_state, found_inf)
-
-        # the loss is tp-replicated even under SP: model.apply gathers the
-        # sequence before the head and vocab_parallel_cross_entropy psums
-        # over tp internally — only the dp average is needed (verified
-        # empirically: tp=2 SP and non-SP local losses are identical)
-        unscaled = monitor.xray.ledger.pmean(loss / scaler_state.scale, "dp")
-        gate = jnp.logical_or(
-            found_inf, sentinel.is_anomalous_loss(sent_state, unscaled)
+        recorder.header(
+            run_id, "gpt", config=tcfg.to_json(),
+            corpus={"prefix": prefix,
+                    **({} if args.corpus
+                       else {"synthetic": {"vocab": args.vocab,
+                                           "n_tokens": 200_000}})},
+            devices=len(jax.devices()), steps=args.steps, **guard_flags,
         )
 
-        # the skip must gate the OPTIMIZER STATE too: opt.update on inf
-        # grads would fold inf into the Adam moments permanently (m =
-        # 0.9*m + 0.1*inf), nan-ing every later step even after the scaler
-        # backs off — same both-or-neither rule as AmpOptimizer.step
-        def apply():
-            updates, new_opt = opt.update(grads, opt_state, params)
-            # rollback escalation dampens the effective LR through here
-            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
-            return optax.apply_updates(params, updates), new_opt
-
-        new_params, new_opt_state = vma_cond(
-            gate, lambda: (params, opt_state), apply
-        )
-        if ddp_compressed:
-            # the residual updates even on gated steps (poisoned leaves
-            # RESET inside ef_update, so a skipped step cannot freeze a
-            # NaN residual); re-pack with the leading dp dim restored
-            new_opt_state = {
-                "opt": new_opt_state,
-                "ef_residual": jax.tree_util.tree_map(
-                    lambda e: e[None], new_ef
-                ),
-            }
-        new_sent_state, verdict = sentinel.update(
-            sent_state, unscaled, anomaly=gate,
-            bad_params=tree_any_non_finite(new_params),
-        )
-        # metric taps: cheap scalars folded into the on-device bag; the
-        # z-score reuses the sentinel's pre-update EMA/var, so the record
-        # shows exactly the statistic the verdict was computed from
-        new_bag = bag.add(
-            loss=unscaled,
-            # tp-AWARE global norm: grads of tp-sharded weights are local
-            # shards inside shard_map, so the partial sums psum over tp
-            # (replicated params counted on rank 0 only); a plain
-            # global_grad_norm here would report one shard's norm
-            grad_norm=calc_params_l2_norm(
-                grads, tp_duplicate_predicate=tp_duplicated, axis_name="tp"
-            ),
-            loss_scale=new_scaler_state.scale,
-            loss_z=jnp.where(
-                sent_state.count > 0,  # cold-start var=0 makes z garbage
-                (unscaled - sent_state.ema)
-                * jax.lax.rsqrt(sent_state.var + 1e-12),
-                0.0,
-            ),
-            skipped=jnp.asarray(gate, jnp.float32),
-            anomalies=jnp.asarray(new_sent_state.anomalies, jnp.float32),
-        )
-        return (new_params, new_opt_state, new_scaler_state, new_sent_state,
-                new_bag, unscaled, verdict)
-
-    # tp-sharded init must run under the mesh like the step
-    @functools.partial(
-        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
-    )
-    def init_params(tokens):
-        return model.init(jax.random.PRNGKey(args.seed), tokens)
-
-    params = init_params(sample_tokens)
-    # optimizer/scaler state is pinned to the SAME mesh-replicated sharding
-    # as the params: plain jit would leave its scalar leaves committed to
-    # device 0, which works transiently (jit auto-moves) but breaks the
-    # moment the state round-trips through a checkpoint — restored arrays
-    # are committed, and mixed device sets are a hard error
-    replicated = jax.sharding.NamedSharding(mesh, P())
-    if args.zero:
-        # ZeRO init needs the mesh axis (axis_index slices this rank's
-        # shard); the state leaves come out dp-sharded NamedShardings —
-        # exactly the layout the elastic restore needs as its target
-        init_opt = functools.partial(
-            shard_map, mesh=mesh, in_specs=(P(),), out_specs=opt_specs,
-            check_vma=False,
-        )(opt.init)
-        opt_state = init_opt(params)
-    else:
-        opt_state = jax.jit(opt.init, out_shardings=replicated)(params)
-        if ddp_compressed:
-            # zero EF residuals, one per rank per param leaf (leading dp
-            # dim, dp-sharded — the opt_specs slot layout above)
-            ef0 = jax.tree_util.tree_map(
-                lambda p: jax.device_put(
-                    np.zeros((dp,) + tuple(p.shape), np.float32),
-                    jax.sharding.NamedSharding(mesh, P("dp")),
-                ),
-                params,
-            )
-            opt_state = {"opt": opt_state, "ef_residual": ef0}
-    scaler_state = jax.device_put(scaler.init(), replicated)
-    sent_state = jax.device_put(sentinel.init(), replicated)
-    bag = jax.device_put(monitor.metric_bag(METRIC_SPEC), replicated)
+    # model/optimizer/scaler/sentinel and the donated train_step all come
+    # from the shared builder above (resilience/replay/targets.py — the
+    # --zero / --compression / sentinel semantics live there now, next to
+    # the replayer that must rebuild them identically)
+    params, opt_state, scaler_state, sent_state = training.init_state()
+    bag = training.init_bag()
 
     # analytic model FLOPs for MFU/throughput (docs/observability.md);
     # peak is None off-TPU unless APEX_TPU_PEAK_FLOPS pins it, and the
     # mfu field is then emitted as null rather than against a fake peak
-    flops_per_token = monitor.gpt_flops_per_token(cfg, args.seq_len)
+    flops_per_token = monitor.gpt_flops_per_token(
+        training.transformer_config, args.seq_len
+    )
     tokens_per_step = args.global_batch * args.seq_len
     peak_flops = monitor.peak_flops_per_device()
 
@@ -597,11 +422,16 @@ def main():
     # mesh= routes a topology-changed restore through the elastic
     # resharder (8-chip checkpoint resumed on 4, dp-sharded ZeRO state
     # regrouped); grace_s= arms the deadline-budgeted termination save
+    # journal= makes every AutoResume save a replay ANCHOR (journal
+    # anchor record + sidecar fsync at the manifest commit), and the
+    # termination/incident paths flush the sidecar so post-mortem replay
+    # works after exit-43 and preemption, not just clean runs
     ar = (
         AutoResume(args.save, interval=args.save_interval,
                    keep_last_n=args.keep_last_n, mesh=mesh,
                    grace_s=args.grace_s,
-                   background_finalize=args.background_finalize)
+                   background_finalize=args.background_finalize,
+                   journal=recorder)
         if args.save else None
     )
     step0 = 0
@@ -654,6 +484,11 @@ def main():
                       f"docs/resilience.md)")
         if step0:
             print(f"resumed from step {step0}")
+    if recorder is not None:
+        # the segment start: a fresh run's init state is reconstructable
+        # from the seed (init=True anchor); a resumed run anchors on the
+        # verified checkpoint it restored
+        recorder.anchor(step0, init=(step0 == 0))
 
     # hung-job defense (apex_tpu.resilience.health, docs/resilience.md
     # "Incident response"): warn -> forensic kind="incident" dump ->
@@ -822,6 +657,11 @@ def main():
         ),
         slow_steps=args.chaos_slow_steps,
         slow_s=args.chaos_slow_s,
+        bitflip_steps=(
+            {args.chaos_bitflip_step}
+            if args.chaos_bitflip_step is not None else frozenset()
+        ),
+        bitflip_bit=args.chaos_bitflip_bit,
     )
 
     # the sampler's own resume mechanism picks the data stream up exactly
@@ -842,8 +682,18 @@ def main():
     # metrics records); blowing --data-skip-budget raises — silent
     # infinite skipping is the failure mode, not the fix. Reads `it`
     # late-bound so the rollback path's iterator rewind stays effective.
-    batches = RobustBatches(lambda: lm.batch(next(it)),
-                            max_skips=args.data_skip_budget)
+    # The loader surfaces the sample ids it ACTUALLY consumed (last_ids)
+    # so the journal records them per step: a skipped batch shifts every
+    # subsequent one, and replay must fetch the journaled ids, not re-run
+    # the skip history.
+    last_ids = []
+
+    def load_batch():
+        ids = list(next(it))
+        last_ids[:] = ids
+        return lm.batch(ids)
+
+    batches = RobustBatches(load_batch, max_skips=args.data_skip_budget)
     # seed the ring so an anomaly before the first cadence point can still
     # roll back instead of escalating straight to halt
     mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
@@ -857,9 +707,15 @@ def main():
         # host blocked on the input pipeline = data_wait badput; the
         # robust loader skips-and-counts flaky loads inside the span
         with goodput.span("data_wait", step=step_i):
-            x, y = batches()
-            x = x.reshape(num_micro, args.micro_batch * dp, args.seq_len)
-            y = y.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+            x0, y0 = batches()
+            x = x0.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+            y = y0.reshape(num_micro, args.micro_batch * dp, args.seq_len)
+        batch_ids = list(last_ids)
+        # the crc fingerprints the batch CONTENT (journal.batch_crc): a
+        # replay re-fetching these ids must see these bytes
+        crc = batch_crc(x0, y0) if recorder is not None else None
+        nan_armed = plan.take_nan(step_i)
+        lr_scale_now = mgr.lr_scale
         trigger.maybe_start(step_i)
         # run-level span: the first call is compile-dominated (no AOT
         # split exists for the jit step), so it books as compile badput;
@@ -872,13 +728,21 @@ def main():
             # the step's device tail out of the next step's span
             with step_annotation(step_i):
                 timers("step").start()
-                (params, opt_state, scaler_state, sent_state, bag, loss,
-                 verdict) = train_step(
+                out = train_step(
                     params, opt_state, scaler_state, sent_state, bag,
                     jnp.asarray(x), jnp.asarray(y),
-                    jnp.asarray(plan.take_nan(step_i), jnp.float32),
-                    jnp.asarray(mgr.lr_scale, jnp.float32),
+                    jnp.asarray(nan_armed, jnp.float32),
+                    jnp.asarray(lr_scale_now, jnp.float32),
                 )
+                # journaling mode appends the per-layer rms vector to the
+                # step outputs (targets.build_gpt_training)
+                if journal_on:
+                    (params, opt_state, scaler_state, sent_state, bag,
+                     loss, verdict, layer_rms) = out
+                else:
+                    (params, opt_state, scaler_state, sent_state, bag,
+                     loss, verdict) = out
+                    layer_rms = None
                 # the loss/verdict fetch below is the step's host sync
                 # point, so the profiler window closes on completed work
                 timers("step").stop(barrier_on=loss)
@@ -901,10 +765,39 @@ def main():
         if responder is not None:
             responder.beat(step_i)
         verdict_code = int(verdict)  # ONE fetch; reused below (relay RTT)
+        loss_f = float(loss)         # likewise: resolve + journal share it
         trigger.on_verdict(step_i, verdict_code)
         trigger.maybe_stop(step_i)
+        if recorder is not None:
+            # everything a replay needs to re-execute THIS step (batch
+            # ids + content crc, chaos arm, lr damping) and the output
+            # fingerprints it will be compared against; the sequential
+            # sampler yields contiguous ranges, stored compactly
+            contiguous = batch_ids == list(
+                range(batch_ids[0], batch_ids[-1] + 1))
+            recorder.step(
+                step_i,
+                batch=([batch_ids[0], batch_ids[-1] + 1]
+                       if contiguous else None),
+                batch_ids=(None if contiguous else batch_ids),
+                batch_crc=crc, inject_nan=nan_armed,
+                lr_scale=lr_scale_now, loss=loss_f, verdict=verdict_code,
+                loss_scale=float(scaler_state.scale),
+                layer_rms=np.asarray(layer_rms),
+                data_skipped=batches.skipped,
+            )
+        # chaos: silent in-memory corruption, applied AFTER the step so
+        # the next checkpoint faithfully saves it (bitflip_leaf): the
+        # sentinel stays quiet, the run completes — only the replay
+        # bisector can pin it to this boundary and this leaf
+        params, flip_info = plan.maybe_bitflip(step_i, params)
+        if flip_info is not None:
+            print(f"[chaos] bit-flipped {flip_info['path']}"
+                  f"[{flip_info['element']}] bit {flip_info['bit']}")
+            if recorder is not None:
+                recorder.event(step_i, "bitflip_injected", **flip_info)
         state = (params, opt_state, scaler_state, sent_state)
-        action = mgr.resolve(step_i, verdict_code, loss=float(loss))
+        action = mgr.resolve(step_i, verdict_code, loss=loss_f)
         if action == "halt":
             if responder is not None:
                 # the final durable save below is not a step: a long
@@ -926,20 +819,30 @@ def main():
                     args.save, good_step, good_state,
                     keep_last_n=args.keep_last_n,
                 )
+            if recorder is not None:
+                # the journaled trajectory ends here (the replayer
+                # refuses to replay across a halt)
+                recorder.event(step_i, "halt", good_step=good_step)
             print(f"halting at step {step_i}: anomaly persisted; "
                   f"checkpointed known-good step {good_step}")
             break
         if action == "rollback":
+            rolled_from = step_i
             step_i, (params, opt_state, scaler_state, sent_state) = (
                 mgr.do_rollback()
             )
             it = make_iter(step_i)
+            if recorder is not None:
+                # rollback restores the in-memory snapshot ring — a
+                # non-replayable break (journal.breaks_in); the replayer
+                # refuses segments spanning it instead of diverging
+                recorder.event(rolled_from, "rollback", to_step=step_i)
             print(f"rolled back to step {step_i} "
                   f"(lr_scale {mgr.lr_scale:.3f})")
             continue
         if action == "skip":
             print(f"anomalous step {step_i}: update skipped "
-                  f"(loss {float(loss):.4f})")
+                  f"(loss {loss_f:.4f})")
         else:
             mgr.observe_good(step_i + 1, state)
         if step_i % args.log_interval == 0 or step_i == args.steps - 1:
@@ -1076,6 +979,8 @@ def main():
                   f"unaffected")
     if ar is not None:
         ar.close()  # finalize any in-flight interval save (manifest commit)
+    if recorder is not None:
+        recorder.close()  # fsync the journal sidecar with the run's end
     # run-level goodput summary (docs/observability.md "Goodput & fleet
     # health"): replay this run's own record window into the
     # productive/badput partition and land it in the SAME stream — the

@@ -72,6 +72,17 @@ def parse_args():
                         "included) — a rerun on a DIFFERENT device count "
                         "reshards the dp-sharded ZeRO state elastically "
                         "(docs/resilience.md \"Elastic restart\")")
+    p.add_argument("--journal", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="flight-recorder journaling "
+                        "(apex_tpu.resilience.replay): the scan's "
+                        "per-step loss fingerprints + batch crc land as "
+                        "kind='journal' records and the "
+                        "<save>/replay-journal.jsonl sidecar. The run is "
+                        "ONE compiled scan, so the journal supports "
+                        "cross-run fingerprint diffs (replay --diff), "
+                        "not checkpoint-anchored re-execution. Default: "
+                        "on when --save is set")
     return p.parse_args()
 
 
@@ -123,6 +134,20 @@ def main():
     goodput.run_header(router, run_id, steps=args.steps)
     goodput.set_router(router)
     init_span = goodput.begin_span("init")
+
+    # flight-recorder journaling (apex_tpu.resilience.replay): default on
+    # when the run saves a checkpoint to anchor to; the determinism_guard
+    # records the numerics flags BEFORE the compile so two runs of the
+    # same job journal bitwise-comparable fingerprints (replay --diff) —
+    # pinned only on an explicit --journal, so merely adding --save
+    # never changes the run's compiled numerics
+    journal_on = (args.journal if args.journal is not None
+                  else bool(args.save))
+    guard_flags = {}
+    if journal_on:
+        from apex_tpu.resilience.replay.replayer import determinism_guard
+
+        guard_flags = determinism_guard(pin=args.journal is True)
 
     model, variables = load_model(args)
     cfg = model.config
@@ -323,13 +348,44 @@ def main():
     assert np.isfinite(losses).all()
 
     shutdown_span = goodput.begin_span("shutdown", step=args.steps)
+    recorder = None
+    if journal_on:
+        # the run is ONE compiled scan (its steps are invisible while it
+        # executes), so the journal is written post-hoc from the scan's
+        # per-step loss vector: header + one fingerprint record per step
+        # + the end-of-run anchor. Costs nothing per step; supports
+        # cross-run diffs (python -m apex_tpu.resilience.replay --diff).
+        from apex_tpu.resilience.replay import (
+            FlightRecorder, batch_crc, journal_path,
+        )
+
+        recorder = FlightRecorder(
+            journal_path(args.save) if args.save else None, router=router
+        )
+        crc = batch_crc(np.asarray(tokens), np.asarray(labels))
+        recorder.header(
+            run_id, "llama-scan",
+            config={"steps": args.steps, "batch": args.batch,
+                    "seq_len": args.seq_len, "lr": args.lr,
+                    "clip": args.clip, "bf16": args.bf16,
+                    "checkpoint": args.checkpoint},
+            corpus={"fixed_batch_crc": crc},
+            devices=n_dev, steps=args.steps, **guard_flags,
+        )
+        for i, l in enumerate(losses):
+            recorder.step(step0 + i, loss=float(l), batch_crc=crc)
     if ar is not None:
         # interval=1 makes this unconditional: one verified save of the
         # trained state (ckpt_save spans land inside the shutdown span;
-        # priority attribution books them as ckpt_save)
+        # priority attribution books them as ckpt_save). journal= marks
+        # it as the replay anchor and flushes the sidecar with the
+        # manifest commit.
+        ar.journal = recorder
         ar.step(step0 + args.steps, (params, opt_state))
         ar.close()
         print(f"checkpointed step {step0 + args.steps} to {args.save}")
+    if recorder is not None:
+        recorder.close()
     if args.profile_analyze:
         # device-time timeline (apex_tpu.monitor.xray.timeline,
         # docs/observability.md#timeline). The main run is ONE compiled

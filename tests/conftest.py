@@ -51,6 +51,9 @@ _SLOW_TESTS = {
     "test_gpt_compression_parity",
     "test_gpt_compression_resume_migration",
     "test_elastic_selftest_gate",
+    "test_replay_selftest_gate",
+    "test_cross_process_determinism",
+    "test_gpt_replay_bitflip_drill",
     "test_gpt_elastic_chaos_drill",
     "test_gpt_preemption_skip_budget",
     "test_gpt_hang_incident_drill",

@@ -560,6 +560,60 @@ class TestLintFramework:
         assert homes == {"apex_tpu/utils/autoresume.py",
                          "apex_tpu/monitor/router.py"}
 
+    def test_nondeterminism_seeded(self):
+        files = {
+            "apex_tpu/fake.py":
+                "import random, time\n"
+                "import numpy as np\n"
+                "a = random.random()\n"
+                "b = np.random.rand(3)\n"
+                "c = time.time()\n"
+                "d = (None or random).uniform(0, 1)\n",
+        }
+        fins = run_lint(rules=["lint.nondeterminism"], files=files)
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/fake.py:3", "apex_tpu/fake.py:4",
+            "apex_tpu/fake.py:5", "apex_tpu/fake.py:6",
+        ]
+        assert {f.data["call"] for f in fins} == {
+            "random.random", "np.random.rand", "time.time",
+            "random.uniform",
+        }
+
+    def test_nondeterminism_seeded_constructs_and_clocks_exempt(self):
+        # seeded constructors PIN determinism, jax.random is functional,
+        # and monotonic clocks are durations — none of these are the
+        # unreproducible inputs the rule polices
+        files = {
+            "apex_tpu/fake.py":
+                "import random, time\n"
+                "import numpy as np\n"
+                "import jax\n"
+                "rng = np.random.RandomState(0)\n"
+                "g = np.random.default_rng(7)\n"
+                "r = random.Random(3)\n"
+                "x = rng.uniform(0, 1)\n"
+                "y = random.Random(3).random()\n"
+                "z = r.random()\n"
+                "random.seed(0)\n"
+                "np.random.seed(0)\n"
+                "k = jax.random.uniform(jax.random.PRNGKey(0), (2,))\n"
+                "t0 = time.monotonic(); t1 = time.perf_counter()\n",
+        }
+        assert run_lint(rules=["lint.nondeterminism"], files=files) == []
+
+    def test_nondeterminism_repo_scan_fully_explained(self):
+        # the ONLY library sites are the two allowlisted homes (retry
+        # jitter, record timestamps) — anything new must carry a reason
+        fins = run_lint(rules=["lint.nondeterminism"])
+        homes = {f.site.rsplit(":", 1)[0] for f in fins}
+        assert homes == {"apex_tpu/resilience/retry.py",
+                         "apex_tpu/monitor/router.py"}
+        from apex_tpu.analysis.allowlist import repo_allowlist as _ral
+
+        res = _ral().apply(fins, check_stale=False)
+        assert res.ok
+
     def test_registered_taps_seeded(self):
         files = {
             "apex_tpu/fake.py":
