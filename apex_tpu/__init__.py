@@ -34,6 +34,12 @@ Subpackage map (reference parity noted per module):
                               host-sync) + a unified AST lint framework and
                               the ``python -m apex_tpu.analysis`` gate (no
                               reference equivalent; see docs/analysis.md)
+- ``apex_tpu.serving``      — overload-hardened inference serving:
+                              continuous batching over a block-allocated
+                              KV pool, bounded admission + load shedding,
+                              per-request deadlines, graceful drain (no
+                              reference equivalent — the reference has no
+                              serving layer; see docs/serving.md)
 """
 
 import logging
@@ -96,7 +102,7 @@ def deprecated_warning(msg: str) -> None:
 # CLI must be able to force its CPU topology before jax initializes.
 _SUBPACKAGES = frozenset({
     "amp", "fp16_utils", "monitor", "normalization", "optimizers",
-    "parallel", "resilience", "transformer",
+    "parallel", "resilience", "serving", "transformer",
 })
 
 
@@ -121,6 +127,7 @@ __all__ = [
     "transformer",
     "parallel",
     "resilience",
+    "serving",
     "get_logger",
     "set_logging_level",
     "deprecated_warning",

@@ -397,6 +397,19 @@ _LINT = [
     ),
     AllowlistEntry(
         rule="lint.jit-donate",
+        match="apex_tpu/serving/engine.py",
+        reason=(
+            "audited entrypoint: the serving engine's AOT-compiled "
+            "prefill/decode steps donate the block-allocated KV pool "
+            "(the whole point of the donated pytree: steady-state "
+            "serving reuses one HBM allocation in place); realized "
+            "donation is pinned empirically by the serving selftest "
+            "gate — the pre-tick pool buffer must be deleted"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
+        rule="lint.jit-donate",
         match="apex_tpu/analysis/donation.py",
         reason=(
             "the donation auditor itself constructs the donating jit in "

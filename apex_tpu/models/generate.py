@@ -21,14 +21,38 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["generate", "beam_search"]
+__all__ = ["generate", "beam_search", "sample_next_token"]
 
 
 def _filter_logits(next_logits, top_k, top_p):
     """Standard nucleus/top-k truncation: logits outside the kept set are
-    driven to -inf so categorical sampling never picks them."""
+    driven to -inf so categorical sampling never picks them.
+
+    Hardened edges (pinned in tests/test_hf_parity.py):
+
+    - ``top_k >= vocab`` is an exact no-op (HF clamps; the sort+compare
+      below would also keep everything, but skipping it avoids paying a
+      vocab-sized sort for a filter that cannot filter);
+    - ``top_p >= 1.0`` is an exact no-op: the cumulative-sum comparison
+      is float arithmetic, and near the boundary a rounding of
+      ``csum`` to exactly 1.0 one slot early could truncate a genuinely
+      nonzero-probability tail token — "keep the full mass" must not
+      depend on summation order;
+    - ``top_k < 1`` / ``top_p <= 0`` are caller errors, refused with a
+      reason (a silent empty keep-set would make categorical sample
+      from all -inf logits and return garbage token 0).
+    """
     if top_k is not None:
-        top_k = min(top_k, next_logits.shape[-1])  # HF clamps (default k=50)
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if top_k >= next_logits.shape[-1]:
+            top_k = None  # keep everything: exact no-op
+    if top_p is not None:
+        if top_p <= 0.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if top_p >= 1.0:
+            top_p = None  # full mass: exact no-op
+    if top_k is not None:
         kth = jnp.sort(next_logits, axis=-1)[:, -top_k][:, None]
         next_logits = jnp.where(next_logits < kth, -jnp.inf, next_logits)
     if top_p is not None:
@@ -55,6 +79,38 @@ def _select_next(next_logits, temperature, key, top_k=None, top_p=None):
         next_logits = _filter_logits(next_logits / temperature, top_k, top_p)
         return jax.random.categorical(key, next_logits, axis=-1)
     return jnp.argmax(next_logits, axis=-1)
+
+
+def sample_next_token(next_logits, temperature, key, top_k=None,
+                      top_p=None):
+    """Single-position sampling with a TRACED per-call temperature.
+
+    The serving engine (``apex_tpu.serving``) batches requests with
+    heterogeneous temperatures through ONE compiled decode step, so the
+    temperature must be an ordinary traced scalar — ``_select_next``'s
+    python-float branch (``if temperature > 0``) would burn a recompile
+    per distinct value. Branchless instead: both the greedy argmax and
+    the tempered/filtered categorical sample are computed, and
+    ``jnp.where`` picks by the traced ``temperature > 0``. ``top_k`` /
+    ``top_p`` stay STATIC (they shape the sort/cumsum); the HF warper
+    order (temper BEFORE truncation) is preserved exactly as in
+    :func:`_select_next`.
+
+    ``next_logits`` is ``(v,)`` or ``(b, v)``; returns int token id(s)
+    of matching batch rank.
+    """
+    squeeze = next_logits.ndim == 1
+    logits = next_logits[None] if squeeze else next_logits
+    logits = logits.astype(jnp.float32)
+    # a zero (greedy) temperature must not divide by zero inside the
+    # discarded sampling branch: NaN logits would propagate through
+    # where() on some backends' grads — clamp the divisor only
+    safe_t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    filtered = _filter_logits(logits / safe_t, top_k, top_p)
+    sampled = jax.random.categorical(key, filtered, axis=-1)
+    greedy = jnp.argmax(logits, axis=-1)
+    tok = jnp.where(jnp.asarray(temperature) > 0.0, sampled, greedy)
+    return tok[0] if squeeze else tok
 
 
 def _check_position_bound(model, s, max_new_tokens):

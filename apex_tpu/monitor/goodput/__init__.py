@@ -28,6 +28,7 @@ _EXPORTS = {
     "PHASES": "spans",
     "PHASE_PRIORITY": "spans",
     "PRODUCTIVE_PHASE": "spans",
+    "PRODUCTIVE_PHASES": "spans",
     "Span": "spans",
     "span": "spans",
     "begin_span": "spans",

@@ -44,13 +44,14 @@ import json
 import math
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from apex_tpu.monitor.goodput.spans import PHASE_PRIORITY, PRODUCTIVE_PHASE
+from apex_tpu.monitor.goodput.spans import PHASE_PRIORITY, PRODUCTIVE_PHASES
 
 __all__ = ["GoodputReport", "account", "read_records"]
 
 #: badput categories in canonical (priority) order — every phase except
-#: the productive one
-BADPUT_PHASES = tuple(p for p in PHASE_PRIORITY if p != PRODUCTIVE_PHASE)
+#: the productive ones (training's ``step`` plus the serving work
+#: phases ``prefill``/``decode``; spans.PRODUCTIVE_PHASES)
+BADPUT_PHASES = tuple(p for p in PHASE_PRIORITY if p not in PRODUCTIVE_PHASES)
 
 
 def read_records(paths: Sequence[str]) -> List[dict]:
@@ -267,7 +268,7 @@ def account(
                     continue
                 u = _union([(max(s, anchor), min(e, end)) for s, e in ivs])
                 exposed = _total(_subtract(u, covered))
-                if phase == PRODUCTIVE_PHASE:
+                if phase in PRODUCTIVE_PHASES:
                     productive += exposed
                 else:
                     badput[phase] += exposed

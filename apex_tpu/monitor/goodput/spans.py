@@ -54,6 +54,7 @@ __all__ = [
     "PHASES",
     "PHASE_PRIORITY",
     "PRODUCTIVE_PHASE",
+    "PRODUCTIVE_PHASES",
     "Span",
     "span",
     "begin_span",
@@ -80,6 +81,14 @@ __all__ = [
 #: - ``incident``      — a stall that escalated: the wedged time from the
 #:   last heartbeat to the incident responder's self-termination
 #:   (resilience.health; docs/resilience.md "Incident response")
+#: - ``prefill``       — a serving prefill pass: prompt tokens entering
+#:   the KV cache (apex_tpu.serving; productive, like ``step``)
+#: - ``decode``        — a serving decode tick: one token per in-flight
+#:   request through the batched KV-cache step (productive)
+#: - ``drain``         — the graceful-drain window after a termination
+#:   notice: admission closed, in-flight requests finishing or being
+#:   deadline-evicted (docs/serving.md). Outranked by prefill/decode so
+#:   only the drain OVERHEAD (waiting, teardown) books as badput.
 #: - ``init``          — everything else before the loop (model build,
 #:   corpus, audits, banners)
 #: - ``shutdown``      — everything after it (final saves, analysis)
@@ -88,15 +97,26 @@ PHASES = (
     "compile",
     "data_wait",
     "step",
+    "prefill",
+    "decode",
     "ckpt_save",
     "ckpt_restore",
     "rollback",
     "stall",
     "incident",
+    "drain",
     "shutdown",
 )
 
 PRODUCTIVE_PHASE = "step"
+
+#: Phases that count as PRODUCTIVE wall clock in the accountant's
+#: partition. Training has one ("step"); serving adds two — a prefill
+#: or decode second is the serving analogue of a step second (tokens
+#: moving through the model), and booking it as badput would make every
+#: healthy serving run read as 0% goodput. The partition identity is
+#: unchanged: productive_s is the union-seconds of ALL these phases.
+PRODUCTIVE_PHASES = ("step", "prefill", "decode")
 
 #: Attribution order for overlapping spans (accountant.py): a second of
 #: wall time belongs to the FIRST phase in this tuple whose span covers
@@ -109,15 +129,21 @@ PRODUCTIVE_PHASE = "step"
 #: the escalating watchdog PROVED the time was dead (a wedged step is
 #: indistinguishable from a long one until the deadline blows), so the
 #: still-open pseudo-step span it overlaps must not book as productive.
+#: ``drain`` sits below the serving work phases (a drain window is an
+#: envelope: decode ticks inside it are still productive) but above
+#: ``init``/``shutdown`` so its exposed overhead is named, not generic.
 PHASE_PRIORITY = (
     "incident",
     "step",
+    "prefill",
+    "decode",
     "ckpt_save",
     "ckpt_restore",
     "rollback",
     "compile",
     "data_wait",
     "stall",
+    "drain",
     "init",
     "shutdown",
 )

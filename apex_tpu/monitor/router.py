@@ -23,9 +23,12 @@ bundles and termination marks from apex_tpu.resilience.health;
 replay kinds ("journal" — the flight recorder's per-step
 nondeterminism inputs and fingerprints; "replay" — a re-execution
 segment's comparison outcome; "divergence" — the bisector's forensic
-verdict, all from apex_tpu.resilience.replay), so pre-flight audit
-results and run-lifecycle accounting land in the same jsonl a tailer
-already reads.
+verdict, all from apex_tpu.resilience.replay), and the serving kind
+("request" — one record per request-lifecycle transition from the
+apex_tpu.serving scheduler: queued/admitted/prefill/decode plus the
+terminal states, docs/serving.md), so pre-flight audit results and
+run-lifecycle accounting land in the same jsonl a tailer already
+reads.
 
 ``host`` is the producing process's index (``jax.process_index()``) so
 merged multi-host streams stay attributable; it defaults to 0 and is
@@ -243,12 +246,17 @@ class StdoutSink(Sink):
     window) is far too large for a one-liner; the incident responder logs
     a compact summary and the file sinks carry the bundle. "journal"
     (the replay flight recorder, resilience.replay) is skipped for the
-    same per-iteration reason: the sidecar jsonl is its durable home.
-    The ``host`` field is likewise plumbing and never rendered.
+    same per-iteration reason: the sidecar jsonl is its durable home —
+    as is "request" (the serving scheduler's per-transition lifecycle
+    records, apex_tpu.serving): a loaded server emits several per tick,
+    and the console surface is the engine's summary line, not the
+    firehose. The ``host`` field is likewise plumbing and never
+    rendered.
     """
 
     def __init__(self, stream=None,
-                 skip_kinds=("span", "run", "incident", "journal")):
+                 skip_kinds=("span", "run", "incident", "journal",
+                             "request")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 
@@ -400,6 +408,13 @@ def _install_teardown() -> None:
                 _signal.signal(signum, _signal.SIG_DFL)
                 os.kill(os.getpid(), signum)
 
+            # marker for handlers that CHAIN (utils.autoresume.
+            # TerminationNotice): this hook exists only to flush before
+            # an otherwise-FATAL SIGTERM, and re-raises to preserve the
+            # death. A graceful-drain latch installed over it must skip
+            # the chain — the signal is no longer fatal, and the flush
+            # happens at the drain's normal close/atexit instead.
+            _on_term._apex_tpu_router_teardown = True
             _signal.signal(_signal.SIGTERM, _on_term)
     except (ValueError, OSError):  # non-main thread / exotic platform
         pass

@@ -21,6 +21,18 @@ on demand, deterministically, on the 8-device CPU test mesh:
   watchdog's escalation ladder can answer), and ``FaultPlan``'s
   ``slow_steps`` inject a per-step artificial delay (the straggler /
   thermal-throttle shape the warn level flags without escalating).
+- **Serving overload shapes** — the four production failure modes of a
+  request-serving loop (``apex_tpu.serving``, docs/serving.md):
+  ``slow_decode_steps`` inflate chosen scheduler ticks (a thermally
+  throttled / contended decode the admission controller must absorb by
+  SHEDDING, not queue growth), ``abandon_requests`` name request
+  ordinals whose client disconnects mid-flight (the engine must book
+  ``cancelled`` and reclaim the KV blocks), ``malformed_requests`` name
+  ordinals submitted as garbage (empty prompt — the admission layer
+  must reject-with-reason, never crash the batch), and ``burst_steps``
+  inject ``burst_n`` simultaneous arrivals (the Poisson tail that blows
+  a bounded queue). All consumed by the serving load generator /
+  engine, step-or-ordinal keyed like every other fault here.
 - **Silent in-memory corruption** — ``bitflip_leaf`` XORs one bit of
   one element of one live param/opt-state leaf (seeded, sharding-
   preserving): the SDC shape that sails PAST the anomaly sentinel (a
@@ -118,6 +130,16 @@ class FaultPlan:
     flipped in memory (see ``bitflip_leaf``; ``bitflip_bit`` /
     ``bitflip_seed`` pick the bit and the leaf) — the silent-corruption
     fault the replay bisector exists to localize.
+    ``slow_decode_steps``: serving scheduler ticks delayed by
+    ``slow_decode_s`` wall seconds inside the decode span (the serving
+    straggler shape; the engine consumes it per tick).
+    ``abandon_requests``: request ORDINALS (submission order, 0-based)
+    whose client abandons them after submission — the serving load
+    generator cancels them on its next pump.
+    ``malformed_requests``: request ordinals submitted malformed (empty
+    prompt) instead of their real payload.
+    ``burst_steps``: load-generator pumps at which ``burst_n`` extra
+    arrivals land at once (the burst-arrival overload shape).
     ``persistent``: re-arm faults on replay (halt-path testing) instead
     of the default fire-once behavior (recovery-path testing).
     """
@@ -127,7 +149,13 @@ class FaultPlan:
     hang_steps: FrozenSet[int] = frozenset()
     slow_steps: FrozenSet[int] = frozenset()
     bitflip_steps: FrozenSet[int] = frozenset()
+    slow_decode_steps: FrozenSet[int] = frozenset()
+    abandon_requests: FrozenSet[int] = frozenset()
+    malformed_requests: FrozenSet[int] = frozenset()
+    burst_steps: FrozenSet[int] = frozenset()
     slow_s: float = 0.0
+    slow_decode_s: float = 0.0
+    burst_n: int = 8
     hang_timeout_s: Optional[float] = None
     bitflip_bit: int = 12
     bitflip_seed: int = 0
@@ -139,11 +167,19 @@ class FaultPlan:
         self.hang_steps = parse_steps(self.hang_steps)
         self.slow_steps = parse_steps(self.slow_steps)
         self.bitflip_steps = parse_steps(self.bitflip_steps)
+        self.slow_decode_steps = parse_steps(self.slow_decode_steps)
+        self.abandon_requests = parse_steps(self.abandon_requests)
+        self.malformed_requests = parse_steps(self.malformed_requests)
+        self.burst_steps = parse_steps(self.burst_steps)
         self._fired_nan: Set[int] = set()
         self._fired_sigterm: Set[int] = set()
         self._fired_hang: Set[int] = set()
         self._fired_slow: Set[int] = set()
         self._fired_bitflip: Set[int] = set()
+        self._fired_slow_decode: Set[int] = set()
+        self._fired_abandon: Set[int] = set()
+        self._fired_malformed: Set[int] = set()
+        self._fired_burst: Set[int] = set()
 
     def _due(self, step: int, steps: FrozenSet[int], fired: Set[int]) -> bool:
         if step in steps and (self.persistent or step not in fired):
@@ -179,6 +215,41 @@ class FaultPlan:
             wedge(self.hang_timeout_s)
             return True
         return False
+
+    def maybe_slow_decode(self, step: int) -> bool:
+        """Inflate serving scheduler tick ``step`` by ``slow_decode_s``
+        (called INSIDE the decode span so the stall warn flags exactly
+        the inflated tick)."""
+        if self._due(int(step), self.slow_decode_steps,
+                     self._fired_slow_decode):
+            logger.warning(
+                "chaos: slowing decode tick %d by %.3fs",
+                int(step), self.slow_decode_s,
+            )
+            time.sleep(self.slow_decode_s)
+            return True
+        return False
+
+    def take_abandon(self, ordinal: int) -> bool:
+        """True when request ``ordinal`` should be client-abandoned."""
+        return self._due(int(ordinal), self.abandon_requests,
+                         self._fired_abandon)
+
+    def take_malformed(self, ordinal: int) -> bool:
+        """True when request ``ordinal`` should be submitted malformed."""
+        return self._due(int(ordinal), self.malformed_requests,
+                         self._fired_malformed)
+
+    def take_burst(self, step: int) -> int:
+        """Extra arrivals to inject at load-generator pump ``step``
+        (``burst_n`` when scheduled, else 0)."""
+        if self._due(int(step), self.burst_steps, self._fired_burst):
+            logger.warning(
+                "chaos: injecting a burst of %d arrivals at pump %d",
+                self.burst_n, int(step),
+            )
+            return int(self.burst_n)
+        return 0
 
     def maybe_bitflip(self, step: int, tree, path_filter=None):
         """``(new_tree, info)`` with one bit flipped when scheduled for

@@ -86,6 +86,13 @@ class IncidentResponder:
     ``terminate_after=None`` (default) stops the ladder at the dump —
     detection and forensics without the authority to kill, the safe
     default for a library. ``exit_fn`` is injectable for tests.
+    ``bundle_extra`` is an optional zero-arg callable returning extra
+    fields merged into the dump bundle — the serving engine passes its
+    in-flight request table through here, so a wedged-decode bundle
+    names exactly which requests were on the batch when the loop died.
+    It runs on the watchdog thread against a possibly-wedged process:
+    it must be lock-free best-effort, and a raise is logged, never
+    allowed to cost the bundle.
     """
 
     def __init__(
@@ -102,6 +109,7 @@ class IncidentResponder:
         exit_code: int = INCIDENT_EXIT_CODE,
         exit_fn=None,
         teardown_timeout_s: float = 10.0,
+        bundle_extra=None,
     ):
         if dump_after < 1.0:
             raise ValueError(
@@ -122,6 +130,7 @@ class IncidentResponder:
         self.exit_code = int(exit_code)
         self.teardown_timeout_s = float(teardown_timeout_s)
         self._exit_fn = exit_fn if exit_fn is not None else os._exit
+        self.bundle_extra = bundle_extra
         self.incidents: List[dict] = []
         escalations = [(float(dump_after), self._dump)]
         if terminate_after is not None:
@@ -156,12 +165,18 @@ class IncidentResponder:
     # -- the ladder ---------------------------------------------------------
 
     def _dump(self, info: dict) -> None:
+        extra = {}
+        if self.bundle_extra is not None:
+            try:
+                extra = dict(self.bundle_extra() or {})
+            except Exception as e:  # the bundle must not die of its garnish
+                logger.warning("incident bundle_extra failed: %s", e)
         bundle = capture_incident(
             self.router, info.get("step"), stage="dump",
             overdue_s=info.get("overdue_s"),
             deadline_s=info.get("deadline_s"),
             window=self.window, tail=self.window_tail,
-            trigger=self.trigger,
+            trigger=self.trigger, **extra,
         )
         self.incidents.append(bundle)
 

@@ -1,0 +1,58 @@
+"""apex_tpu.serving — the overload-hardened inference serving core.
+
+Continuous (in-flight) batching over the library's KV-cache decode path
+with a block-allocated KV pool, bounded admission + load shedding,
+per-request deadlines, graceful drain, and the incident-response ladder
+armed per scheduler tick. See docs/serving.md; the exit-nonzero gate is
+``python -m apex_tpu.serving --selftest``.
+
+Attribute access is lazy (PEP 562, the package-wide contract):
+``lifecycle``/``kvcache``/``loadgen`` import jax-free — the request
+state machine and the latency statistics must be testable on any box —
+and the jax-heavy engine only loads when touched.
+"""
+
+_EXPORTS = {
+    # lifecycle (jax-free)
+    "Request": "lifecycle",
+    "STATES": "lifecycle",
+    "TERMINAL_STATES": "lifecycle",
+    "TRANSITIONS": "lifecycle",
+    "transition": "lifecycle",
+    # kv pool (jax-free host side)
+    "BlockAllocator": "kvcache",
+    "CacheSpec": "kvcache",
+    "blocks_needed": "kvcache",
+    # engine
+    "ServingConfig": "engine",
+    "ServingEngine": "engine",
+    # load generation / stats (jax-free)
+    "PoissonLoadGenerator": "loadgen",
+    "LoadReport": "loadgen",
+    "percentile": "loadgen",
+}
+
+__all__ = sorted(_EXPORTS) + [
+    "engine", "kvcache", "lifecycle", "loadgen",
+]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _EXPORTS:
+        mod = importlib.import_module(
+            f"apex_tpu.serving.{_EXPORTS[name]}"
+        )
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"apex_tpu.serving.{name}")
+    raise AttributeError(
+        f"module 'apex_tpu.serving' has no attribute {name!r}"
+    )
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

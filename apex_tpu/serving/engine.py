@@ -1,0 +1,890 @@
+"""Overload-hardened serving core: continuous batching over the KV pool.
+
+The scheduler that turns the library's decode path (``models/generate``
+semantics over the transformer's cache variables) into a SERVER — and a
+robustness-first one: a server that melts under load is worse than no
+server, so every resource here is bounded and every overflow is SHED
+with a booked reason, never buffered without limit
+(docs/serving.md; ROADMAP item 1).
+
+Continuous (in-flight) batching: the engine runs a tick loop. Each tick
+admits up to ``max_prefills_per_tick`` queued requests (one compiled
+prefill each, bucketed by prompt length), then advances EVERY in-flight
+request by one token through ONE compiled decode step — requests join
+and leave the batch at tick granularity, no waiting for stragglers to
+finish a "batch". Per-lane state (its own ``cache_index``, block table
+and sampling temperature) is threaded through a ``jax.vmap`` of the
+model's single-sequence decode, so the model's cache machinery is
+reused unchanged and per-request positions diverge freely.
+
+Zero steady-state recompiles: prefill shapes are BUCKETED (block-size
+multiples, doubling up to ``max_seq_len``) and every bucket plus the
+decode step is AOT-compiled (``jit(...).lower(...).compile()``) in
+:meth:`ServingEngine.start`, so steady traffic executes pre-compiled
+artifacts only. A PR-3 :class:`~apex_tpu.monitor.CompileWatcher`
+created AFTER the warmup ticks once per scheduler tick; any compile it
+sees is a steady-state violation surfaced as
+:attr:`ServingEngine.steady_state_compiles` (the selftest and the
+overload drill assert it stays 0).
+
+Robustness surface (the ops layer transferring wholesale):
+
+- **bounded admission queue + load shedding** — ``submit`` refuses with
+  a booked reason (``queue_full``, ``ttft_budget``, ``malformed``,
+  ``too_long``, ``draining``) the moment a bound would be exceeded;
+- **per-request deadlines** — enforced at EVERY tick, in queue and in
+  batch: expired requests are evicted, their KV blocks reclaimed, and
+  the ending booked ``timed_out`` — never a silent drop;
+- **wedged-decode defense** — pass an
+  :class:`~apex_tpu.resilience.health.IncidentResponder` (or a bare
+  watchdog) as ``watchdog=``: the engine beats it once per tick, and
+  ``bundle_extra=engine.inflight_table`` puts the in-flight request
+  table into the forensic dump before the coordinated exit 43;
+- **graceful drain** — :meth:`drain` stops admission, finishes or
+  deadline-evicts the in-flight requests within the grace budget
+  (PR-8's ``APEX_TPU_PREEMPTION_GRACE_S`` convention via
+  ``utils.autoresume.TerminationNotice``), and emits terminal states
+  for every request;
+- **chaos drills** — a :class:`~apex_tpu.resilience.chaos.FaultPlan`
+  injects slow-decode ticks and host-loop wedges inside the tick, and
+  the load generator (loadgen.py) consumes its client-abandon /
+  malformed-prompt / burst-arrival faults.
+
+Telemetry: ``kind="request"`` lifecycle records (lifecycle.py) plus
+goodput spans — ``prefill`` and ``decode`` are PRODUCTIVE phases, so
+the PR-7 accountant's partition identity extends to request wall clock
+digit-for-digit.
+"""
+
+import collections
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.monitor.goodput.spans import span
+from apex_tpu.serving.kvcache import BlockAllocator, CacheSpec, blocks_needed
+from apex_tpu.serving.lifecycle import (
+    ADMITTED,
+    CANCELLED,
+    COMPLETED,
+    DECODE,
+    FAILED,
+    PREFILL,
+    QUEUED,
+    REJECTED,
+    TIMED_OUT,
+    Request,
+    emit_request_record,
+    transition,
+)
+
+logger = logging.getLogger("apex_tpu.serving")
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+def _ema(old: Optional[float], x: float, alpha: float = 0.5) -> float:
+    return x if old is None else (1.0 - alpha) * old + alpha * x
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine geometry and admission policy (docs/serving.md).
+
+    ``lanes`` bounds concurrent in-flight decodes; ``num_blocks`` x
+    ``block_size`` tokens is the whole KV pool; ``max_seq_len`` caps one
+    request's prompt+generation (and is each lane's contiguous decode
+    view, so it must divide into blocks). ``prefill_buckets`` (derived
+    when None: block-size multiples doubling up to ``max_seq_len``) are
+    the ONLY prompt shapes ever compiled. ``ttft_budget_s`` arms the
+    admission-time TTFT estimate — beyond it, submissions shed with
+    ``ttft_budget`` instead of queueing into a deadline they cannot
+    meet. ``top_k``/``top_p`` are engine-static (they shape the
+    compiled sort/cumsum); per-request ``temperature`` is traced.
+    ``collect_logits`` keeps each request's per-step next-token logits
+    on the host (tests/debug; a per-tick vocab-sized fetch).
+    """
+
+    lanes: int = 4
+    block_size: int = 16
+    num_blocks: int = 64
+    max_seq_len: int = 128
+    prefill_buckets: Optional[Tuple[int, ...]] = None
+    max_queue_depth: int = 16
+    ttft_budget_s: Optional[float] = None
+    default_deadline_s: Optional[float] = None
+    max_prefills_per_tick: int = 1
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    seed: int = 0
+    collect_logits: bool = False
+
+    def __post_init__(self):
+        if self.lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {self.lanes}")
+        if self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.max_seq_len % self.block_size:
+            raise ValueError(
+                f"max_seq_len ({self.max_seq_len}) must divide into "
+                f"block_size ({self.block_size}) blocks"
+            )
+        if self.num_blocks < self.max_seq_len // self.block_size:
+            raise ValueError(
+                f"num_blocks ({self.num_blocks}) cannot hold even one "
+                f"max_seq_len ({self.max_seq_len}) request "
+                f"({self.max_seq_len // self.block_size} blocks)"
+            )
+        if self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        if self.max_prefills_per_tick < 1:
+            raise ValueError(
+                f"max_prefills_per_tick must be >= 1, got "
+                f"{self.max_prefills_per_tick}")
+        buckets = self.prefill_buckets
+        if buckets is None:
+            buckets, b = [], self.block_size
+            while b < self.max_seq_len:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_seq_len)
+        buckets = tuple(sorted(set(int(b) for b in buckets)))
+        for b in buckets:
+            if b < 1 or b > self.max_seq_len or b % self.block_size:
+                raise ValueError(
+                    f"prefill bucket {b} must be a block_size "
+                    f"({self.block_size}) multiple in [1, max_seq_len "
+                    f"({self.max_seq_len})]"
+                )
+        object.__setattr__(self, "prefill_buckets", buckets)
+
+    @property
+    def max_blocks_per_lane(self) -> int:
+        return self.max_seq_len // self.block_size
+
+
+class ServingEngine:
+    """The tick-loop scheduler (module docstring).
+
+    Drive it::
+
+        eng = ServingEngine(model, variables, ServingConfig(...),
+                            router=router, fault_plan=plan,
+                            watchdog=responder)
+        eng.start()                      # AOT-compiles every bucket
+        req = eng.submit(prompt, max_new_tokens=32)   # queued/rejected
+        while not eng.idle:
+            eng.tick()
+        eng.drain(grace_s=...)           # on a termination notice
+
+    ``router`` receives the ``kind="request"`` lifecycle records and the
+    prefill/decode/drain goodput spans; ``watchdog`` (a StallWatchdog or
+    IncidentResponder) is beaten once per tick; ``fault_plan`` injects
+    the serving chaos faults. Single-process data plane: the engine
+    drives the model with plain ``apply`` (no mesh) — model-parallel
+    serving composes later, the robustness contract first.
+    """
+
+    def __init__(self, model, variables, config: ServingConfig,
+                 router=None, fault_plan=None, watchdog=None,
+                 time_fn=time.monotonic):
+        self.model = model
+        self.variables = variables
+        self.config = config
+        self.router = router
+        self.fault_plan = fault_plan
+        self.watchdog = watchdog
+        self.time_fn = time_fn
+        self._validate_model()
+
+        self.allocator = BlockAllocator(config.num_blocks)
+        self._queue: "collections.deque[Request]" = collections.deque()
+        self._active: Dict[int, Request] = {}
+        self._requests: Dict[int, Request] = {}
+        self._next_rid = 0
+        self._tick = 0
+        self._draining = False
+        self._started = False
+        self._prefill_ema: Optional[float] = None
+        self._decode_ema: Optional[float] = None
+        self._steady_compiles = 0
+        self._compile_watch = None
+        self._spec: Optional[CacheSpec] = None
+        self._pool = None
+        self._prefill_c: Dict[int, Any] = {}
+        self._decode_c = None
+        self._prefill_key = None
+        self._keys = None
+
+        B, MB = config.lanes, config.max_blocks_per_lane
+        self._tables = np.full((B, MB), config.num_blocks, np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._last_tok = np.zeros((B,), np.int32)
+        self._temps = np.zeros((B,), np.float32)
+        self._lane_mask = np.zeros((B,), bool)
+
+    # -- model validation ---------------------------------------------------
+
+    def _validate_model(self) -> None:
+        cfg = getattr(self.model, "config", None)
+        max_pos = getattr(cfg, "max_position_embeddings", None)
+        # rope models may leave the field at 0 (no position table); a
+        # learned-position table smaller than the serving capacity would
+        # CLAMP out-of-range gathers into garbage — refuse at build, the
+        # models.generate._check_position_bound contract
+        if max_pos and self.config.max_seq_len > max_pos:
+            raise ValueError(
+                f"max_seq_len ({self.config.max_seq_len}) exceeds the "
+                f"model's max_position_embeddings ({max_pos}) — serving "
+                f"beyond the position table would emit clamped garbage"
+            )
+        self._vocab = getattr(cfg, "vocab_size", None)
+
+    # -- compilation (all of it happens here) -------------------------------
+
+    def start(self) -> "ServingEngine":
+        """Build the pool and AOT-compile every prefill bucket plus the
+        decode step. Every compile of the engine's life happens inside
+        this call (booked as a ``compile`` goodput span); the
+        CompileWatcher created at the end then counts any later compile
+        as a steady-state violation."""
+        if self._started:
+            return self
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.config
+        with span("compile", router=self.router, step=-1):
+            b0 = cfg.prefill_buckets[0]
+
+            def _prefill_shape(tokens):
+                return self.model.apply(
+                    self.variables, tokens, cache_len=b0, mutable=["cache"]
+                )
+
+            _, shapes = jax.eval_shape(
+                _prefill_shape, jax.ShapeDtypeStruct((1, b0), jnp.int32)
+            )
+            self._spec = CacheSpec.from_cache_shapes(shapes["cache"])
+            pool_shapes = self._spec.pool_shapes(
+                cfg.num_blocks, cfg.block_size
+            )
+            self._pool = {
+                k: jax.device_put(np.zeros(shape, dtype))
+                for k, (shape, dtype) in pool_shapes.items()
+            }
+            pool_sds = {
+                k: jax.ShapeDtypeStruct(shape, dtype)
+                for k, (shape, dtype) in pool_shapes.items()
+            }
+            i32, f32 = jnp.int32, jnp.float32
+            key_sds = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            for P in cfg.prefill_buckets:
+                lowered = jax.jit(
+                    self._make_prefill(P), donate_argnums=(0,)
+                ).lower(
+                    pool_sds,
+                    jax.ShapeDtypeStruct((P,), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((P // cfg.block_size,), i32),
+                    jax.ShapeDtypeStruct((), f32),
+                    key_sds,
+                )
+                self._prefill_c[P] = lowered.compile()
+            B, MB = cfg.lanes, cfg.max_blocks_per_lane
+            self._decode_c = jax.jit(
+                self._make_decode(), donate_argnums=(0,)
+            ).lower(
+                pool_sds,
+                jax.ShapeDtypeStruct((B, MB), i32),
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B,), i32),
+                jax.ShapeDtypeStruct((B,), f32),
+                jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((B,), jnp.bool_),
+            ).compile()
+            self._prefill_key = jax.random.PRNGKey(cfg.seed)
+            self._keys = jax.random.split(
+                jax.random.PRNGKey(cfg.seed + 1), B
+            )
+        from apex_tpu.monitor.xray.compile_watch import CompileWatcher
+
+        self._compile_watch = CompileWatcher(router=self.router)
+        self._started = True
+        logger.info(
+            "serving engine ready: %d lanes, %d blocks x %d tokens, "
+            "buckets %s", cfg.lanes, cfg.num_blocks, cfg.block_size,
+            cfg.prefill_buckets,
+        )
+        return self
+
+    def _make_prefill(self, P: int):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.generate import sample_next_token
+
+        cfg, spec = self.config, self._spec
+        model, variables = self.model, self.variables
+        n_pb = P // cfg.block_size
+
+        def prefill(pool, tokens, true_len, block_ids, temp, key):
+            logits, st = model.apply(
+                variables, tokens[None], cache_len=P, mutable=["cache"]
+            )
+            # next-token logits at the TRUE prompt end; the right-padded
+            # tail is causal-shadowed (positions >= true_len never feed
+            # position true_len - 1)
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), true_len - 1, axis=0,
+                keepdims=False,
+            )
+            key, sub = jax.random.split(key)
+            tok = sample_next_token(
+                last, temp, sub, top_k=cfg.top_k, top_p=cfg.top_p
+            )
+            kv = spec.kv_from_cache(st["cache"])
+            new_pool = dict(pool)
+            for k, leaf in kv.items():
+                # (1, h_kv, P, hd) -> (P/bs blocks, h_kv, bs, hd);
+                # out-of-range sentinel ids drop their (unreserved,
+                # fully-padded) blocks on the scatter
+                h_kv, hd = leaf.shape[1], leaf.shape[3]
+                blocks = leaf[0].reshape(
+                    h_kv, n_pb, cfg.block_size, hd
+                ).transpose(1, 0, 2, 3)
+                new_pool[k] = pool[k].at[block_ids].set(
+                    blocks.astype(pool[k].dtype), mode="drop"
+                )
+            out = (new_pool, tok.astype(jnp.int32), key)
+            if cfg.collect_logits:
+                out = out + (last,)
+            return out
+
+        return prefill
+
+    def _make_decode(self):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.models.generate import sample_next_token
+
+        cfg, spec = self.config, self._spec
+        model, variables = self.model, self.variables
+        bs, nb, MB = cfg.block_size, cfg.num_blocks, cfg.max_blocks_per_lane
+        kv_keys = [CacheSpec.key(l.path) for l in spec.kv_leaves]
+
+        def decode(pool, tables, positions, tokens, temps, keys, active):
+            def lane(table, pos, tok, temp, key):
+                safe = jnp.clip(table, 0, nb - 1)
+                kv = {}
+                for k in kv_keys:
+                    g = pool[k][safe]  # (MB, h_kv, bs, hd)
+                    h_kv, hd = g.shape[1], g.shape[3]
+                    kv[k] = g.transpose(1, 0, 2, 3).reshape(
+                        h_kv, MB * bs, hd
+                    )[None]
+                cache = spec.build_cache(kv, jnp.asarray(pos, jnp.int32))
+                logits, upd = model.apply(
+                    {**variables, "cache": cache},
+                    tok[None, None],
+                    position_ids=pos[None, None],
+                    cache_len=cfg.max_seq_len,
+                    decode_step=True,
+                    mutable=["cache"],
+                )
+                # only the block containing slot `pos` changed — scatter
+                # exactly it back; the rest of the lane's view is the
+                # pool's own bytes round-tripping
+                blk = pos // bs
+                off = blk * bs
+                new_kv = spec.kv_from_cache(upd["cache"])
+                written = []
+                for k in kv_keys:
+                    leaf = new_kv[k]  # (1, h_kv, max_seq_len, hd)
+                    h_kv, hd = leaf.shape[1], leaf.shape[3]
+                    written.append(jax.lax.dynamic_slice(
+                        leaf, (0, 0, off, 0), (1, h_kv, bs, hd)
+                    )[0])
+                key, sub = jax.random.split(key)
+                last = logits[0, 0].astype(jnp.float32)
+                nxt = sample_next_token(
+                    last, temp, sub, top_k=cfg.top_k, top_p=cfg.top_p
+                )
+                out = (nxt.astype(jnp.int32), table[blk], tuple(written),
+                       key)
+                if cfg.collect_logits:
+                    out = out + (last,)
+                return out
+
+            res = jax.vmap(lane)(tables, positions, tokens, temps, keys)
+            nxts, blk_ids, written, new_keys = res[:4]
+            # inactive lanes compute garbage (static batch); their writes
+            # are dropped via the out-of-range sentinel
+            blk_ids = jnp.where(active, blk_ids, nb)
+            new_pool = dict(pool)
+            for i, k in enumerate(kv_keys):
+                new_pool[k] = pool[k].at[blk_ids].set(
+                    written[i].astype(pool[k].dtype), mode="drop"
+                )
+            out = (new_pool, nxts, new_keys)
+            if cfg.collect_logits:
+                out = out + (res[4],)
+            return out
+
+        return decode
+
+    # -- admission ----------------------------------------------------------
+
+    def _validate_submission(self, prompt, max_new_tokens, temperature,
+                             deadline_s) -> Tuple[
+            Optional[np.ndarray], int, float, Optional[float],
+            Optional[str], Optional[str]]:
+        """(prompt_array, max_new, temperature, deadline_s, reason,
+        detail) — reason None = valid. On invalid input the parsed
+        fields fall back to inert defaults so the rejected Request
+        still constructs: ``submit`` NEVER raises on bad client input,
+        it sheds with a reason."""
+        def bad(detail, reason="malformed"):
+            return None, 1, 0.0, None, reason, detail
+
+        try:
+            n_new = int(max_new_tokens)
+        except (TypeError, ValueError):
+            return bad(f"max_new_tokens {max_new_tokens!r} is not an "
+                       f"integer")
+        try:
+            temp = float(temperature)
+        except (TypeError, ValueError):
+            return bad(f"temperature {temperature!r} is not a number")
+        try:
+            ddl = None if deadline_s is None else float(deadline_s)
+        except (TypeError, ValueError):
+            return bad(f"deadline_s {deadline_s!r} is not a number")
+        try:
+            arr = np.asarray(prompt)
+        except Exception:
+            return bad("prompt is not array-like")
+        if arr.ndim != 1 or arr.size == 0:
+            return bad(f"prompt must be a nonempty 1-d token array, got "
+                       f"shape {arr.shape}")
+        if not np.issubdtype(arr.dtype, np.integer):
+            return bad(f"prompt dtype {arr.dtype} not integer")
+        if self._vocab and (arr.min() < 0 or arr.max() >= self._vocab):
+            return bad(f"prompt token out of vocab [0, {self._vocab})")
+        if n_new < 1:
+            return bad(f"max_new_tokens must be >= 1, got {n_new}")
+        cfg = self.config
+        if arr.size > cfg.prefill_buckets[-1]:
+            return bad(
+                f"prompt ({arr.size}) exceeds the largest prefill bucket "
+                f"({cfg.prefill_buckets[-1]})", reason="too_long")
+        if arr.size + n_new > cfg.max_seq_len:
+            return bad(
+                f"prompt ({arr.size}) + max_new_tokens ({n_new}) exceeds "
+                f"max_seq_len ({cfg.max_seq_len})", reason="too_long")
+        return arr.astype(np.int32), n_new, temp, ddl, None, None
+
+    def estimated_ttft_s(self) -> Optional[float]:
+        """Admission-time TTFT estimate for a NEW submission: queue depth
+        x the measured per-admission cost (prefill + one decode tick,
+        EMAs), scaled by the per-tick admission width. None until the
+        first prefill measured (the budget arms with the estimator)."""
+        if self._prefill_ema is None:
+            return None
+        per = self._prefill_ema + (self._decode_ema or 0.0)
+        width = max(1, self.config.max_prefills_per_tick)
+        return (len(self._queue) + 1) * per / width
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0,
+               deadline_s: Optional[float] = None) -> Request:
+        """Admission control at the door (module docstring): the request
+        is QUEUED, or REJECTED with a booked reason — this method never
+        raises on bad input and never buffers beyond the bounds."""
+        self._ensure_started()
+        now = self.time_fn()
+        rid = self._next_rid
+        self._next_rid += 1
+        if deadline_s is None:
+            deadline_s = self.config.default_deadline_s
+        arr, n_new, temp, ddl, bad_reason, detail = (
+            self._validate_submission(
+                prompt, max_new_tokens, temperature, deadline_s))
+        req = Request(
+            rid=rid, prompt=arr, max_new_tokens=max(n_new, 1),
+            temperature=temp, deadline_s=ddl, submit_t=now,
+        )
+        self._requests[rid] = req
+
+        def reject(reason, **extra):
+            transition(req, REJECTED, now=now, reason=reason)
+            emit_request_record(self.router, self._tick, req, **extra)
+            logger.warning("request %d rejected (%s)%s", rid, reason,
+                           f": {detail}" if detail else "")
+            return req
+
+        if self._draining:
+            return reject("draining")
+        if bad_reason is not None:
+            return reject(bad_reason, detail=detail)
+        # TTFT estimate first: it is the stronger signal (a shallow queue
+        # over a slow engine is still an unmeetable wait); the depth
+        # bound is the fallback for the cold window before EMAs exist
+        est = self.estimated_ttft_s()
+        if (self.config.ttft_budget_s is not None and est is not None
+                and est > self.config.ttft_budget_s):
+            return reject("ttft_budget", estimated_ttft_s=est)
+        if len(self._queue) >= self.config.max_queue_depth:
+            return reject("queue_full")
+        transition(req, QUEUED, now=now)
+        self._queue.append(req)
+        emit_request_record(self.router, self._tick, req)
+        return req
+
+    def cancel(self, rid: int) -> bool:
+        """Client abandon: evict ``rid`` wherever it is; True if it was
+        live (terminal/unknown requests are a no-op)."""
+        req = self._requests.get(rid)
+        if req is None or req.terminal:
+            return False
+        if req.state == QUEUED:
+            self._queue.remove(req)
+            transition(req, CANCELLED, now=self.time_fn(),
+                       reason="client_cancel")
+            emit_request_record(self.router, self._tick, req)
+            return True
+        self._release(req, CANCELLED, "client_cancel")
+        return True
+
+    # -- placement ----------------------------------------------------------
+
+    def _free_lane(self) -> Optional[int]:
+        for lane in range(self.config.lanes):
+            if lane not in self._active:
+                return lane
+        return None
+
+    def _bucket_for(self, prompt_len: int) -> int:
+        for b in self.config.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise AssertionError("validated at submit")  # pragma: no cover
+
+    def _try_place(self, req: Request) -> Optional[
+            Tuple[int, Tuple[int, ...], int]]:
+        """(lane, blocks, bucket) or None when capacity is short — the
+        request then WAITS in the bounded queue (admission shed happens
+        at submit; capacity waits are what deadlines bound)."""
+        lane = self._free_lane()
+        if lane is None:
+            return None
+        P = self._bucket_for(req.prompt_len)
+        cfg = self.config
+        # worst case up front (kvcache.py): decode can never deadlock on
+        # pool memory mid-request
+        need = max(
+            blocks_needed(req.prompt_len + req.max_new_tokens,
+                          cfg.block_size),
+            P // cfg.block_size,
+        )
+        ids = self.allocator.alloc(need)
+        if ids is None:
+            return None
+        return lane, ids, P
+
+    # -- the tick loop ------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if not self._started:
+            self.start()
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self._active
+
+    @property
+    def steady_state_compiles(self) -> int:
+        """Compiles observed AFTER start() finished — the zero-recompile
+        contract's violation counter (0 in a healthy steady state)."""
+        return self._steady_compiles
+
+    def tick(self) -> int:
+        """One scheduler iteration (module docstring); returns the tick
+        number just executed."""
+        self._ensure_started()
+        t = self._tick
+        now = self.time_fn()
+        self._expire(now)
+        if self.fault_plan is not None:
+            # the wedge fault blocks HERE, inside the loop the watchdog
+            # guards — exactly like the training examples inject it
+            self.fault_plan.maybe_hang(t)
+        n_pref = 0
+        while (self._queue and not self._draining
+               and n_pref < self.config.max_prefills_per_tick):
+            placement = self._try_place(self._queue[0])
+            if placement is None:
+                break
+            req = self._queue.popleft()
+            lane, blocks, P = placement
+            req.lane, req.blocks, req.bucket = lane, blocks, P
+            transition(req, ADMITTED, now=self.time_fn())
+            emit_request_record(self.router, t, req)
+            self._run_prefill(req, t)
+            n_pref += 1
+        if self._active:
+            self._run_decode(t)
+        if self.watchdog is not None:
+            self.watchdog.beat(t)
+        if self._compile_watch is not None:
+            rec = self._compile_watch.on_step(t)
+            if rec is not None:
+                self._steady_compiles += int(rec.get("compiles", 0))
+                logger.warning(
+                    "serving steady-state compile at tick %d — a shape "
+                    "escaped the AOT buckets", t,
+                )
+        self._tick += 1
+        return t
+
+    def _run_prefill(self, req: Request, t: int) -> None:
+        cfg = self.config
+        transition(req, PREFILL, now=self.time_fn())
+        emit_request_record(self.router, t, req)
+        L, P = req.prompt_len, req.bucket
+        n_pb = P // cfg.block_size
+        tokens = np.zeros((P,), np.int32)
+        tokens[:L] = req.prompt
+        block_ids = np.full((n_pb,), cfg.num_blocks, np.int32)
+        k = min(n_pb, len(req.blocks))
+        block_ids[:k] = req.blocks[:k]
+        t0 = time.perf_counter()
+        try:
+            with span("prefill", router=self.router, step=t):
+                out = self._prefill_c[P](
+                    self._pool, tokens, np.int32(L), block_ids,
+                    np.float32(req.temperature), self._prefill_key,
+                )
+                self._pool, tok_dev, self._prefill_key = out[:3]
+                tok = int(np.asarray(tok_dev))
+        except Exception as e:
+            logger.exception("prefill failed for request %d", req.rid)
+            self.allocator.free(req.blocks)
+            transition(req, FAILED, now=self.time_fn(),
+                       reason=f"engine_error: {type(e).__name__}")
+            emit_request_record(self.router, t, req)
+            return
+        self._prefill_ema = _ema(
+            self._prefill_ema, time.perf_counter() - t0)
+        req.first_token_t = self.time_fn()
+        req.tokens_out.append(tok)
+        if cfg.collect_logits:
+            req.logits = (req.logits or []) + [np.asarray(out[3])]
+        if len(req.tokens_out) >= req.max_new_tokens:
+            # single-token request: prefill IS the whole generation
+            self.allocator.free(req.blocks)
+            transition(req, COMPLETED, now=self.time_fn())
+            emit_request_record(self.router, t, req)
+            return
+        transition(req, DECODE, now=self.time_fn())
+        emit_request_record(self.router, t, req)
+        lane = req.lane
+        self._tables[lane, :] = cfg.num_blocks
+        self._tables[lane, :len(req.blocks)] = req.blocks
+        self._positions[lane] = L
+        self._last_tok[lane] = tok
+        self._temps[lane] = req.temperature
+        self._lane_mask[lane] = True
+        self._active[lane] = req
+
+    def _run_decode(self, t: int) -> None:
+        cfg = self.config
+        t0 = time.perf_counter()
+        try:
+            with span("decode", router=self.router, step=t):
+                if self.fault_plan is not None:
+                    # injected INSIDE the span: the inflated tick is
+                    # exactly the span the stall warn flags
+                    self.fault_plan.maybe_slow_decode(t)
+                out = self._decode_c(
+                    self._pool, self._tables, self._positions,
+                    self._last_tok, self._temps, self._keys,
+                    self._lane_mask,
+                )
+                self._pool, nxts_dev, self._keys = out[:3]
+                nxts = np.asarray(nxts_dev)
+                logits_rows = (np.asarray(out[3])
+                               if cfg.collect_logits else None)
+        except Exception as e:
+            logger.exception("decode tick %d failed", t)
+            for req in list(self._active.values()):
+                self._release(
+                    req, FAILED, f"engine_error: {type(e).__name__}")
+            raise
+        self._decode_ema = _ema(self._decode_ema, time.perf_counter() - t0)
+        for lane, req in list(self._active.items()):
+            tok = int(nxts[lane])
+            req.tokens_out.append(tok)
+            if logits_rows is not None:
+                req.logits = (req.logits or []) + [logits_rows[lane]]
+            if len(req.tokens_out) >= req.max_new_tokens:
+                self._release(req, COMPLETED, None)
+            else:
+                self._positions[lane] += 1
+                self._last_tok[lane] = tok
+
+    def _release(self, req: Request, state: str,
+                 reason: Optional[str]) -> None:
+        """Evict ``req`` from its lane, reclaim its blocks, book the
+        terminal state — the ONE eviction path, so blocks can never
+        leak past an ending."""
+        lane = req.lane
+        if lane is not None and self._active.get(lane) is req:
+            del self._active[lane]
+            self._lane_mask[lane] = False
+            self._tables[lane, :] = self.config.num_blocks
+            self._positions[lane] = 0
+            self._last_tok[lane] = 0
+            self._temps[lane] = 0.0
+        self.allocator.free(req.blocks)
+        transition(req, state, now=self.time_fn(), reason=reason)
+        emit_request_record(self.router, self._tick, req)
+
+    def _expire(self, now: float) -> None:
+        """Deadline enforcement, EVERY tick, queue and batch alike."""
+        for req in [r for r in self._queue
+                    if r.expires_at() is not None
+                    and now > r.expires_at()]:
+            self._queue.remove(req)
+            transition(req, TIMED_OUT, now=now, reason="deadline")
+            emit_request_record(self.router, self._tick, req)
+        for req in [r for r in self._active.values()
+                    if r.expires_at() is not None
+                    and now > r.expires_at()]:
+            self._release(req, TIMED_OUT, "deadline")
+
+    # -- drain --------------------------------------------------------------
+
+    def drain(self, grace_s: Optional[float] = None,
+              deadline: Optional[float] = None) -> dict:
+        """Graceful drain: stop admitting, reject the still-queued,
+        finish or deadline-evict the in-flight within the grace budget,
+        and emit a terminal state for EVERY request (module docstring).
+
+        ``deadline`` is an absolute monotonic instant (the
+        ``TerminationNotice.grace_deadline()`` convention); ``grace_s``
+        is relative from now. With neither, the drain runs until the
+        batch empties (deadlines on the requests themselves still
+        apply). Returns a summary dict.
+        """
+        self._ensure_started()
+        self._draining = True
+        t0 = self.time_fn()
+        if deadline is None and grace_s is not None:
+            deadline = t0 + grace_s
+        inflight0 = list(self._active.values())
+        evicted = 0
+        with span("drain", router=self.router, step=self._tick):
+            while self._queue:
+                req = self._queue.popleft()
+                transition(req, REJECTED, now=self.time_fn(),
+                           reason="draining")
+                emit_request_record(self.router, self._tick, req)
+            while self._active:
+                if deadline is not None and self.time_fn() > deadline:
+                    for req in list(self._active.values()):
+                        self._release(req, TIMED_OUT, "drain_deadline")
+                        evicted += 1
+                    break
+                self.tick()
+        # summarize by the ACTUAL endings of the requests that were in
+        # flight at drain start — a request whose OWN deadline expired
+        # inside the window is a timeout, not a finish; the jsonl stream
+        # is the ground truth this summary must not contradict
+        finished = sum(1 for r in inflight0 if r.state == COMPLETED)
+        timed_out = sum(1 for r in inflight0
+                        if r.state == TIMED_OUT
+                        and r.reason != "drain_deadline")
+        out = {
+            "drain_s": self.time_fn() - t0,
+            "finished": finished,
+            "evicted": evicted,
+            "timed_out": timed_out,
+        }
+        logger.info(
+            "drain complete in %.3fs: %d finished, %d deadline-evicted, "
+            "%d timed out on their own deadlines",
+            out["drain_s"], finished, evicted, timed_out,
+        )
+        return out
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- introspection ------------------------------------------------------
+
+    def inflight_table(self) -> dict:
+        """The forensic in-flight table for the incident bundle
+        (``IncidentResponder(bundle_extra=engine.inflight_table)``):
+        lock-free best-effort reads only."""
+        rows = []
+        for lane, req in list(self._active.items()):
+            rows.append({
+                "id": req.rid, "lane": lane, "state": req.state,
+                "prompt_len": req.prompt_len,
+                "tokens_out": len(req.tokens_out),
+                "max_new": req.max_new_tokens,
+                "deadline_s": req.deadline_s,
+            })
+        return {
+            "requests": rows,
+            "queued": len(self._queue),
+            "tick": self._tick,
+            "free_blocks": self.allocator.free_blocks,
+        }
+
+    def requests(self) -> List[Request]:
+        return list(self._requests.values())
+
+    def stats(self) -> dict:
+        """Aggregate serving outcome (docs/serving.md): per-terminal
+        counts, shed reasons, TTFT percentiles over requests that got a
+        first token, and the zero-recompile violation counter."""
+        from apex_tpu.serving.loadgen import percentile
+
+        counts: Dict[str, int] = {}
+        reasons: Dict[str, int] = {}
+        ttfts: List[float] = []
+        tokens = 0
+        live = 0
+        for req in self._requests.values():
+            if req.terminal:
+                counts[req.state] = counts.get(req.state, 0) + 1
+                if req.reason:
+                    reasons[req.reason] = reasons.get(req.reason, 0) + 1
+            else:
+                live += 1
+            if req.ttft_s is not None:
+                ttfts.append(req.ttft_s)
+            tokens += len(req.tokens_out)
+        return {
+            "submitted": self._next_rid,
+            "live": live,
+            "terminal": counts,
+            "reasons": reasons,
+            "tokens_out": tokens,
+            "ttft_p50_s": percentile(ttfts, 50.0),
+            "ttft_p99_s": percentile(ttfts, 99.0),
+            "prefill_ema_s": self._prefill_ema,
+            "decode_ema_s": self._decode_ema,
+            "ticks": self._tick,
+            "steady_state_compiles": self._steady_compiles,
+            "free_blocks": self.allocator.free_blocks,
+        }

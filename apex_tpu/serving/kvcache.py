@@ -1,0 +1,215 @@
+"""Block-allocated KV cache: a bounded pool, per-request block tables.
+
+The serving engine's KV memory is the scarce resource admission control
+reasons about. Instead of one contiguous ``(lanes, max_seq_len, ...)``
+cache sized for every lane's worst case, the cache is a POOL of
+fixed-size blocks (``block_size`` token slots each, the vLLM paged-KV
+idea at allocation granularity):
+
+- each layer's ``cached_key`` / ``cached_value`` live as
+  ``(num_blocks, h_kv, block_size, head_dim)`` arrays — ONE donated
+  pytree threaded through the compiled prefill/decode steps, so
+  steady-state serving reuses the same HBM in place;
+- each admitted request owns a BLOCK TABLE row: lane-local block ``j``
+  maps to pool block ``table[j]``. Unreserved entries carry the
+  out-of-range sentinel ``num_blocks`` — gathers clip them onto an
+  arbitrary in-range block (``num_blocks - 1``), whose stale bytes are
+  safe NOT because of which block it is but because the decode validity
+  mask excludes them: lane positions beyond the request's reservation
+  are always ``> cache_index``. Scatters drop sentinel entries outright
+  (``mode="drop"``);
+- the host-side :class:`BlockAllocator` hands out blocks atomically
+  (all-or-nothing) and admission reserves a request's WORST CASE
+  (``ceil((prompt+max_new)/block_size)``, plus the prefill bucket's
+  span) up front — conservative by design: a mid-decode request can
+  then never deadlock on pool memory, so no preemption/eviction
+  machinery is needed to stay safe, and "not enough blocks" is a clean
+  queue-wait the admission TTFT estimate absorbs. The cost is bucket-
+  granularity over-reservation, documented in docs/serving.md.
+
+The compiled steps reuse the MODEL's own cache machinery
+(transformer/layer.py "cache" variables) unchanged: per lane, the pool
+blocks are gathered into the contiguous per-layer layout the model
+expects, the model's prefill/decode writes into that contiguous view,
+and only the touched block is scattered back. :class:`CacheSpec` is the
+bridge — it records, from one ``jax.eval_shape`` of a prefill, which
+cache leaves are K/V payload and which are the scalar ``cache_index``
+bookkeeping, and refuses cache layouts it does not understand
+(context-parallel ``prompt_len_local``, future variables) rather than
+guessing.
+"""
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["BlockAllocator", "CacheSpec", "blocks_needed"]
+
+
+def blocks_needed(total_tokens: int, block_size: int) -> int:
+    """ceil(total_tokens / block_size) — the reservation arithmetic."""
+    return -(-int(total_tokens) // int(block_size))
+
+
+class BlockAllocator:
+    """Host-side free-list over the KV pool's ``num_blocks`` blocks.
+
+    ``alloc(n)`` is atomic: it returns ``n`` distinct block ids or None
+    (never a partial grant — a half-reserved request would be exactly
+    the deadlock the conservative reservation exists to prevent).
+    ``free(ids)`` returns blocks to the pool; double-frees and unknown
+    ids are refused loudly (a double-free means two requests think they
+    own one block — the corruption must not be silent). jax-free.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._allocated: set = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[Tuple[int, ...]]:
+        """``n`` distinct block ids, or None when the pool cannot cover
+        the request (all-or-nothing)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            return None
+        ids = tuple(self._free.pop() for _ in range(n))
+        self._allocated.update(ids)
+        return ids
+
+    def free(self, ids) -> None:
+        for b in ids:
+            b = int(b)
+            if b not in self._allocated:
+                raise ValueError(
+                    f"freeing block {b} that is not allocated — a "
+                    f"double-free means two requests claimed one block"
+                )
+            self._allocated.discard(b)
+            self._free.append(b)
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheLeaf:
+    """One leaf of the model's cache collection, classified."""
+
+    path: Tuple[str, ...]        # nested-dict key path
+    kind: str                    # "kv" | "index"
+    shape: Tuple[int, ...]       # the PREFILL leaf shape (b=1 layout)
+    dtype: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheSpec:
+    """The bridge between the model's cache pytree and the block pool.
+
+    Built once from an abstract prefill (:meth:`from_cache_shapes`);
+    thereafter :meth:`pool_shapes` names the pool leaves (keyed by the
+    joined cache path — a flat dict is the donated pytree), and the
+    engine's compiled steps use the path lists to (a) rebuild the
+    nested cache dict the model expects from gathered pool blocks and
+    (b) pick the written block back out of the model's updated cache.
+    """
+
+    kv_leaves: Tuple[CacheLeaf, ...]
+    index_leaves: Tuple[CacheLeaf, ...]
+
+    @staticmethod
+    def _classify(path: Tuple[str, ...], shape, dtype) -> CacheLeaf:
+        name = path[-1]
+        if name in ("cached_key", "cached_value"):
+            if len(shape) != 4 or shape[0] != 1:
+                raise ValueError(
+                    f"cache leaf {'/'.join(path)} has shape {shape}; the "
+                    f"serving pool understands the (1, h_kv, slots, "
+                    f"head_dim) single-sequence prefill layout only"
+                )
+            return CacheLeaf(path, "kv", tuple(shape), dtype)
+        if name == "cache_index":
+            return CacheLeaf(path, "index", tuple(shape), dtype)
+        raise ValueError(
+            f"unrecognized cache variable {'/'.join(path)} — the serving "
+            f"engine reuses the model's cache layout and refuses layouts "
+            f"it does not understand (context-parallel decode caches "
+            f"carry prompt_len_local; serve with cp disabled)"
+        )
+
+    @classmethod
+    def from_cache_shapes(cls, cache_shapes: Dict[str, Any]) -> "CacheSpec":
+        """Build from the ``{"cache": ...}`` ShapeDtypeStruct pytree of
+        an abstract (``jax.eval_shape``) single-sequence prefill."""
+        kv: List[CacheLeaf] = []
+        idx: List[CacheLeaf] = []
+
+        def walk(node, path):
+            if isinstance(node, dict):
+                for k in sorted(node):
+                    walk(node[k], path + (str(k),))
+                return
+            leaf = cls._classify(path, tuple(node.shape), node.dtype)
+            (kv if leaf.kind == "kv" else idx).append(leaf)
+
+        walk(cache_shapes, ())
+        if not kv:
+            raise ValueError(
+                "no cached_key/cached_value leaves found — does the model "
+                "support cache_len= prefill? (models.generate contract)"
+            )
+        return cls(kv_leaves=tuple(kv), index_leaves=tuple(idx))
+
+    @staticmethod
+    def key(path: Tuple[str, ...]) -> str:
+        return "/".join(path)
+
+    def pool_shapes(self, num_blocks: int,
+                    block_size: int) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+        """``{pool_key: ((num_blocks, h_kv, block_size, hd), dtype)}``."""
+        out = {}
+        for leaf in self.kv_leaves:
+            _, h_kv, _, hd = leaf.shape
+            out[self.key(leaf.path)] = (
+                (int(num_blocks), h_kv, int(block_size), hd), leaf.dtype
+            )
+        return out
+
+    def build_cache(self, kv_arrays: Dict[str, Any], index_value) -> dict:
+        """The nested cache dict the model expects, from per-leaf
+        contiguous K/V arrays (keyed like :meth:`pool_shapes`) and the
+        per-lane ``cache_index`` scalar."""
+        cache: dict = {}
+
+        def insert(path, value):
+            node = cache
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = value
+
+        for leaf in self.kv_leaves:
+            insert(leaf.path, kv_arrays[self.key(leaf.path)])
+        for leaf in self.index_leaves:
+            insert(leaf.path, index_value)
+        return cache
+
+    def kv_from_cache(self, cache: dict) -> Dict[str, Any]:
+        """Extract the K/V leaves of a (possibly updated) cache dict,
+        keyed like :meth:`pool_shapes`."""
+        out = {}
+        for leaf in self.kv_leaves:
+            node = cache
+            for k in leaf.path:
+                node = node[k]
+            out[self.key(leaf.path)] = node
+        return out

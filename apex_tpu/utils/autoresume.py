@@ -101,7 +101,7 @@ from apex_tpu.utils.checkpoint import (
     load_checkpoint,
 )
 
-__all__ = ["AutoResume", "GRACE_ENV"]
+__all__ = ["AutoResume", "TerminationNotice", "GRACE_ENV"]
 
 logger = logging.getLogger("apex_tpu.utils.autoresume")
 
@@ -125,6 +125,90 @@ def _env_grace() -> Optional[float]:
 def _ema(old: Optional[float], x: float, alpha: float = 0.5) -> float:
     """Recent-weighted EMA; seeds from the first sample."""
     return x if old is None else (1.0 - alpha) * old + alpha * x
+
+
+class TerminationNotice:
+    """Flag-only SIGTERM latch for non-checkpoint consumers.
+
+    :class:`AutoResume` couples the SIGTERM flag to checkpoint IO; a
+    consumer that only needs to KNOW a termination arrived — the serving
+    engine's graceful drain (docs/serving.md) stops admitting and
+    deadline-evicts in-flight decodes, it has no training state to
+    save — needs the flag without the directory. This latch lives here
+    because ``utils/autoresume.py`` is blessed home #1 of raw signal
+    registration (``lint.signal-handlers``): the handler stores one
+    bool + one monotonic float (async-signal-safe, no IO) and then
+    CHAINS to whatever flag-style handler was installed before it
+    (AutoResume's preemption flag), so stacking loses neither. The one
+    handler it deliberately does NOT chain is the router module's
+    SIGTERM teardown hook, which flushes and then re-raises to DIE by
+    the signal — with a notice installed the signal means "drain
+    gracefully", not "die", so that hook is superseded (see
+    :meth:`_on_signal`).
+
+    ``grace_s`` defaults to the PR-8 preemption budget
+    (``APEX_TPU_PREEMPTION_GRACE_S``): :meth:`grace_deadline` is the
+    monotonic instant by which a drain must be done.
+    """
+
+    def __init__(self, signals: Sequence[int] = (_signal.SIGTERM,),
+                 install_handlers: bool = True,
+                 grace_s: Optional[float] = None):
+        self.grace_s = grace_s if grace_s is not None else _env_grace()
+        self._signaled = False
+        self._signal_t: Optional[float] = None
+        self._prev_handlers = {}
+        if install_handlers:
+            for sig in signals:
+                self._prev_handlers[sig] = _signal.signal(
+                    sig, self._on_signal
+                )
+
+    def _on_signal(self, signum, frame):
+        # flag + timestamp only (async-signal-safe), then chain: a
+        # previously-installed AutoResume handler (flag-only, like this
+        # one) still runs — a notice must observe, not preempt. The ONE
+        # exception is the router module's teardown hook (monitor/
+        # router.py): it exists to flush spans before an otherwise-
+        # FATAL SIGTERM and re-raises the signal to die by it — chained
+        # from here it would kill the very process the notice exists to
+        # drain gracefully. With a notice installed the signal is no
+        # longer fatal, so that hook is superseded: the flush happens
+        # at the drain's normal router close / atexit instead.
+        self._signaled = True
+        if self._signal_t is None:
+            self._signal_t = time.monotonic()
+        prev = self._prev_handlers.get(signum)
+        if (callable(prev)
+                and not getattr(prev, "_apex_tpu_router_teardown", False)):
+            prev(signum, frame)
+
+    @property
+    def signaled(self) -> bool:
+        """True once a termination signal arrived (host-local)."""
+        return self._signaled
+
+    def request(self) -> None:
+        """Arm the latch programmatically (tests; in-process drills)."""
+        self._signaled = True
+        if self._signal_t is None:
+            self._signal_t = time.monotonic()
+
+    def grace_deadline(self) -> Optional[float]:
+        """Monotonic deadline for post-signal work (arrival +
+        ``grace_s``); None while un-signaled or with no budget."""
+        if self._signal_t is None or self.grace_s is None:
+            return None
+        return self._signal_t + self.grace_s
+
+    def close(self) -> None:
+        """Restore the previous handlers (idempotent)."""
+        for sig, h in self._prev_handlers.items():
+            try:
+                _signal.signal(sig, h)
+            except (ValueError, OSError):  # non-main thread teardown
+                pass
+        self._prev_handlers = {}
 
 
 class AutoResume:

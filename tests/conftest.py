@@ -52,6 +52,9 @@ _SLOW_TESTS = {
     "test_gpt_compression_resume_migration",
     "test_elastic_selftest_gate",
     "test_replay_selftest_gate",
+    "test_serving_selftest_gate",
+    "test_serving_wedged_decode_bundle",
+    "test_serving_overload_drill",
     "test_cross_process_determinism",
     "test_gpt_replay_bitflip_drill",
     "test_gpt_elastic_chaos_drill",

@@ -163,7 +163,7 @@ class TestAccountant:
         assert rep.badput_s == {
             "ckpt_save": 0.5, "ckpt_restore": 2.0, "rollback": 0.0,
             "compile": 3.0, "data_wait": 0.0, "stall": 0.0,
-            "incident": 0.0, "init": 2.0, "shutdown": 0.0,
+            "incident": 0.0, "drain": 0.0, "init": 2.0, "shutdown": 0.0,
         }
         assert rep.unattributed_s == 0.0
         assert rep.incarnations == 3
@@ -225,6 +225,29 @@ class TestAccountant:
         assert rep.wall_s == 13.5 and rep.incarnations == 3
         other = accountant.account(recs, run_id="other")
         assert other.wall_s == 50.0 and other.incarnations == 1
+
+    def test_serving_phases_are_productive_and_drain_is_envelope(self):
+        # serving taxonomy (PR 13): prefill/decode seconds are the
+        # serving analogue of step seconds (PRODUCTIVE_PHASES), and a
+        # drain span is an ENVELOPE — the decode ticks inside it stay
+        # productive, only the exposed remainder books as drain badput.
+        # Hand count: wall [0,10]; prefill [0,2] + decode [2,5]+[6,8]
+        # productive = 7.0; drain envelope [5,10] minus the covered
+        # [6,8] = 3.0 badput; unattributed [5,6)? no — drain covers it.
+        recs = [
+            _header(0.0),
+            _span("prefill", 0.0, 2.0),
+            _span("decode", 2.0, 3.0),
+            _span("drain", 5.0, 5.0),
+            _span("decode", 6.0, 2.0),
+        ]
+        rep = accountant.account(recs)
+        assert rep.wall_s == 10.0
+        assert rep.productive_s == 7.0
+        assert rep.badput_s["drain"] == 3.0
+        assert rep.unattributed_s == 0.0
+        f = rep.fields()
+        assert "badput_drain_s" in f and "badput_prefill_s" not in f
 
     def test_headerless_legacy_stream(self):
         rep = accountant.account([_span("step", 2.0, 3.0)])

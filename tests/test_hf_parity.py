@@ -345,6 +345,108 @@ def test_topk_topp_filtering():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_sampling_edges_pinned():
+    """The serving-facing sampling edges (ISSUE 13 satellite), pinned:
+
+    - ``top_k >= vocab`` is an exact no-op (not merely equivalent-by-
+      accident through the sort);
+    - ``top_p = 1.0`` keeps the FULL mass — no token may be lost to
+      cumulative-sum rounding at the boundary;
+    - ``top_k < 1`` and ``top_p <= 0`` refuse with a reasoned error
+      instead of sampling from an empty keep-set;
+    - ``temperature = 0`` is deterministic argmax regardless of rng;
+    - ``sample_next_token`` (the traced-temperature serving variant)
+      agrees with the greedy path at t=0 and stays inside the top-k
+      set when sampling.
+    """
+    from apex_tpu.models.generate import _filter_logits, sample_next_token
+
+    logits = jnp.asarray([[2.0, -1.0, 0.5, -3.0, 1.0]])
+    vocab = logits.shape[-1]
+
+    for k in (vocab, vocab + 1, 10 * vocab):
+        np.testing.assert_array_equal(
+            np.asarray(_filter_logits(logits, top_k=k, top_p=None)),
+            np.asarray(logits),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(logits, top_k=None, top_p=1.0)),
+        np.asarray(logits),
+    )
+    # near-boundary: a distribution whose cumsum rounds to 1.0 before
+    # the last slot must still keep every token at top_p=1.0
+    tiny = jnp.asarray([[0.0, -20.0, -40.0, -60.0]])
+    np.testing.assert_array_equal(
+        np.asarray(_filter_logits(tiny, top_k=None, top_p=1.0)),
+        np.asarray(tiny),
+    )
+    with pytest.raises(ValueError, match="top_k must be >= 1"):
+        _filter_logits(logits, top_k=0, top_p=None)
+    with pytest.raises(ValueError, match="top_p must be in"):
+        _filter_logits(logits, top_k=None, top_p=0.0)
+
+    # temperature=0 is argmax, rng-independent
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generate import generate
+    from apex_tpu.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4, vocab_size=37,
+        max_position_embeddings=32, hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    model = GPTModel(config=cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 5), 0, 37)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+    a = generate(model, variables, prompt, max_new_tokens=5,
+                 temperature=0.0, rng=jax.random.PRNGKey(1))
+    b = generate(model, variables, prompt, max_new_tokens=5,
+                 temperature=0.0, rng=jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    full = model.apply(variables, a[:, :-1])
+    np.testing.assert_array_equal(
+        np.asarray(a[:, -1]),
+        np.asarray(jnp.argmax(full[:, -1].astype(jnp.float32), -1)),
+    )
+
+    # the traced-temperature serving variant: t=0 == argmax; t>0 with
+    # top_k=1 is still the argmax (the kept set is a single token)
+    row = jnp.asarray([0.1, 3.0, -1.0, 0.2])
+    key = jax.random.PRNGKey(7)
+    assert int(sample_next_token(row, jnp.float32(0.0), key)) == 1
+    assert int(sample_next_token(row, jnp.float32(1.3), key, top_k=1)) == 1
+    batched = sample_next_token(
+        jnp.stack([row, row[::-1]]),
+        jnp.float32(0.0), key,
+    )
+    np.testing.assert_array_equal(np.asarray(batched), [1, 2])
+
+
+def test_position_bound_refusal_pinned():
+    """``_check_position_bound`` refuses (reasoned error, not clamped
+    garbage) when prompt + max_new_tokens exceeds a learned-position
+    model's table — through both ``generate`` and ``beam_search``."""
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generate import beam_search, generate
+    from apex_tpu.transformer import TransformerConfig
+
+    cfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4, vocab_size=37,
+        max_position_embeddings=8, hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    model = GPTModel(config=cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 6), 0, 37)
+    variables = model.init(jax.random.PRNGKey(0), prompt)
+
+    # 6 + 2 == 8 fits; 6 + 3 would gather clamped garbage -> refuse
+    out = generate(model, variables, prompt, max_new_tokens=2)
+    assert out.shape == (1, 8)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(model, variables, prompt, max_new_tokens=3)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        beam_search(model, variables, prompt, max_new_tokens=3, num_beams=2)
+
+
 class _MarkovLM(nn.Module):
     """Stub LM whose next-token logits depend only on the current token —
     a lookup table, so beam-search outcomes are analytically known."""
