@@ -65,7 +65,7 @@ def main(argv=None) -> int:
     parser.add_argument("--skip-timeline", action="store_true",
                         help="skip the profiler trace-schema smoke check")
     parser.add_argument("--target",
-                        choices=("gpt", "gpt-compressed", "bert"),
+                        choices=("gpt", "gpt-compressed", "bert", "gpt-pp"),
                         default=None,
                         help="audit only one step builder")
     args = parser.parse_args(argv)
@@ -90,12 +90,17 @@ def main(argv=None) -> int:
             # allowlist it away
             "gpt-compressed": targets_mod.gpt_compressed_step_target,
             "bert": targets_mod.bert_step_target,
+            # LAST: the zero-bubble pipeline target builds its own
+            # dp2xpp2 mesh, re-initializing the global parallel_state —
+            # the differ audits its hand-written backward p2p edges and
+            # prefetched ZeRO gathers with zero comms suppressions
+            "gpt-pp": lambda _mesh: targets_mod.gpt_pp_step_target(),
         }
         names = [args.target] if args.target else list(builders)
         for name in names:
             target = builders[name](mesh)
             print(f"auditing step target {target.name!r} "
-                  f"(mesh {dict(mesh.shape)})", flush=True)
+                  f"(mesh {dict(target.mesh.shape)})", flush=True)
             findings.extend(passes_mod.run_passes(target))
     if not args.skip_timeline:
         # trace-schema smoke (analysis/trace_smoke.py): a tiny REAL

@@ -36,6 +36,16 @@ sides:
   tuple form (one operand per participant); same-shaped operands of one
   all-to-all instruction are folded back into the single logical payload
   the ledger predicted before matching.
+- ``comms.async``       (info) — positive confirmation that ledger-matched
+  collectives were emitted as async ``-start``/``-done`` pairs: the
+  compiler actually split them so its latency-hiding scheduler can
+  overlap their wire time with compute — the emitted-HLO leg of the
+  overlap proof loop (the prefetched ZeRO param gathers and the
+  zero-bubble p2p edges are the callers that cite this), with
+  predicted==emitted bytes carried in the finding data. Backend-honest:
+  CPU XLA emits sync collectives, so the finding appears only where the
+  backend's scheduler can overlap (TPU compiles); its absence on the
+  CPU gate is expected, not a failure.
 
 Matching currency is (op-class, mesh axis, OPERAND element count) —
 elements, not bytes, because backends legalize dtypes without changing
@@ -409,6 +419,32 @@ def audit_comms(
             ),
             site=site0, severity=SEV_INFO, target=target,
             data={"axis": axis, "ops": d["ops"], "bytes": d["bytes"]},
+        ))
+
+    # stage 6 — POSITIVE confirmation of async -start/-done emission:
+    # matched collectives XLA split into start/done pairs are overlappable
+    # by its latency-hiding scheduler. Per (axis, op class) so "the
+    # prefetched gathers were emitted async with predicted==emitted
+    # bytes" is a record in the stream, not the absence of an error.
+    async_matched: Dict[Tuple[str, str], Dict[str, int]] = {}
+    for u in matched:
+        if not u.instr.is_async:
+            continue
+        d = async_matched.setdefault((u.axis, u.kind), {"ops": 0, "bytes": 0})
+        d["ops"] += 1
+        d["bytes"] += u.nbytes
+    for (axis, kind), d in sorted(async_matched.items()):
+        findings.append(Finding(
+            rule="comms.async",
+            message=(
+                f"async overlap pattern verified over {axis!r}: "
+                f"{d['ops']} {kind} op(s) emitted as -start/-done pairs, "
+                f"{d['bytes']} payload bytes, all matched to ledger "
+                f"predictions (predicted == emitted)"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+            data={"axis": axis, "op": kind, "ops": d["ops"],
+                  "bytes": d["bytes"]},
         ))
     return findings
 

@@ -239,7 +239,14 @@ class HloCollective:
     ``replica_groups`` is how every collective EXCEPT collective-permute
     spells its participants; permutes instead print
     ``source_target_pairs={{src,dst},...}`` (captured in
-    ``source_target_pairs``, with ``replica_groups`` left empty)."""
+    ``source_target_pairs``, with ``replica_groups`` left empty).
+
+    ``is_async`` marks the ``-start`` spelling: XLA split the op into a
+    ``-start``/``-done`` pair, i.e. the scheduler may overlap its wire
+    time with compute between the halves — the emitted-HLO evidence the
+    overlap-aware schedules' proof loop reads (the timeline analyzer
+    fuses the same pairs into in-flight intervals on the measured
+    side)."""
 
     kind: str  # one of COLLECTIVE_KINDS
     name: str  # %all-reduce.50
@@ -253,6 +260,7 @@ class HloCollective:
     source_line: int
     line: int  # 1-based line in the module text
     source_target_pairs: Tuple[Tuple[int, int], ...] = ()
+    is_async: bool = False  # emitted as a -start/-done pair
 
     @property
     def group_size(self) -> int:
@@ -540,7 +548,8 @@ def parse_hlo_module(compiled_or_text) -> HloModule:
         kind = opcode
         if kind.endswith("-done"):
             continue
-        if kind.endswith("-start"):
+        is_async = kind.endswith("-start")
+        if is_async:
             kind = kind[: -len("-start")]
         if kind not in COLLECTIVE_KINDS:
             continue
@@ -566,5 +575,6 @@ def parse_hlo_module(compiled_or_text) -> HloModule:
             source_file=source_file,
             source_line=source_line,
             line=lineno,
+            is_async=is_async,
         ))
     return module

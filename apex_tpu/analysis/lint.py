@@ -35,6 +35,19 @@ Plus the new rules this framework exists to host:
   f64 at a fraction of rate, and a single f64 literal poisons every
   dtype downstream of it. (Host-side ``np.float64`` index math is fine
   and not flagged.)
+- ``lint.prefetch-gather`` — no Python-``for``-loop-issued gather
+  pipelines (``all_gather``/``psum_scatter`` called inside a ``for``
+  body) outside the blessed home,
+  ``optimizers/distributed_fused_adam.py``'s ``zero_prefetch_gather``.
+  A loop of per-bucket collectives is a hand-rolled prefetch/overlap
+  pipeline: its depth is a perf-critical knob that must come from the
+  ICI roofline model (``choose_overlap_buckets``), its buckets must
+  reconstruct the flat buffer exactly, and its gathers must stay
+  ledger-routed — three invariants that drift the moment a second copy
+  of the loop appears. Scan/vmap-issued collectives (one traced op) and
+  straight-line repeated gathers are not flagged — only the
+  loop-of-collectives fingerprint is. Reason-carrying allowlist entries
+  only (the home carries a require_hit entry).
 - ``lint.compressed-collective`` — no quantize/dequant + collective
   composition outside ``parallel/compress.py`` (the ledger-accounting
   home rule, same shape as ``lint.raw-collective``): a function that
@@ -689,6 +702,71 @@ def compressed_collective(ctx: LintContext) -> Iterable[Finding]:
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                     data={"quant": quant, "collective": coll,
                           "function": node.name},
+                )
+
+
+#: the gather collectives lint.prefetch-gather polices inside for-loops
+#: (psum/ppermute in a loop are schedule edges, not bucket pipelines)
+_PREFETCH_GATHER_OPS = frozenset({"all_gather", "psum_scatter"})
+
+
+@lint_rule("lint.prefetch-gather", scopes=("apex_tpu/", "examples/"))
+def prefetch_gather(ctx: LintContext) -> Iterable[Finding]:
+    """Python-for-loop gather pipelines outside the blessed prefetch
+    home (module docstring). AST-based, function granularity: a
+    ``for``/``async for`` whose body (not a nested function's) calls a
+    terminal ``all_gather``/``psum_scatter`` is the bucketed-prefetch
+    fingerprint — the loop traces one collective per iteration, i.e. a
+    hand-rolled overlap pipeline whose depth/reconstruction/ledger
+    invariants belong in ``zero_prefetch_gather``."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.prefetch-gather",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            hit = None
+            # manual walk that PRUNES nested function defs: a call
+            # inside a closure defined in the loop traces when the
+            # closure runs, not per loop iteration
+            stack = list(ast.iter_child_nodes(node))
+            while stack and hit is None:
+                sub = stack.pop()
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue
+                stack.extend(ast.iter_child_nodes(sub))
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                name = (
+                    func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None
+                )
+                if name in _PREFETCH_GATHER_OPS:
+                    hit = name
+            if hit:
+                yield Finding(
+                    rule="lint.prefetch-gather",
+                    message=(
+                        f"{hit} issued inside a Python for-loop — a "
+                        f"hand-rolled bucketed gather pipeline; route "
+                        f"through optimizers.zero_prefetch_gather (the "
+                        f"one home where overlap depth is roofline-"
+                        f"derived and the bucket reconstruction is "
+                        f"exact), or allowlist the site with the reason "
+                        f"it is not a prefetch pipeline"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"op": hit},
                 )
 
 

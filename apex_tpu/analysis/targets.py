@@ -26,8 +26,10 @@ from apex_tpu.analysis.passes import StepTarget
 
 __all__ = [
     "dp2tp2_mesh",
+    "dp2pp2_mesh",
     "gpt_step_target",
     "gpt_compressed_step_target",
+    "gpt_pp_step_target",
     "bert_step_target",
     "all_targets",
 ]
@@ -49,6 +51,25 @@ def dp2tp2_mesh():
         )
     return parallel_state.initialize_model_parallel(
         tensor_model_parallel_size=2, devices=devices[:4]
+    )
+
+
+def dp2pp2_mesh():
+    """The pipeline audit mesh: dp=2 x pp=2 over the first four devices.
+    NOTE: re-initializes the global parallel_state — build (and audit)
+    the dp2xtp2 targets first; the CLI's builder order does."""
+    from apex_tpu.parallel import parallel_state
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise RuntimeError(
+            f"the dp2xpp2 audit mesh needs >= 4 devices, found "
+            f"{len(devices)} — run via `python -m apex_tpu.analysis` (which "
+            f"forces the 8-device CPU topology) or set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    return parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size=2, devices=devices[:4]
     )
 
 
@@ -149,6 +170,116 @@ def gpt_compressed_step_target(mesh=None) -> StepTarget:
     return gpt_step_target(mesh, compression=CompressionConfig())
 
 
+def gpt_pp_step_target(mesh=None) -> StepTarget:
+    """The pp-enabled GPT CLI-gate target (dp2 x pp2): the ZERO-BUBBLE
+    pipeline schedule + the prefetched ZeRO optimizer, so the comms
+    differ, donation, and sharding passes audit pipeline p2p traffic on
+    every run.
+
+    Deliberately the fully-ledger-visible composition: the zero-bubble
+    schedule hand-writes its backward edges through the p2p wrappers
+    (no transpose-synthesized permutes for the differ to flag), and
+    ``distributed_fused_adam(param_gather_buckets=2)`` routes the
+    bucketed prefetch gathers through the ledger — this target must
+    audit clean with ZERO comms-allowlist suppressions beyond the
+    positive-confirmation rules (pinned by tests/test_analysis.py)."""
+    import optax
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.optimizers import distributed_fused_adam, zero_state_specs
+    from apex_tpu.parallel.pipeline import (
+        forward_backward_zero_bubble_with_pre_post,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or dp2pp2_mesh()
+    pp, dp = 2, 2
+    cfg = _tiny_cfg()
+    parts = build_gpt_pipeline(cfg, pp)
+    opt = distributed_fused_adam(
+        lr=1e-3, axis_name="dp", axis_size=dp, average_grads=True,
+        param_gather_buckets=2,
+    )
+    num_micro, mb, seq = 2, 2, cfg.max_position_embeddings
+    tokens = jnp.zeros((num_micro, mb * dp, seq), jnp.int32)
+    sspec = zero_state_specs("dp")
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )
+    def init(tokens):
+        key = jax.random.PRNGKey(0)
+        pre = parts.embed.init(key, tokens[0])["params"]
+        h = parts.pre_fn(pre, tokens[0])
+        stage = parts.chunk.init(jax.random.fold_in(key, 7), h)["params"]
+        return {
+            "pre": pre,
+            # leading pp dim: the boundary layout of per-stage params
+            "stages": jax.tree_util.tree_map(
+                lambda a: jnp.stack([a] * pp), stage
+            ),
+            "post": parts.init_post(jax.random.fold_in(key, 9)),
+        }
+
+    # abstract state, as in gpt_step_target: avals only, no execution
+    params = jax.eval_shape(init, tokens)
+    pspec = jax.tree_util.tree_map(lambda _: P("pp"), params["stages"])
+    io_spec = {"pre": P(), "stages": pspec, "post": P()}
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=sspec,
+        check_vma=False,
+    )
+    def init_opt(local_params):
+        return opt.init(local_params)
+
+    local_shape = dict(params)
+    local_shape["stages"] = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+        params["stages"],
+    )
+    opt_state = jax.eval_shape(init_opt, local_shape)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(io_spec, sspec, P(None, "dp"), P(None, "dp")),
+        out_specs=(io_spec, sspec, P(), P()),
+        check_vma=False,
+    )
+    def gpt_pp_train_step(params, opt_state, tokens, labels):
+        local = dict(params)
+        local["stages"] = jax.tree_util.tree_map(
+            lambda a: a[0], params["stages"]
+        )
+        # per-microbatch losses are a REAL output (training loops log
+        # them) — returning them keeps their pp publication psum live,
+        # so the differ sees no vanished traffic on this target
+        loss, losses, grads = forward_backward_zero_bubble_with_pre_post(
+            parts.pre_fn, parts.stage_fn, parts.post_loss_fn, local,
+            tokens, labels, axis_name="pp",
+        )
+        # the ZeRO reduce-scatter over dp IS the gradient sync; the
+        # bucketed param all-gather prefetch rides the same update
+        updates, new_opt_state = opt.update(grads, opt_state, local)
+        new_local = optax.apply_updates(local, updates)
+        new_params = dict(new_local)
+        new_params["stages"] = jax.tree_util.tree_map(
+            lambda a: a[None], new_local["stages"]
+        )
+        return (new_params, new_opt_state, xlax.pmean(loss, "dp"),
+                xlax.pmean(losses, "dp"))
+
+    return StepTarget(
+        name="gpt-dp2pp2",
+        fn=gpt_pp_train_step,
+        args=(params, opt_state, tokens, tokens),
+        mesh=mesh,
+        donate_argnums=(0, 1),
+    )
+
+
 def bert_step_target(mesh=None) -> StepTarget:
     """The BERT masked-LM step on the same mesh: bf16, tp2 vocab/tensor
     parallel heads, fused Adam, donated (params, opt_state)."""
@@ -213,4 +344,6 @@ def all_targets(mesh=None) -> List[StepTarget]:
         gpt_step_target(mesh),
         gpt_compressed_step_target(mesh),
         bert_step_target(mesh),
+        # LAST: building it re-initializes parallel_state to dp2xpp2
+        gpt_pp_step_target(),
     ]

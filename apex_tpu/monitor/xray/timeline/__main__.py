@@ -14,11 +14,23 @@ bare log dir does not carry — run the examples with
 programmatically.
 
 Flags: ``--json PATH`` appends the ``kind="profile"`` records to a
-jsonl (the shared MetricRouter schema).
+jsonl (the shared MetricRouter schema); ``--schedule NAME --pp P
+--microbatches M [--chunks V]`` joins the pipeline schedule algebra's
+predicted bubble fraction (``parallel/pipeline/algebra.py``) onto every
+per-step record and the summary — the predicted-vs-measured bubble
+join, computable from a bare log dir because the algebra needs only
+(schedule, P, M, V), not the HLO.
 """
 
 import argparse
 import sys
+
+#: the registered schedule names (parallel.pipeline.algebra.SCHEDULES),
+#: spelled literally: algebra.py itself is jax-free but importing it
+#: initializes the parallel package, which is not — and argparse needs
+#: the choices before anyone passes --schedule. Kept in sync by
+#: tests/test_timeline.py (drift fails tier-1).
+_SCHEDULE_CHOICES = ("1f1b", "interleaved", "no_pipelining", "zero_bubble")
 
 
 def main(argv=None) -> int:
@@ -30,12 +42,39 @@ def main(argv=None) -> int:
                    "jax.profiler.trace / ProfilerTrigger)")
     p.add_argument("--json", default=None,
                    help="append kind='profile' records to this jsonl")
+    p.add_argument("--schedule", default=None, choices=_SCHEDULE_CHOICES,
+                   help="pipeline schedule name for the predicted-bubble "
+                   "join")
+    p.add_argument("--pp", type=int, default=None,
+                   help="pipeline size P for the join")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="microbatch count M for the join")
+    p.add_argument("--chunks", type=int, default=1,
+                   help="virtual-PP model chunks V for the join")
     args = p.parse_args(argv)
+
+    predicted = None
+    if args.schedule is not None:
+        if args.pp is None or args.microbatches is None:
+            p.error("--schedule needs --pp and --microbatches")
+        from apex_tpu.parallel.pipeline.algebra import schedule_cost
+
+        try:
+            predicted = schedule_cost(
+                args.schedule, args.pp, args.microbatches, args.chunks
+            ).bubble_fraction
+        except ValueError as e:
+            # e.g. interleaved without --chunks >= 2, or M % P != 0 —
+            # a usage message, not a traceback
+            p.error(str(e))
 
     from apex_tpu.monitor.xray.timeline.analyzer import analyze_logdir
 
     try:
-        report = analyze_logdir(args.logdir)
+        report = analyze_logdir(
+            args.logdir, predicted_bubble_fraction=predicted,
+            schedule=args.schedule,
+        )
     except (FileNotFoundError, ValueError) as e:
         print(f"timeline: {e}", file=sys.stderr)
         return 1

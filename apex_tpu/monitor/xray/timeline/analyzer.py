@@ -329,7 +329,21 @@ class AxisBandwidth:
 @dataclasses.dataclass
 class TimelineReport:
     """The analyzer's full output: per-step partitions + the per-axis
-    measured-vs-predicted bandwidth join."""
+    measured-vs-predicted bandwidth join.
+
+    ``predicted_bubble_fraction`` (optional) is the schedule algebra's
+    tick-count prediction
+    (``parallel.pipeline.algebra.schedule_cost(...).bubble_fraction``):
+    when the caller supplies it, every per-step ``kind="profile"``
+    record carries predicted next to measured — the predicted-vs-
+    measured bubble join that closes ROADMAP item 5's proof loop. The
+    algebra is a dependence-graph lower bound, so on a faithful device
+    capture measured >= predicted and the gap is the scheduler's
+    shortfall; CPU captures undercut it (the threadpool runs different
+    virtual devices' bubble ticks concurrently — the standing CPU
+    caveat, docs/observability.md#timeline) and read as relative
+    structure only.
+    """
 
     steps: List[StepBreakdown]
     axes: List[AxisBandwidth]
@@ -337,6 +351,8 @@ class TimelineReport:
     n_unattributed_collectives: int = 0
     files: List[str] = dataclasses.field(default_factory=list)
     synthetic_step: bool = False  # no markers: whole capture = one span
+    predicted_bubble_fraction: Optional[float] = None
+    schedule: Optional[str] = None  # algebra schedule name, when joined
 
     def to_records(self) -> List[dict]:
         """``kind="profile"`` records in the shared MetricRouter schema:
@@ -346,6 +362,15 @@ class TimelineReport:
 
         records = []
         for s in self.steps:
+            extra = {}
+            if self.predicted_bubble_fraction is not None:
+                # the algebra join: predicted rides next to measured in
+                # the same record so downstream consumers (the bench
+                # section, the sentinel's jsonl) never re-derive it
+                extra["predicted_bubble_fraction"] = (
+                    self.predicted_bubble_fraction
+                )
+                extra["schedule"] = self.schedule
             records.append(make_record(
                 "profile", s.step,
                 span_ms=s.span_us / 1e3,
@@ -358,6 +383,7 @@ class TimelineReport:
                 overlap_fraction=s.overlap_fraction,
                 bubble_fraction=s.bubble_fraction,
                 n_ops=s.n_ops,
+                **extra,
             ))
         last_step = self.steps[-1].step if self.steps else 0
         for ax in self.axes:
@@ -421,6 +447,17 @@ class TimelineReport:
                 f"  ({self.n_unattributed_collectives} collective event(s) "
                 f"matched no HLO instruction / axis — not joined)"
             )
+        if self.predicted_bubble_fraction is not None and self.steps:
+            measured = sum(s.bubble_fraction for s in self.steps) / len(
+                self.steps
+            )
+            sched = f" ({self.schedule})" if self.schedule else ""
+            lines.append(
+                f"  bubble join{sched}: predicted "
+                f"{100 * self.predicted_bubble_fraction:5.1f}% (schedule "
+                f"algebra) vs measured {100 * measured:5.1f}% (mean over "
+                f"{len(self.steps)} step(s)) — gap is scheduler shortfall"
+            )
         return "\n".join(lines)
 
 
@@ -459,6 +496,8 @@ def analyze(
     mesh=None,
     ledger=None,
     ici_bandwidth: Optional[float] = None,
+    predicted_bubble_fraction: Optional[float] = None,
+    schedule: Optional[str] = None,
 ) -> TimelineReport:
     """Compute the full report from one parsed capture.
 
@@ -469,6 +508,13 @@ def analyze(
     utilization column — pass
     ``xray.ledger.ici_bandwidth_per_device()`` or a pinned number; the
     analyzer itself never guesses one.
+
+    ``predicted_bubble_fraction`` / ``schedule`` attach the pipeline
+    schedule algebra's prediction
+    (``parallel.pipeline.algebra.schedule_cost``) to every per-step
+    record and the summary — the predicted-vs-measured bubble join (see
+    :class:`TimelineReport`); the analyzer never derives a prediction
+    itself (it cannot know (P, M, V)).
     """
     ops = timeline.device_op_events()
     intervals = pair_async_collectives(ops)
@@ -570,6 +616,8 @@ def analyze(
         n_device_ops=len(ops),
         n_unattributed_collectives=unattributed,
         synthetic_step=synthetic,
+        predicted_bubble_fraction=predicted_bubble_fraction,
+        schedule=schedule,
     )
 
 
@@ -579,6 +627,8 @@ def analyze_logdir(
     mesh=None,
     ledger=None,
     ici_bandwidth: Optional[float] = None,
+    predicted_bubble_fraction: Optional[float] = None,
+    schedule: Optional[str] = None,
 ) -> TimelineReport:
     """Parse the newest capture under ``logdir`` and :func:`analyze` it
     (the ``--profile-analyze`` and CLI entry path)."""
@@ -586,6 +636,8 @@ def analyze_logdir(
     report = analyze(
         timeline, module=module, mesh=mesh, ledger=ledger,
         ici_bandwidth=ici_bandwidth,
+        predicted_bubble_fraction=predicted_bubble_fraction,
+        schedule=schedule,
     )
     report.files = files
     return report

@@ -29,7 +29,9 @@ from apex_tpu.optimizers.fused_adagrad import fused_adagrad, FusedAdagrad
 from apex_tpu.optimizers.larc import larc, LARC
 from apex_tpu.optimizers.clip_grad import clip_grad_norm
 from apex_tpu.optimizers.distributed_fused_adam import (
+    choose_overlap_buckets,
     distributed_fused_adam,
+    zero_prefetch_gather,
     zero_regroup_flat,
     zero_state_specs,
     DistributedFusedAdam,
@@ -57,7 +59,9 @@ __all__ = [
     "larc",
     "LARC",
     "clip_grad_norm",
+    "choose_overlap_buckets",
     "distributed_fused_adam",
+    "zero_prefetch_gather",
     "zero_regroup_flat",
     "zero_state_specs",
     "DistributedFusedAdam",

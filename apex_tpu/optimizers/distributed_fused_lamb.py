@@ -26,9 +26,13 @@ import optax
 from apex_tpu.monitor.xray import ledger as xlax
 from apex_tpu.ops.multi_tensor import FlatSpec
 from apex_tpu.optimizers.distributed_fused_adam import (
+    bucket_grid,
+    choose_overlap_buckets,
     zero_gather_updates,
     zero_init_master_shard,
+    zero_prefetch_gather,
     zero_scatter_with_ef,
+    zero_updates_from_flat,
 )
 
 
@@ -65,12 +69,22 @@ def distributed_fused_lamb(
     axis_size: int = None,
     average_grads: bool = True,
     compression=None,
+    param_gather_buckets: int = None,
 ) -> optax.GradientTransformation:
     """ZeRO LAMB over the ``axis_name`` mesh axis; use inside shard_map.
 
     ``compression``: same contract as ``distributed_fused_adam`` — the
     grad reduce-scatter travels block-scaled int8 with error feedback in
     ``state.ef_residual``; the trust-ratio/master math stays fp32.
+
+    ``param_gather_buckets``: the param all-gather prefetch depth, same
+    contract as ``distributed_fused_adam`` (None = roofline-derived, 1 =
+    whole-shard gather). LAMB's moments/norms/trust ratios need the full
+    shard (the segment psums), so only the final per-tensor-scaled
+    master write is bucketed — each bucket's gather still overlaps the
+    next bucket's scale math and the unflatten fan-out, through the one
+    blessed ``zero_prefetch_gather`` pipeline. Bitwise-identical at
+    every depth.
     """
     beta1, beta2 = betas
     if axis_size is None:
@@ -154,9 +168,33 @@ def distributed_fused_lamb(
                 w_norm / jnp.maximum(u_norm, 1e-30),
                 1.0,
             )
-        new_master = p - lr * jnp.take(ratios, seg) * u
+        nb = (
+            param_gather_buckets if param_gather_buckets is not None
+            else choose_overlap_buckets(shard * 4, axis_size)
+        )
+        if nb > 1:
+            bs, pad = bucket_grid(shard, nb)
 
-        updates = zero_gather_updates(new_master, params, spec, axis_name)
+            def padto(a):
+                return jnp.pad(a, (0, pad)) if pad else a
+
+            # padded seg indexes the padding bucket -> ratio row nseg-1,
+            # a real (finite) entry; the tail is stripped before storing
+            pw, uw = padto(p), padto(u)
+            segw = jnp.pad(seg, (0, pad), constant_values=nseg - 1) if pad else seg
+
+            def bucket(b, bsz):
+                sl = slice(b * bsz, (b + 1) * bsz)
+                return pw[sl] - lr * jnp.take(ratios, segw[sl]) * uw[sl]
+
+            buckets, new_flat = zero_prefetch_gather(
+                bucket, nb, shard, axis_name, axis_size
+            )
+            new_master = jnp.concatenate(buckets)[:shard]
+            updates = zero_updates_from_flat(new_flat, params, spec)
+        else:
+            new_master = p - lr * jnp.take(ratios, seg) * u
+            updates = zero_gather_updates(new_master, params, spec, axis_name)
         new_state = DistributedFusedLAMBState(
             step=step, master_shard=new_master, exp_avg=m, exp_avg_sq=v,
             ef_residual=new_ef,
@@ -184,6 +222,7 @@ class DistributedFusedLAMB:
         axis_size: int = None,
         average_grads: bool = True,
         compression=None,
+        param_gather_buckets: int = None,
         **_unused,
     ):
         return distributed_fused_lamb(
@@ -198,4 +237,5 @@ class DistributedFusedLAMB:
             axis_size=axis_size,
             average_grads=average_grads,
             compression=compression,
+            param_gather_buckets=param_gather_buckets,
         )

@@ -38,10 +38,19 @@ from apex_tpu.parallel.pipeline.schedules import (
     forward_backward_pipelining_without_interleaving,
     forward_backward_pipelining_with_interleaving,
     forward_backward_with_pre_post,
+    forward_backward_zero_bubble,
+    forward_backward_zero_bubble_with_pre_post,
     get_forward_backward_func,
     pipeline_forward,
     pipeline_forward_interleaved,
     build_model,
+)
+from apex_tpu.parallel.pipeline.algebra import (
+    ScheduleCost,
+    SCHEDULES,
+    schedule_cost,
+    compare as compare_schedules,
+    bubble_fraction_1f1b,
 )
 
 __all__ = [
@@ -65,8 +74,15 @@ __all__ = [
     "forward_backward_pipelining_without_interleaving",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_with_pre_post",
+    "forward_backward_zero_bubble",
+    "forward_backward_zero_bubble_with_pre_post",
     "get_forward_backward_func",
     "pipeline_forward",
     "pipeline_forward_interleaved",
     "build_model",
+    "ScheduleCost",
+    "SCHEDULES",
+    "schedule_cost",
+    "compare_schedules",
+    "bubble_fraction_1f1b",
 ]

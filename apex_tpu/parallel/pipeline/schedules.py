@@ -48,6 +48,28 @@ schedule's reversed edges are synthesized by jax's transpose rules and
 never appear in a forward trace, so the validator sees them only when
 the traced function includes ``jax.grad`` of the scan (the fwd+bwd
 program), which all ``forward_backward_*`` entry points here do.
+
+ZERO-BUBBLE (B/W split). ``forward_backward_zero_bubble`` (and its
+pre/post twin) hand-write the backward pipeline instead of deriving it
+from ``jax.grad``: the backward pass splits into B (activation-grad:
+``dx``, the only value the reversed p2p chain carries) and W
+(weight-grad: ``dp``, which feeds nothing but a local accumulator).
+Expressing that split in the program's dataflow is what lets XLA's
+latency-hiding scheduler fill each backward tick's edge-transfer wait
+with W compute instead of idling — the compiled-scan realization of
+zero-bubble scheduling (arXiv:2401.10241), whose predicted tick counts
+and bubble fractions live in ``algebra.py`` and whose realized bubble
+the timeline analyzer measures. Two structural consequences:
+
+- the reversed edges are REAL ``p2p.send_backward_recv_backward`` calls,
+  so the comms ledger predicts the backward pp traffic exactly (the
+  transpose blind spot above closes for this schedule) and the HLO
+  differ can match every emitted permute to a prediction;
+- memory: the forward scan stashes its per-tick stage inputs AND outputs
+  (2 boundary activations x T ticks — the deferred-W stash, vs the
+  remat'd 1F1B's 1 x T carry residuals), and each backward tick
+  recomputes the stage forward once inside its vjp, exactly the remat
+  trade the fused path already pays.
 """
 
 import functools
@@ -332,6 +354,265 @@ def _last_stage_mean_loss(per_microbatch_losses, axis_name: str):
     )
 
 
+# -- zero-bubble (B/W split) -------------------------------------------------
+
+
+def _zb_forward_scan(
+    stage_fn, params, microbatches, *, axis_name: str, remat: bool,
+    tick_block_remat: int,
+):
+    """The zero-bubble forward pass: ``pipeline_forward``'s tick loop,
+    additionally stashing every tick's stage INPUT (the value the
+    backward scan's per-tick vjp replays — the deferred-W stash).
+
+    Returns ``(xs, outs)``: ``xs`` with leading dim T = M + P - 1 (this
+    stage's input at each tick, bubble ticks included), ``outs`` with
+    leading dim M (last-stage outputs, valid on the last stage only).
+    """
+    num_stages = xlax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    num_micro = _leading_dim(microbatches)
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    mb0 = _index(microbatches, 0)
+    with xlax.muted():  # shape probe, not part of the compiled program
+        out_shape = jax.eval_shape(stage_fn, params, mb0)
+    state0 = _varying_zeros(out_shape, axis_name)
+
+    def tick(state, t):
+        with jax.named_scope("pp_p2p"):
+            recv = p2p.send_forward_recv_forward(state, axis_name)
+        mb = _index(microbatches, jnp.clip(t, 0, num_micro - 1))
+        is_first = rank == 0
+        x = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(is_first, a, b), mb, recv
+        )
+        with jax.named_scope("pp_stage"):
+            y = body(params, x)
+        return y, (x, y)
+
+    num_ticks = num_micro + num_stages - 1
+    _, (xs, ys) = _scan_ticks(tick, state0, num_ticks, tick_block_remat)
+    outs = jax.tree_util.tree_map(
+        lambda a: jax.lax.slice_in_dim(a, num_stages - 1, num_ticks, axis=0),
+        ys,
+    )
+    return xs, outs
+
+
+def _zb_backward_scan(stage_fn, params, xs, seed, *, axis_name: str,
+                      num_micro: int):
+    """The hand-written backward pipeline: a reverse-clock scan of
+    T = M + P - 1 ticks whose tick body splits B from W.
+
+    At reverse tick q every stage replays its forward tick t = T - 1 - q
+    (the backward schedule is the forward's exact mirror: stage s handled
+    microbatch m = t - s there, so the reversal needs no per-stage index
+    algebra — only the shared clock flips). The tick:
+
+    - receives the downstream cotangent over a REAL backward edge
+      (``send_backward_recv_backward`` — ledger-recorded, unlike the
+      transpose-synthesized edges of the ``jax.grad`` path);
+    - the last stage swaps in its own loss seed for the microbatch that
+      exited at t;
+    - one ``jax.vjp`` replay of the stage yields both halves, but only
+      ``dx`` (B) enters the carried edge chain — ``dp`` (W) feeds the
+      grad accumulator, a dataflow XLA's latency-hiding scheduler is
+      free to move into the edge-transfer wait (the zero-bubble filling;
+      ``algebra.zero_bubble_cost`` is its tick-count model);
+    - bubble ticks (this stage outside its valid window) contribute
+      exact zeros to both halves.
+
+    Returns ``(stage_grads, dxs)`` where ``dxs`` (leading dim T) holds
+    each tick's masked ``dx`` — stage 0's entries are the cotangents of
+    its microbatch inputs, which the pre/post variant feeds to the
+    embedding vjp.
+    """
+    num_stages = xlax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    num_ticks = num_micro + num_stages - 1
+
+    x0 = _index(xs, 0)
+    with xlax.muted():  # shape probes only
+        out_shape = jax.eval_shape(stage_fn, params, x0)
+        p_shape = jax.eval_shape(lambda p: p, params)
+    d0 = _varying_zeros(out_shape, axis_name)
+    g0 = _varying_zeros(p_shape, axis_name)
+
+    def btick(carry, q):
+        dprev, gacc = carry
+        with jax.named_scope("pp_p2p_bwd"):
+            recv = p2p.send_backward_recv_backward(dprev, axis_name)
+        t = num_ticks - 1 - q
+        x = _index(xs, t)
+        # the microbatch exiting the LAST stage at forward tick t seeds
+        # its loss cotangent here; everyone else consumes the edge
+        m = t - (num_stages - 1)
+        seed_m = _index(seed, jnp.clip(m, 0, num_micro - 1))
+        is_seed = (rank == num_stages - 1) & (m >= 0) & (m < num_micro)
+        dy = jax.tree_util.tree_map(
+            lambda s, r: jnp.where(is_seed, s, r), seed_m, recv
+        )
+        # this stage's valid window mirrors the forward's: u = t - rank
+        u = t - rank
+        valid = (u >= 0) & (u < num_micro)
+        with jax.named_scope("pp_stage_bwd"):
+            _, pull = jax.vjp(stage_fn, params, x)
+            dp, dx = pull(dy)
+        dx = jax.tree_util.tree_map(
+            lambda a: jnp.where(valid, a, jnp.zeros_like(a)), dx
+        )
+        dp = jax.tree_util.tree_map(
+            lambda a: jnp.where(valid, a, jnp.zeros_like(a)), dp
+        )
+        gacc = jax.tree_util.tree_map(jnp.add, gacc, dp)
+        return (dx, gacc), dx
+
+    with xlax.scaled(num_ticks):
+        (_, grads), dxs = jax.lax.scan(
+            btick, (d0, g0), jnp.arange(num_ticks)
+        )
+    return grads, dxs
+
+
+def _loss_seed_cotangent(num_micro: int, axis_name: str):
+    """d(published mean loss)/d(per-microbatch losses): 1/M on the last
+    stage (only its losses reach the mean — ``_last_stage_mean_loss``
+    keeps just the local term on the grad path), zero elsewhere."""
+    num_stages = xlax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    return jnp.where(
+        rank == num_stages - 1,
+        jnp.full((num_micro,), 1.0 / num_micro),
+        jnp.zeros((num_micro,)),
+    )
+
+
+def forward_backward_zero_bubble(
+    stage_fn: Callable[[Any, Any], Any],
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    params: Any,
+    microbatches: Any,
+    targets: Any,
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+    tick_block_remat: int = 0,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """Zero-bubble-style schedule: same signature and same gradients as
+    ``forward_backward_pipelining_without_interleaving``, backward
+    hand-written with the B/W split (module docstring). Tick counts and
+    the predicted bubble fraction: ``algebra.zero_bubble_cost(P, M)``.
+    """
+    num_micro = _leading_dim(microbatches)
+    xs, outs = _zb_forward_scan(
+        stage_fn, params, microbatches, axis_name=axis_name, remat=remat,
+        tick_block_remat=tick_block_remat,
+    )
+    losses, loss_pull = jax.vjp(
+        lambda o: jax.vmap(loss_fn)(o, targets), outs
+    )
+    loss, losses_pub = _publish_losses(losses, axis_name)
+    (douts,) = loss_pull(_loss_seed_cotangent(num_micro, axis_name))
+    grads, _ = _zb_backward_scan(
+        stage_fn, params, xs, douts, axis_name=axis_name,
+        num_micro=num_micro,
+    )
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return loss, losses_pub, grads
+
+
+def forward_backward_zero_bubble_with_pre_post(
+    pre_fn: Callable[[Any, Any], Any],
+    stage_fn: Callable[[Any, Any], Any],
+    post_loss_fn: Callable[[Any, Any, Any], jnp.ndarray],
+    params: Any,
+    inputs: Any,
+    targets: Any,
+    *,
+    axis_name: str = "pp",
+    remat: bool = True,
+    tick_block_remat: int = 0,
+    grad_sync_fn: Optional[Callable[[Any], Any]] = None,
+):
+    """``forward_backward_with_pre_post`` with the zero-bubble backward:
+    embedding + stages + head in one B/W-split program, gradients equal
+    to the fused path's.
+
+    The pre/post halves ride the stage machinery: the head's loss vjp
+    provides the last-stage seeds, and stage 0's per-tick ``dx`` stash
+    IS the embedding-output cotangent (microbatch m's entry lands at
+    reverse tick (M-1-m) + (P-1), a host-side constant), so the
+    embedding vjp needs no extra pipeline pass. Replicated pre/post
+    grads are combined over pp exactly as in the fused variant.
+    """
+    num_stages = xlax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    num_micro = _leading_dim(inputs)
+
+    def pre_all(pre):
+        with jax.named_scope("pp_pre"):
+            return jax.vmap(lambda mb: pre_fn(pre, mb))(inputs)
+
+    h, pre_pull = jax.vjp(pre_all, params["pre"])
+    xs, outs = _zb_forward_scan(
+        stage_fn, params["stages"], h, axis_name=axis_name, remat=remat,
+        tick_block_remat=tick_block_remat,
+    )
+
+    def post_all(post, o):
+        with jax.named_scope("pp_post"):
+            return jax.vmap(
+                lambda y, t: post_loss_fn(post, y, t)
+            )(o, targets)
+
+    losses, post_pull = jax.vjp(post_all, params["post"], outs)
+    loss, losses_pub = _publish_losses(losses, axis_name)
+    dpost, douts = post_pull(_loss_seed_cotangent(num_micro, axis_name))
+    stage_grads, dxs = _zb_backward_scan(
+        stage_fn, params["stages"], xs, douts, axis_name=axis_name,
+        num_micro=num_micro,
+    )
+    # microbatch m entered stage 0 at forward tick m, i.e. reverse tick
+    # (T-1) - m = (M-1-m) + (P-1) — static gather indices for dL/dh
+    qs = (num_micro - 1 - jnp.arange(num_micro)) + (num_stages - 1)
+    dh = jax.tree_util.tree_map(lambda a: a[qs], dxs)
+    # only stage 0 consumed h; its dx rows are the real cotangents
+    dh = jax.tree_util.tree_map(
+        lambda a: jnp.where(rank == 0, a, jnp.zeros_like(a)), dh
+    )
+    (dpre,) = pre_pull(dh)
+
+    grads = {
+        "pre": _combine_replicated_grads(dpre, axis_name),
+        "stages": stage_grads,
+        "post": _combine_replicated_grads(dpost, axis_name),
+    }
+    if grad_sync_fn is not None:
+        grads = grad_sync_fn(grads)
+    return loss, losses_pub, grads
+
+
+def _combine_replicated_grads(tree, axis_name: str):
+    """Combine pp-replicated params' grads (nonzero on one rank only)
+    onto every rank — the tied-embedding allreduce semantics, with the
+    checked-shard_map dispatch of ``forward_backward_with_pre_post``:
+    under live vma tracking the transpose already psummed replicated
+    leaves, and a second psum would multiply by P."""
+    from apex_tpu.parallel.ddp import grads_already_reduced, vma_tracking_live
+
+    tracking = vma_tracking_live(axis_name)
+
+    def one(g):
+        if grads_already_reduced(g, axis_name, tracking):
+            return g
+        return xlax.psum(g, axis_name)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def forward_backward_no_pipelining(
     forward_step_fn: Callable[[Any, Any], jnp.ndarray],
     params: Any,
@@ -487,22 +768,11 @@ def forward_backward_with_pre_post(
 
     (loss, losses), grads = jax.value_and_grad(total_loss, has_aux=True)(params)
     # replicated pre/post params: combine the single contributing rank's
-    # grads onto every rank (tied-embedding allreduce semantics). Under
-    # CHECKED shard_map the grad-transpose already psummed these over
-    # axis_name (they type replicated), so another psum would multiply by
-    # P — same vma dispatch as parallel.ddp.all_reduce_gradients
-    from apex_tpu.parallel.ddp import grads_already_reduced, vma_tracking_live
-
-    tracking = vma_tracking_live(axis_name)
-
-    def _combine(g):
-        if grads_already_reduced(g, axis_name, tracking):
-            return g
-        return xlax.psum(g, axis_name)
-
+    # grads onto every rank (tied-embedding allreduce semantics) — the
+    # shared vma-dispatched helper the zero-bubble variant also uses
     grads = dict(grads)
-    grads["pre"] = jax.tree_util.tree_map(_combine, grads["pre"])
-    grads["post"] = jax.tree_util.tree_map(_combine, grads["post"])
+    grads["pre"] = _combine_replicated_grads(grads["pre"], axis_name)
+    grads["post"] = _combine_replicated_grads(grads["post"], axis_name)
     if grad_sync_fn is not None:
         grads = grad_sync_fn(grads)
     return loss, losses, grads
@@ -511,19 +781,31 @@ def forward_backward_with_pre_post(
 def get_forward_backward_func(
     virtual_pipeline_model_parallel_size: Optional[int],
     pipeline_model_parallel_size: int,
+    zero_bubble: bool = False,
 ) -> Callable:
     """Schedule dispatcher (ref: schedules/__init__.py:22): interleaved iff
-    virtual PP is set, 1F1B iff PP > 1, else plain grad accumulation."""
+    virtual PP is set, 1F1B iff PP > 1, else plain grad accumulation.
+    ``zero_bubble=True`` swaps the 1F1B schedule for the B/W-split
+    ``forward_backward_zero_bubble`` (same signature, same gradients;
+    predicted bubble per ``algebra.zero_bubble_cost``). Virtual PP has
+    no zero-bubble variant yet — the combination raises."""
     if virtual_pipeline_model_parallel_size is not None:
         if pipeline_model_parallel_size <= 1:
             raise ValueError(
                 "virtual pipeline parallelism requires pipeline_model_parallel_size > 1"
+            )
+        if zero_bubble:
+            raise ValueError(
+                "zero_bubble has no interleaved variant: pick virtual PP "
+                "(bubble/V) or the B/W split, not both"
             )
         return functools.partial(
             forward_backward_pipelining_with_interleaving,
             num_model_chunks=virtual_pipeline_model_parallel_size,
         )
     if pipeline_model_parallel_size > 1:
+        if zero_bubble:
+            return forward_backward_zero_bubble
         return forward_backward_pipelining_without_interleaving
     return forward_backward_no_pipelining
 

@@ -62,6 +62,7 @@ BUDGETS = {
     "sweep": int(os.environ.get("APEX_TPU_SWEEP_BUDGET", "900")),
     "ckpt": int(os.environ.get("APEX_TPU_CKPT_BUDGET", "900")),
     "comms": int(os.environ.get("APEX_TPU_COMMS_BUDGET", "900")),
+    "pipeline": int(os.environ.get("APEX_TPU_PIPELINE_BUDGET", "1200")),
     "serving": int(os.environ.get("APEX_TPU_SERVING_BUDGET", "900")),
 }
 
@@ -894,6 +895,287 @@ def run_comms(deadline, out_path):
     return rec
 
 
+def run_pipeline(deadline, out_path):
+    """Pipeline-schedule bench: tokens/s + measured bubble fraction per
+    schedule (1F1B vs interleaved vs zero-bubble) on a pp pipeline over
+    the device set (the virtual 8-device topology on CPU runs, real
+    chips on TPU).  One tiny GPT (pp*V layers total) is driven through
+    each schedule:
+
+    - tokens/s via ``apex_tpu.utils.benchmarking`` chain-slope timing
+      (k train steps scanned inside one jit — the only measurement the
+      relay can't lie to), emitted as ``pipeline_<sched>_tokens_per_sec``
+      sub-records whose ``kind="bench"`` twins the PR-7 sentinel gates
+      higher-is-better like every throughput;
+    - measured bubble via a profiler capture of annotated steps through
+      the PR-6 timeline analyzer, JOINED to the schedule algebra's
+      predicted bubble fraction (``parallel.pipeline.algebra``) in the
+      same sub-record — emitted as ``pipeline_<sched>_idle_s`` (idle
+      seconds/step, ``_s`` suffix so the sentinel gates lower-is-better)
+      with measured + predicted fractions as fields.  Best-effort: a
+      capture failure records ``timeline_error`` and keeps the tokens/s.
+
+    On CPU the idle numbers include host scheduling noise
+    (docs/observability.md#timeline) — compare within one platform tag,
+    which the sentinel already does.
+    """
+    import functools
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models.gpt_pipeline import build_gpt_pipeline
+    from apex_tpu.parallel import parallel_state
+    from apex_tpu.parallel.pipeline import (
+        forward_backward_with_pre_post,
+        forward_backward_zero_bubble_with_pre_post,
+        schedule_cost,
+    )
+    from apex_tpu.transformer import TransformerConfig
+    from apex_tpu.utils.benchmarking import chained_seconds_per_iter, full_reduce
+
+    devs = jax.devices()
+    n = len(devs)
+    # APEX_TPU_PIPELINE_PP caps the pipeline size: the CPU proof runs
+    # pp=4 (the pp=8 x 16-layer compile alone eats a CPU window; on real
+    # TPU the compiles are cached and the full topology is the point)
+    cap = int(os.environ.get("APEX_TPU_PIPELINE_PP", "8"))
+    pp = next((k for k in (8, 4, 2) if n >= k and k <= cap), 0)
+    if pp < 2:
+        return {"measured_n": 0, "note": f"needs >=2 devices for pp, have {n}"}
+    vpp = 2
+    num_micro = 2 * pp  # M % P == 0 (interleaved) and M >= 2(P-1) (ZB -> 0)
+    mb, seq = 2, 64
+    cfg = TransformerConfig(
+        num_layers=pp * vpp, hidden_size=128, num_attention_heads=4,
+        vocab_size=512, max_position_embeddings=seq,
+        hidden_dropout=0.0, attention_dropout=0.0,
+    )
+    mesh = parallel_state.initialize_model_parallel(
+        pipeline_model_parallel_size=pp, devices=devs[:pp]
+    )
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (num_micro, mb, seq), 0, cfg.vocab_size)
+    labels = jnp.roll(tokens, -1, axis=2)
+    tokens_per_step = num_micro * mb * seq
+
+    def setup(chunks_per_rank):
+        """parts + concretely-initialized params for a pp split into
+        ``chunks_per_rank`` model chunks per rank (1 = plain/ZB split,
+        vpp = interleaved's one-layer chunks)."""
+        parts = build_gpt_pipeline(cfg, pp * chunks_per_rank)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(),
+            out_specs={"pre": P(), "stages": P("pp"), "post": P()},
+            check_vma=False,
+        )
+        def init(tokens):
+            k = jax.random.PRNGKey(0)
+            pre = parts.embed.init(k, tokens[0])["params"]
+            h = parts.pre_fn(pre, tokens[0])
+            r = jax.lax.axis_index("pp")
+            chunks = [
+                parts.chunk.init(
+                    jax.random.fold_in(k, 7 + v * pp + r), h
+                )["params"]
+                for v in range(chunks_per_rank)
+            ]
+            stages = jax.tree_util.tree_map(lambda *a: jnp.stack(a), *chunks)
+            if chunks_per_rank == 1:
+                stages = jax.tree_util.tree_map(lambda a: a[0], stages)
+            return {
+                "pre": pre,
+                "stages": jax.tree_util.tree_map(lambda a: a[None], stages),
+                "post": parts.init_post(jax.random.fold_in(k, 9)),
+            }
+
+        params = init(tokens)
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+        return parts, params
+
+    parts1, params1 = setup(1)
+    partsV, paramsV = setup(vpp)
+
+    def step_body(parts, fb_kwargs):
+        def one(local, tokens, labels):
+            if fb_kwargs.get("num_model_chunks"):
+                loss, _, grads = forward_backward_with_pre_post(
+                    parts.pre_fn, parts.stage_fn, parts.post_loss_fn,
+                    local, tokens, labels, axis_name="pp", **fb_kwargs,
+                )
+            elif fb_kwargs.get("zero_bubble"):
+                loss, _, grads = forward_backward_zero_bubble_with_pre_post(
+                    parts.pre_fn, parts.stage_fn, parts.post_loss_fn,
+                    local, tokens, labels, axis_name="pp",
+                )
+            else:
+                loss, _, grads = forward_backward_with_pre_post(
+                    parts.pre_fn, parts.stage_fn, parts.post_loss_fn,
+                    local, tokens, labels, axis_name="pp",
+                )
+            local = jax.tree_util.tree_map(
+                lambda p, g: p - 1e-4 * g.astype(p.dtype), local, grads
+            )
+            return local, loss
+
+        return one
+
+    io_spec = {"pre": P(), "stages": P("pp"), "post": P()}
+
+    def make_build(parts, fb_kwargs):
+        one = step_body(parts, fb_kwargs)
+
+        def build(k):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(io_spec, P(), P()),
+                out_specs=P(), check_vma=False,
+            )
+            def run(params, tokens, labels):
+                local = dict(params)
+                local["stages"] = jax.tree_util.tree_map(
+                    lambda a: a[0], params["stages"]
+                )
+
+                def body(c, _):
+                    c, loss = one(c, tokens, labels)
+                    return c, loss
+
+                local, losses = jax.lax.scan(body, local, None, length=k)
+                # psum makes the fetched scalar replicated across pp
+                return jax.lax.psum(
+                    full_reduce(local) + jnp.sum(losses), "pp"
+                )
+
+            return run
+
+        return build
+
+    def make_step1(parts, fb_kwargs):
+        one = step_body(parts, fb_kwargs)
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(io_spec, P(), P()),
+            out_specs=(io_spec, P()), check_vma=False,
+        )
+        def step1(params, tokens, labels):
+            local = dict(params)
+            local["stages"] = jax.tree_util.tree_map(
+                lambda a: a[0], params["stages"]
+            )
+            local, loss = one(local, tokens, labels)
+            out = dict(local)
+            out["stages"] = jax.tree_util.tree_map(
+                lambda a: a[None], local["stages"]
+            )
+            return out, jax.lax.psum(loss, "pp")
+
+        return step1
+
+    scheds = [
+        ("1f1b", parts1, params1, {}, schedule_cost("1f1b", pp, num_micro)),
+        ("interleaved", partsV, paramsV, {"num_model_chunks": vpp},
+         schedule_cost("interleaved", pp, num_micro, vpp)),
+        ("zero_bubble", parts1, params1, {"zero_bubble": True},
+         schedule_cost("zero_bubble", pp, num_micro)),
+    ]
+    rec = {"measured_n": 0, "pp": pp, "num_microbatches": num_micro,
+           "virtual_chunks": vpp, "tokens_per_step": tokens_per_step}
+    incomplete = []
+    for i, (name, parts, params, fb_kwargs, cost) in enumerate(scheds):
+        remaining = deadline - time.monotonic()
+        if remaining <= 60:
+            incomplete.append(name)
+            rec[name] = "skipped: section budget exhausted"
+            continue
+        if not relay_alive():
+            incomplete.append(name)
+            rec[name] = "skipped: relay dead"
+            continue
+        item_deadline = time.monotonic() + remaining / (len(scheds) - i)
+        entry = {"predicted_bubble_fraction": round(cost.bubble_fraction, 4),
+                 "predicted_ticks": cost.forward_ticks + cost.backward_ticks
+                 + cost.filler_ticks}
+        try:
+            sec = chained_seconds_per_iter(
+                make_build(parts, fb_kwargs), (params, tokens, labels),
+                deadline=item_deadline,
+            )
+            tps = round(tokens_per_step / sec, 1)
+            entry["tokens_per_sec"] = tps
+            entry["s_per_step"] = round(sec, 6)
+            rec["measured_n"] += 1
+            emit(out_path, {
+                "section": f"pipeline_{name}", "ok": True, "completed": True,
+                "metric": f"pipeline_{name}_tokens_per_sec", "value": tps,
+                "unit": "tok/s", "pp": pp, "num_microbatches": num_micro,
+                "predicted_bubble_fraction": entry[
+                    "predicted_bubble_fraction"],
+            })
+        except Exception as e:
+            entry["error"] = f"{e!r}"[:300]
+            rec[name] = entry
+            if transient_error(e):
+                incomplete.append(name)
+            continue
+        # measured bubble: a short annotated capture through the PR-6
+        # timeline analyzer, joined to the algebra's prediction.
+        # Best-effort — a profiler failure must not void the tokens/s.
+        trace_dir = tempfile.mkdtemp(prefix=f"apex_tpu_pipe_{name}_")
+        try:
+            from apex_tpu.monitor.xray import timeline
+            from apex_tpu.utils.timers import step_annotation, trace
+
+            step1 = make_step1(parts, fb_kwargs)
+            p = params
+            p, _ = step1(p, tokens, labels)  # compile outside the capture
+            jax.block_until_ready(jax.tree_util.tree_leaves(p)[0])
+            with trace(trace_dir):
+                for i in range(3):
+                    with step_annotation(i, name=f"pipeline_{name}"):
+                        p, loss = step1(p, tokens, labels)
+                        jax.block_until_ready(loss)
+            report = timeline.analyze_logdir(
+                trace_dir,
+                predicted_bubble_fraction=cost.bubble_fraction,
+                schedule=name,
+            )
+            if report.steps:
+                measured = float(np.mean(
+                    [s.bubble_fraction for s in report.steps]
+                ))
+                idle_s = float(np.mean(
+                    [s.idle_us for s in report.steps]
+                )) * 1e-6
+                entry["measured_bubble_fraction"] = round(measured, 4)
+                entry["idle_s_per_step"] = round(idle_s, 6)
+                rec["measured_n"] += 1
+                emit(out_path, {
+                    "section": f"pipeline_{name}_bubble", "ok": True,
+                    "completed": True,
+                    "metric": f"pipeline_{name}_idle_s", "value":
+                        round(idle_s, 6),
+                    "unit": "s", "pp": pp,
+                    "bubble_fraction": round(measured, 4),
+                    "predicted_bubble_fraction": entry[
+                        "predicted_bubble_fraction"],
+                })
+        except Exception as e:
+            entry["timeline_error"] = f"{e!r}"[:200]
+        finally:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        rec[name] = entry
+    if incomplete:
+        rec["incomplete"] = incomplete
+    return rec
+
+
 def run_serving(deadline, out_path):
     """Serving-core latency under a seeded Poisson load: p50/p99 TTFT,
     p50/p99 per-token decode latency, and tokens/s through the
@@ -1014,6 +1296,7 @@ def main():
         ("sweep", functools.partial(run_sweep, out_path=args.out)),
         ("ckpt", functools.partial(run_ckpt, out_path=args.out)),
         ("comms", functools.partial(run_comms, out_path=args.out)),
+        ("pipeline", functools.partial(run_pipeline, out_path=args.out)),
         ("serving", functools.partial(run_serving, out_path=args.out)),
     ]
     for name, fn in runners:

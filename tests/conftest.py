@@ -68,10 +68,12 @@ _SLOW_TESTS = {
     "test_gpt_pretrain_xray",
     "test_gpt_pretrain_profile_analyze",
     "test_analysis_cli_subprocess",
+    "test_gpt_pp_target_zero_comms_suppressions",
     "test_sparsity_example",
     "test_llama_finetune_example",
     "test_post_params_stay_replicated_under_sp",
     "test_matches_sequential_composition",
+    "test_zero_bubble_matches_fused_pre_post",
     "test_bert_sp_loss_and_grads_match_non_sp",
     "test_tp8_loss_decreases",
     "test_selective_remat_matches_plain",

@@ -432,6 +432,149 @@ class TestZeROInPipelineTopology:
         assert int(shard) > 0
 
 
+class TestParamGatherPrefetch:
+    """The double-buffered param all-gather prefetch: every depth must
+    be bitwise-identical to the whole-shard gather (the bucketing is a
+    schedule change, not a numerics change), the depth rule must follow
+    the ICI roofline, and the bucketed gathers must stay ledger-exact."""
+
+    @pytest.mark.parametrize("factory", [
+        distributed_fused_adam, distributed_fused_lamb,
+    ])
+    @pytest.mark.parametrize("buckets", [2, 3, None])
+    def test_bitwise_matches_single_gather(self, rng, grads_seq, factory,
+                                           buckets):
+        params = make_params(rng)
+        base = run_distributed(
+            lambda: factory(lr=1e-2, weight_decay=0.01, axis_size=DP,
+                            average_grads=True, param_gather_buckets=1),
+            params, grads_seq,
+        )
+        got = run_distributed(
+            lambda: factory(lr=1e-2, weight_decay=0.01, axis_size=DP,
+                            average_grads=True,
+                            param_gather_buckets=buckets),
+            params, grads_seq,
+        )
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            base, got,
+        )
+
+    def test_remainder_mode_bitwise_across_depths(self, rng, grads_seq):
+        """store_param_remainders buckets the bf16-high gather + uint16
+        state the same way — bitwise at every depth."""
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), make_params(rng)
+        )
+        mesh = parallel_state.initialize_model_parallel(
+            devices=jax.devices()[:DP]
+        )
+
+        def run(buckets):
+            opt = distributed_fused_adam(
+                lr=1e-2, axis_size=DP, average_grads=True,
+                store_param_remainders=True, param_gather_buckets=buckets,
+            )
+
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+                check_vma=False,
+            )
+            def steps(params, gseq):
+                state = opt.init(params)
+
+                def body(carry, g):
+                    p, s = carry
+                    updates, s = opt.update(g, s, p)
+                    return (optax.apply_updates(p, updates), s), None
+
+                (p, _), _ = jax.lax.scan(body, (params, state), gseq)
+                return p
+
+            return steps(params, grads_seq)
+
+        base = run(1)
+        got = run(3)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a, np.float32), np.asarray(b, np.float32)
+            ),
+            base, got,
+        )
+
+    def test_choose_overlap_buckets_roofline_rule(self):
+        from apex_tpu.optimizers import choose_overlap_buckets
+
+        # size-1 axis: no gather at all
+        assert choose_overlap_buckets(10 * 2**20, 1) == 1
+        # unknown bandwidth: plain double-buffering, never a fake roofline
+        assert choose_overlap_buckets(10 * 2**20, 8, bandwidth=None) == 2
+        # v5e (200 GB/s): a 40 MiB shard over 8 ranks gathers
+        # 7*40 MiB ~= 1.47 ms -> 3 buckets of ~0.5 ms each
+        assert choose_overlap_buckets(40 * 2**20, 8, bandwidth=200e9) == 3
+        # tiny shard: the gather is below one quantum, nothing to hide
+        assert choose_overlap_buckets(1024, 8, bandwidth=200e9) == 1
+        # huge shard: clamped to the max depth
+        assert choose_overlap_buckets(2**31, 8, bandwidth=200e9) == 8
+        # depth grows monotonically with bytes
+        depths = [
+            choose_overlap_buckets(nbytes, 8, bandwidth=200e9)
+            for nbytes in (2**18, 2**22, 2**26, 2**30)
+        ]
+        assert depths == sorted(depths)
+
+    def test_prefetch_ledger_bytes_exact(self, rng):
+        """The bucketed gathers stay ledger-routed with exact bytes: nb
+        all_gather entries whose payloads sum to the (bucket-padded)
+        shard — predicted == what the compiled program ships."""
+        from apex_tpu.monitor.xray import ledger as xlax
+        from apex_tpu.optimizers import zero_state_specs
+
+        params = make_params(rng)
+        mesh = parallel_state.initialize_model_parallel(
+            devices=jax.devices()[:DP]
+        )
+        nb = 3
+        opt = distributed_fused_adam(
+            lr=1e-2, axis_size=DP, average_grads=True,
+            param_gather_buckets=nb,
+        )
+        sspec = zero_state_specs("dp")
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=P(), out_specs=sspec,
+            check_vma=False,
+        )
+        def init(params):
+            return opt.init(params)
+
+        state = jax.eval_shape(init, params)
+        shard = state.master_shard.shape[0] // DP
+        bs = -(-shard // nb)
+
+        @functools.partial(
+            shard_map, mesh=mesh, in_specs=(P(), sspec), out_specs=P(),
+            check_vma=False,
+        )
+        def one_update(params, state):
+            g = jax.tree_util.tree_map(jnp.ones_like, params)
+            updates, _ = opt.update(g, state, params)
+            return updates
+
+        led = xlax.predict_comms(one_update, params, state)
+        gathers = led.filter(op="all_gather", axis="dp")
+        assert len(gathers) == nb
+        assert all(e.shape == (bs,) for e in gathers)
+        # total gathered elements == the bucket-padded shard, and the
+        # per-chip wire bytes follow the ring all_gather convention
+        assert sum(e.shape[0] for e in gathers) == bs * nb
+        assert all(e.ici_bytes == (DP - 1) * bs * 4 for e in gathers)
+
+
 class TestCheckedShardMapGrads:
     """Under jax's CHECKED shard_map (check_vma=True, the default),
     jax.grad w.r.t. dp-replicated params already returns the cross-rank

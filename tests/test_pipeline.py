@@ -22,12 +22,16 @@ from apex_tpu.parallel.pipeline import (
     RampupBatchsizeNumMicroBatchesCalculator,
     build_model,
     build_num_microbatches_calculator,
+    bubble_fraction_1f1b,
+    compare_schedules,
     forward_backward_no_pipelining,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
+    forward_backward_zero_bubble,
     get_forward_backward_func,
     pipeline_forward,
     ring_send_last_to_first,
+    schedule_cost,
     send_backward_recv_backward,
     send_forward_recv_forward,
 )
@@ -430,6 +434,237 @@ class TestPipelineSchedules:
             loss, params = train_step(params, mbs, targets)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.7, losses
+
+
+class TestScheduleAlgebra:
+    """Hand-counted tick/bubble pins for every registered schedule —
+    the predicted half of the overlap proof loop (algebra.py)."""
+
+    def test_no_pipelining_hand_counted(self):
+        c = schedule_cost("no_pipelining", 4, 8)
+        assert (c.forward_ticks, c.backward_ticks) == (8, 8)
+        assert c.span_units == 24 and c.useful_units == 24
+        assert c.bubble_units == 0 and c.bubble_fraction == 0.0
+
+    def test_1f1b_hand_counted(self):
+        # P=4, M=8: scans of 11 ticks; fwd 1 unit, bwd (B+W fused) 2 ->
+        # span 33; useful 3*8 = 24; bubble 9/33 = (P-1)/(M+P-1) = 3/11
+        c = schedule_cost("1f1b", 4, 8)
+        assert (c.forward_ticks, c.backward_ticks) == (11, 11)
+        assert c.span_units == 33 and c.useful_units == 24
+        assert c.bubble_units == 9
+        assert c.bubble_fraction == pytest.approx(3 / 11)
+        assert c.bubble_fraction == pytest.approx(bubble_fraction_1f1b(4, 8))
+
+    def test_interleaved_hand_counted(self):
+        # P=2, M=4, V=2: T = 2*4 + 1 = 9 one-chunk ticks per direction;
+        # span 27, useful 3*4*2 = 24, bubble 3/27 = (P-1)/(VM+P-1) = 1/9
+        c = schedule_cost("interleaved", 2, 4, 2)
+        assert (c.forward_ticks, c.backward_ticks) == (9, 9)
+        assert c.span_units == 27 and c.useful_units == 24
+        assert c.bubble_fraction == pytest.approx(1 / 9)
+        with pytest.raises(ValueError, match="interleaved"):
+            schedule_cost("interleaved", 2, 3, 2)
+        # V=1 is just 1F1B — silently computing its bubble under the
+        # interleaved label would mislabel the prediction
+        with pytest.raises(ValueError, match="num_model_chunks"):
+            schedule_cost("interleaved", 2, 4, 1)
+
+    def test_zero_bubble_hand_counted(self):
+        # P=4, M=8: two 11-tick scans + filler max(0, 8 - 6) = 2 ->
+        # span 24 == useful 24: ZERO bubble (M >= 2(P-1))
+        c = schedule_cost("zero_bubble", 4, 8)
+        assert (c.forward_ticks, c.backward_ticks) == (11, 11)
+        assert c.filler_ticks == 2
+        assert c.span_units == 24 and c.useful_units == 24
+        assert c.bubble_fraction == 0.0
+        # P=8, M=4 (M < 2(P-1)): span 2*11 = 22, useful 12, bubble 10
+        c = schedule_cost("zero_bubble", 8, 4)
+        assert c.filler_ticks == 0
+        assert c.span_units == 22 and c.useful_units == 12
+        assert c.bubble_fraction == pytest.approx(10 / 22)
+
+    @pytest.mark.parametrize("P", [2, 4, 8])
+    @pytest.mark.parametrize("M", [1, 2, 4, 8, 16, 32])
+    def test_identity_and_zero_bubble_beats_1f1b(self, P, M):
+        """span == useful + bubble for every schedule, and the zero-
+        bubble fraction is strictly below 1F1B's (P-1)/(M+P-1) — the
+        acceptance inequality, over the whole (P, M) grid."""
+        for name in ("no_pipelining", "1f1b", "zero_bubble"):
+            c = schedule_cost(name, P, M)
+            assert c.span_units == c.useful_units + c.bubble_units
+            assert 0.0 <= c.bubble_fraction < 1.0
+        if M % P == 0:
+            for V in (2, 4):
+                c = schedule_cost("interleaved", P, M, V)
+                assert c.span_units == c.useful_units + c.bubble_units
+                assert c.bubble_fraction == pytest.approx(
+                    (P - 1) / (V * M + P - 1)
+                )
+        zb = schedule_cost("zero_bubble", P, M).bubble_fraction
+        assert zb < bubble_fraction_1f1b(P, M)
+
+    def test_compare_sorted_and_skips_invalid_interleaved(self):
+        costs = compare_schedules(4, 8, 2)
+        assert [c.bubble_fraction for c in costs] == sorted(
+            c.bubble_fraction for c in costs
+        )
+        assert {c.name for c in costs} == {
+            "no_pipelining", "1f1b", "interleaved", "zero_bubble"
+        }
+        # M=5 % P=4 != 0: the interleaved row drops out instead of lying
+        assert {c.name for c in compare_schedules(4, 5, 2)} == {
+            "no_pipelining", "1f1b", "zero_bubble"
+        }
+
+    def test_errors(self):
+        with pytest.raises(KeyError):
+            schedule_cost("nope", 2, 2)
+        with pytest.raises(ValueError):
+            schedule_cost("1f1b", 0, 2)
+
+
+class TestZeroBubble:
+    """The B/W-split schedule: gradient parity with the fused jax.grad
+    path, and the closed transpose blind spot (backward edges ledgered)."""
+
+    @pytest.mark.parametrize("num_micro", [4, 5, 8])
+    def test_matches_1f1b_bitwise(self, rng, num_micro):
+        """Split-backward loss AND grads are BITWISE equal to the fused
+        1F1B path on the toy stage — the B/W split is a schedule change,
+        not a numerics change."""
+        pp = 4
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+        params = make_stage_params(rng, pp)
+        mbs = jax.random.normal(
+            jax.random.fold_in(rng, 1), (num_micro, MICRO_B, HID)
+        )
+        targets = jax.random.normal(
+            jax.random.fold_in(rng, 2), (num_micro, MICRO_B, HID)
+        )
+        pspec = {"w": P("pp", None, None), "b": P("pp", None)}
+
+        def make(fb):
+            @jax.jit
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(pspec, P(), P()),
+                out_specs=(P(), P(), pspec), check_vma=False,
+            )
+            def run(stacked, mbs, targets):
+                local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+                loss, losses, grads = fb(
+                    stage_fn, loss_fn, local, mbs, targets, axis_name="pp"
+                )
+                return loss, losses, jax.tree_util.tree_map(
+                    lambda g: g[None], grads
+                )
+
+            return run
+
+        l1, ls1, g1 = make(forward_backward_pipelining_without_interleaving)(
+            params, mbs, targets
+        )
+        lz, lsz, gz = make(forward_backward_zero_bubble)(params, mbs, targets)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(lz))
+        np.testing.assert_array_equal(np.asarray(ls1), np.asarray(lsz))
+        for k in ("w", "b"):
+            np.testing.assert_array_equal(np.asarray(g1[k]), np.asarray(gz[k]))
+
+    def test_checked_vma_matches_unchecked(self, rng):
+        """Both shard_map modes produce the same zero-bubble grads (the
+        carry fixed-point typing — _varying_zeros on dy AND the grad
+        accumulator — holds under checked vma)."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()), ("pp",))
+        M = 8
+
+        def sfn(p, x):
+            return jnp.tanh(x @ p)
+
+        def lfn(x, t):
+            return jnp.mean((x - t) ** 2)
+
+        def run(check_vma):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(P("pp"), P(), P()),
+                out_specs=(P(), P("pp")), check_vma=check_vma,
+            )
+            def f(stacked, xs, ts):
+                loss, _, grads = forward_backward_zero_bubble(
+                    sfn, lfn, stacked[0], xs, ts, axis_name="pp"
+                )
+                return jax.lax.pmean(loss, "pp"), grads[None]
+
+            return f
+
+        stacked = 0.5 * jax.random.normal(rng, (8, HID, HID))
+        xs = jax.random.normal(jax.random.fold_in(rng, 1), (M, 2, HID))
+        ts = jax.random.normal(jax.random.fold_in(rng, 2), (M, 2, HID))
+        lu, gu = run(False)(stacked, xs, ts)
+        lc, gc = run(True)(stacked, xs, ts)
+        np.testing.assert_array_equal(np.asarray(lu), np.asarray(lc))
+        np.testing.assert_array_equal(np.asarray(gu), np.asarray(gc))
+
+    def test_backward_edges_are_ledger_predicted(self, rng):
+        """The closed blind spot: the fused path's ledger sees only the
+        forward ppermutes (transpose edges are invisible); zero-bubble
+        predicts BOTH directions — 2 ppermute entries, each weighted by
+        the full T = M + P - 1 tick count."""
+        from apex_tpu.monitor.xray import ledger as xlax
+
+        pp, M = 4, 8
+        mesh = parallel_state.initialize_model_parallel(
+            pipeline_model_parallel_size=pp, devices=jax.devices()[:pp]
+        )
+        params = make_stage_params(rng, pp)
+        mbs = jnp.zeros((M, MICRO_B, HID))
+        tgts = jnp.zeros((M, MICRO_B, HID))
+        pspec = {"w": P("pp", None, None), "b": P("pp", None)}
+
+        def make(fb):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=(pspec, P(), P()),
+                out_specs=(P(), P(), pspec), check_vma=False,
+            )
+            def run(stacked, mbs, targets):
+                local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+                loss, losses, grads = fb(
+                    stage_fn, loss_fn, local, mbs, targets, axis_name="pp"
+                )
+                return loss, losses, jax.tree_util.tree_map(
+                    lambda g: g[None], grads
+                )
+
+            return run
+
+        T = M + pp - 1
+        led = xlax.predict_comms(
+            make(forward_backward_zero_bubble), params, mbs, tgts
+        )
+        perms = led.filter(op="ppermute", axis="pp")
+        assert sorted(e.count for e in perms) == [T, T]
+        led_1f1b = xlax.predict_comms(
+            make(forward_backward_pipelining_without_interleaving),
+            params, mbs, tgts,
+        )
+        # the fused path predicts only the forward scan's edges
+        assert [e.count for e in led_1f1b.filter(op="ppermute", axis="pp")] \
+            == [T]
+
+    def test_dispatcher_zero_bubble(self):
+        assert (
+            get_forward_backward_func(None, 4, zero_bubble=True)
+            is forward_backward_zero_bubble
+        )
+        assert (
+            get_forward_backward_func(None, 1, zero_bubble=True)
+            is forward_backward_no_pipelining
+        )
+        with pytest.raises(ValueError, match="zero_bubble"):
+            get_forward_backward_func(2, 4, zero_bubble=True)
 
 
 class TestDispatcher:

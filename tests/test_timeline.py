@@ -286,6 +286,46 @@ class TestBreakdown:
         assert s.overlap_fraction == pytest.approx(1.0 - 10.0 / 30.0)
         assert s.n_ops == 4
 
+    def test_predicted_bubble_join(self):
+        """The schedule-algebra join: the caller's predicted bubble
+        fraction rides every per-step kind="profile" record next to the
+        measured one, and the summary prints the comparison — the
+        predicted-vs-measured leg of the zero-bubble proof loop."""
+        from apex_tpu.parallel.pipeline import schedule_cost
+
+        cost = schedule_cost("zero_bubble", 4, 8)
+        report = analyze(
+            self.fixture_a(),
+            predicted_bubble_fraction=cost.bubble_fraction,
+            schedule="zero_bubble",
+        )
+        (s,) = report.steps
+        recs = [r for r in report.to_records() if "bubble_fraction" in r]
+        (r,) = recs
+        assert r["predicted_bubble_fraction"] == cost.bubble_fraction
+        assert r["schedule"] == "zero_bubble"
+        assert r["bubble_fraction"] == pytest.approx(0.35)
+        summary = report.summary()
+        assert "bubble join (zero_bubble)" in summary
+        assert "predicted" in summary and "measured" in summary
+        # without the join, neither field appears (the analyzer never
+        # invents a prediction)
+        plain = analyze(self.fixture_a())
+        assert all(
+            "predicted_bubble_fraction" not in r for r in plain.to_records()
+        )
+        assert "bubble join" not in plain.summary()
+
+    def test_cli_schedule_choices_in_sync(self):
+        """The CLI's literal --schedule choices (spelled out so the
+        no-jax CLI contract holds) must track the algebra registry."""
+        from apex_tpu.monitor.xray.timeline.__main__ import (
+            _SCHEDULE_CHOICES,
+        )
+        from apex_tpu.parallel.pipeline.algebra import SCHEDULES
+
+        assert sorted(_SCHEDULE_CHOICES) == sorted(SCHEDULES)
+
     def test_partition_identity(self):
         (s,) = analyze(self.fixture_a()).steps
         assert (
