@@ -379,6 +379,21 @@ _LINT = [
         require_hit=True,
     ),
     AllowlistEntry(
+        rule="lint.process-exit",
+        match="apex_tpu/resilience/health/responder.py",
+        reason=(
+            "the ONE deliberate hard-exit home: the incident "
+            "responder's coordinated self-termination must use "
+            "os._exit because a wedged main thread can run neither "
+            "signal handlers nor atexit hooks — the responder performs "
+            "the teardown (span flush, pending-save tombstone) itself "
+            "from the watchdog thread and then ends the process with "
+            "ExitCode.INCIDENT; sys.exit would raise into a thread "
+            "that cannot unwind"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
         rule="lint.silent-except",
         match="apex_tpu/monitor/router.py",
         reason=(

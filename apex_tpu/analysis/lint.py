@@ -99,6 +99,19 @@ Plus the new rules this framework exists to host:
   inputs. The legitimate host-side homes — the retry jitter and the
   record-timestamp clock — carry require_hit allowlist entries with
   exactly those reasons.
+- ``lint.process-exit`` — no raw ``os._exit(...)`` / ``sys.exit(...)``
+  (or ``from os import _exit`` / ``from sys import exit``) in library
+  code outside the blessed homes. The exit-code TAXONOMY is closed
+  (``resilience/exit_codes.py``: incident 43, remediation restart
+  44 / halt 45, replay divergence 2) and a supervisor BRANCHES on it —
+  a stray exit call invents an undocumented code and, worse, ends the
+  process without the teardown discipline (span flush, pending-save
+  tombstone) the blessed paths guarantee. The exemption is structural
+  for the CLI convention — a ``sys.exit`` lexically inside an
+  ``if __name__ == "__main__":`` gate is how every ``__main__`` module
+  returns its documented code — and allowlisted (require_hit, with the
+  reason) for the one deliberate hard-exit home,
+  ``resilience/health/responder.py``'s coordinated self-termination.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -543,6 +556,86 @@ def silent_except(ctx: LintContext) -> Iterable[Finding]:
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                     data={"form": "silent"},
                 )
+
+
+def _main_gate_spans(tree: ast.Module) -> List[Tuple[int, int]]:
+    """(start, end) line spans of top-level ``if __name__ == "__main__":``
+    blocks — the one structural exemption lint.process-exit grants."""
+    spans: List[Tuple[int, int]] = []
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__"
+                and any(isinstance(c, ast.Constant)
+                        and c.value == "__main__"
+                        for c in test.comparators)):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
+@lint_rule("lint.process-exit", scopes=("apex_tpu/",))
+def process_exit(ctx: LintContext) -> Iterable[Finding]:
+    """Raw ``os._exit``/``sys.exit`` usage outside the blessed homes
+    (module docstring). AST-based: flags the ATTRIBUTE usage, not just
+    calls — ``exit_fn = os._exit`` rewires the same authority — plus
+    the ``from os import _exit`` / ``from sys import exit`` imports
+    that would hide the attribute from review."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.process-exit",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        gates = _main_gate_spans(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                    "os", "sys"):
+                for a in node.names:
+                    if (node.module, a.name) in (("os", "_exit"),
+                                                 ("sys", "exit")):
+                        yield Finding(
+                            rule="lint.process-exit",
+                            message=(
+                                f"'from {node.module} import {a.name}' "
+                                f"hides a process-exit call site from "
+                                f"review — spell it "
+                                f"{node.module}.{a.name}(...) in a "
+                                f"blessed home"
+                            ),
+                            site=f"{rel}:{node.lineno}",
+                            severity=SEV_ERROR,
+                        )
+                continue
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)):
+                continue
+            pair = (node.value.id, node.attr)
+            if pair not in (("os", "_exit"), ("sys", "exit")):
+                continue
+            if pair == ("sys", "exit") and any(
+                    lo <= node.lineno <= hi for lo, hi in gates):
+                continue  # the __main__-gate CLI convention
+            yield Finding(
+                rule="lint.process-exit",
+                message=(
+                    f"raw {node.value.id}.{node.attr} outside the "
+                    f"blessed homes — exit codes are a CLOSED taxonomy "
+                    f"(resilience/exit_codes.py) that supervisors branch "
+                    f"on, and the blessed paths (the __main__ gates, the "
+                    f"incident responder's coordinated self-termination) "
+                    f"own the teardown discipline; return an ExitCode "
+                    f"from main() or route through the responder"
+                ),
+                site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                data={"call": f"{node.value.id}.{node.attr}"},
+            )
 
 
 #: goodput span constructors -> position of their ``phase`` argument

@@ -81,6 +81,13 @@ __all__ = [
 #: - ``incident``      — a stall that escalated: the wedged time from the
 #:   last heartbeat to the incident responder's self-termination
 #:   (resilience.health; docs/resilience.md "Incident response")
+#: - ``remediation``   — the auto-remediation controller's envelope
+#:   (resilience.remediation; docs/resilience.md "Auto-remediation"):
+#:   canary re-execution of a suspect segment, quarantine bookkeeping,
+#:   probation accounting. Outranks ``step`` in PHASE_PRIORITY so a
+#:   canary replay's nested ``step``/``ckpt_restore`` spans book as
+#:   recovery badput, never silently productive — automated recovery
+#:   time is still recovery time
 #: - ``prefill``       — a serving prefill pass: prompt tokens entering
 #:   the KV cache (apex_tpu.serving; productive, like ``step``)
 #: - ``decode``        — a serving decode tick: one token per in-flight
@@ -104,6 +111,7 @@ PHASES = (
     "rollback",
     "stall",
     "incident",
+    "remediation",
     "drain",
     "shutdown",
 )
@@ -129,11 +137,18 @@ PRODUCTIVE_PHASES = ("step", "prefill", "decode")
 #: the escalating watchdog PROVED the time was dead (a wedged step is
 #: indistinguishable from a long one until the deadline blows), so the
 #: still-open pseudo-step span it overlaps must not book as productive.
+#: ``remediation`` outranks ``step`` for the same reason from the other
+#: side: the controller's canary re-executes journaled steps (which book
+#: their own ``step``/``ckpt_restore`` spans through the replayer), and
+#: a re-executed step moves no NEW tokens — the whole envelope is
+#: recovery badput by definition, so the envelope must claim the wall
+#: time before the nested work phases can.
 #: ``drain`` sits below the serving work phases (a drain window is an
 #: envelope: decode ticks inside it are still productive) but above
 #: ``init``/``shutdown`` so its exposed overhead is named, not generic.
 PHASE_PRIORITY = (
     "incident",
+    "remediation",
     "step",
     "prefill",
     "decode",

@@ -23,12 +23,16 @@ bundles and termination marks from apex_tpu.resilience.health;
 replay kinds ("journal" — the flight recorder's per-step
 nondeterminism inputs and fingerprints; "replay" — a re-execution
 segment's comparison outcome; "divergence" — the bisector's forensic
-verdict, all from apex_tpu.resilience.replay), and the serving kind
+verdict, all from apex_tpu.resilience.replay), the serving kind
 ("request" — one record per request-lifecycle transition from the
 apex_tpu.serving scheduler: queued/admitted/prefill/decode plus the
-terminal states, docs/serving.md), so pre-flight audit results and
-run-lifecycle accounting land in the same jsonl a tailer already
-reads.
+terminal states, docs/serving.md), and the remediation kind
+("remediation" — one record per auto-remediation case transition from
+apex_tpu.resilience.remediation: detect/verify/quarantine/probation/
+readmit/escalate with the triggering detector records attached as
+evidence in the incident-bundle idiom, docs/resilience.md
+"Auto-remediation"), so pre-flight audit results and run-lifecycle
+accounting land in the same jsonl a tailer already reads.
 
 ``host`` is the producing process's index (``jax.process_index()``) so
 merged multi-host streams stay attributable; it defaults to 0 and is
@@ -200,8 +204,13 @@ class CsvSink(Sink):
     #: schema additions that are plumbing, not data (see class docstring).
     #: "data_skipped" (the bounded data-pipeline skip counter,
     #: apex_tpu/data/robust.py) joined the metrics record after CSVs in
-    #: the wild froze their headers, exactly like "host" before it.
-    TOLERATED_EXTRA_KEYS = frozenset({"host", "data_skipped"})
+    #: the wild froze their headers, exactly like "host" before it —
+    #: and "probation"/"remediation_cases" (the auto-remediation
+    #: controller's per-interval gauges, resilience.remediation) after
+    #: that, for the same frozen-header-resume reason.
+    TOLERATED_EXTRA_KEYS = frozenset({
+        "host", "data_skipped", "probation", "remediation_cases",
+    })
 
     def __init__(self, path: str, kinds=("metrics",)):
         self.path = path
@@ -250,13 +259,17 @@ class StdoutSink(Sink):
     as is "request" (the serving scheduler's per-transition lifecycle
     records, apex_tpu.serving): a loaded server emits several per tick,
     and the console surface is the engine's summary line, not the
-    firehose. The ``host`` field is likewise plumbing and never
-    rendered.
+    firehose. "remediation" (the auto-remediation controller,
+    resilience.remediation) is skipped for the incident reason: each
+    record attaches its triggering evidence records wholesale, far too
+    large for a one-liner — the controller logs compact action lines
+    and the file sinks carry the case history. The ``host`` field is
+    likewise plumbing and never rendered.
     """
 
     def __init__(self, stream=None,
                  skip_kinds=("span", "run", "incident", "journal",
-                             "request")):
+                             "request", "remediation")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 

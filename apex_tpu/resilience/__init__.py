@@ -44,6 +44,17 @@ stakes; see PAPERS.md):
   bitwise/tolerance fingerprint comparison, and the corruption bisector
   that pins a silent fault to the exact step and leaf — the
   ``python -m apex_tpu.resilience.replay`` CLI and ``--selftest`` gate.
+- ``exit_codes`` — the ONE home of the process-exit taxonomy (incident
+  43, remediation restart 44 / halt 45, replay divergence 2) that the
+  responder, the CLIs, the supervisor, and the drill tests share.
+- ``remediation`` — self-healing: the policy-driven controller that
+  turns the detectors' findings into bounded recovery actions (canary
+  verify → quarantine → probation → readmit | escalate-to-halt), each
+  one a ``kind="remediation"`` record with the evidence attached; the
+  exit-code supervisor that relaunches reduced topologies; and the
+  seeded chaos-campaign runner with its invariant checker — the
+  ``python -m apex_tpu.resilience.remediation`` CLI and ``--selftest``
+  gate.
 
 End-to-end wiring: ``AmpOptimizer.step(..., sentinel=...)``,
 ``AutoResume`` (verified restore + async-finalized saves + retention),
@@ -80,9 +91,12 @@ from apex_tpu.resilience.integrity import (
 )
 from apex_tpu.resilience import chaos
 from apex_tpu.resilience import elastic
+from apex_tpu.resilience import exit_codes
 from apex_tpu.resilience import health
+from apex_tpu.resilience import remediation
 from apex_tpu.resilience import replay
 from apex_tpu.resilience import retry
+from apex_tpu.resilience.exit_codes import ExitCode
 
 __all__ = [
     "AnomalySentinel",
@@ -106,9 +120,12 @@ __all__ = [
     "verify_checkpoint",
     "write_abandoned_marker",
     "write_manifest",
+    "ExitCode",
     "chaos",
     "elastic",
+    "exit_codes",
     "health",
+    "remediation",
     "replay",
     "retry",
 ]

@@ -247,14 +247,16 @@ def selftest(directory=None) -> int:
     _check(failures, step == 3,
            "pre-topology newest manifest skipped (format-upgrade rollback)")
 
+    from apex_tpu.resilience.exit_codes import ExitCode
+
     if failures:
         print(f"elastic selftest: {len(failures)} check(s) FAILED:",
               flush=True)
         for f in failures:
             print(f"  - {f}", flush=True)
-        return 1
+        return int(ExitCode.FAILURE)
     print("elastic selftest: all checks passed", flush=True)
-    return 0
+    return int(ExitCode.OK)
 
 
 def main(argv=None) -> int:

@@ -54,6 +54,7 @@ from typing import List, Optional
 from apex_tpu.monitor.goodput.spans import emit_span
 from apex_tpu.monitor.router import flush_all_routers
 from apex_tpu.monitor.watchdog import StallWatchdog
+from apex_tpu.resilience.exit_codes import ExitCode
 from apex_tpu.resilience.health.incident import capture_incident
 
 logger = logging.getLogger("apex_tpu.resilience.health")
@@ -63,8 +64,10 @@ __all__ = ["INCIDENT_EXIT_CODE", "IncidentResponder"]
 #: the self-termination exit status: distinct from success (0), python
 #: tracebacks (1), argparse (2) and signal deaths (128+N), so a
 #: supervisor (and the chaos drill) can tell "ended by incident
-#: response, restart me" from every other ending
-INCIDENT_EXIT_CODE = 43
+#: response, restart me" from every other ending. The number lives in
+#: the one-home taxonomy (resilience/exit_codes.py); this module-level
+#: name is the historical import surface and stays.
+INCIDENT_EXIT_CODE = int(ExitCode.INCIDENT)
 
 
 class IncidentResponder:

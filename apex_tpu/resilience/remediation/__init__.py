@@ -1,0 +1,115 @@
+"""Self-healing runs: detector findings → bounded recovery actions.
+
+The trust layer detects (sentinel verdicts, fleet straggler/SDC flags,
+the stall ladder, the replay referee) and the ops layer survives
+(elastic restart, verified checkpoints, incident self-termination) —
+this package closes the loop between them: the system now *acts* on
+its own verdicts, with every action bounded, auditable, and reversible
+(docs/resilience.md "Auto-remediation"):
+
+- ``policy``     — the closed action state machine (verify →
+  quarantine → probation → readmit | cleared | recovered | halted) and
+  the :class:`RemediationPolicy` bounds table; ``advance`` refuses
+  unregistered transitions.
+- ``state``      — the persisted cross-incarnation plan
+  (``<save>/remediation-state.json``: quarantined devices, restart
+  budget, open cases) and the reversible checkpoint-quarantine move.
+- ``controller`` — :class:`RemediationController`: detector records
+  in (one ``ControllerSink`` tap on the MetricRouter), decisions out
+  (:class:`RemediationDecision` restart/halt + exit code), every
+  transition one ``kind="remediation"`` record with the triggering
+  evidence attached; canary verification before any restart.
+- ``canary``     — the replayer-backed verifier: re-execute the newest
+  journaled segment(s); clean ⇒ the finding was transient (case closes
+  ``cleared``, zero restarts), divergent ⇒ confirmed corruption with
+  the clean anchor and the exact leaf already in evidence.
+- ``supervisor`` — the relauncher: exit codes
+  (resilience/exit_codes.py) in, bounded incarnations out; the
+  persisted state carries the topology between them.
+- ``campaign``   — seeded randomized fault sequences (hang, slow-host,
+  bitflip, NaN poison, SIGTERM) against the GPT target with an
+  invariant checker (goodput partition identity, one terminal verdict
+  per fault, no quarantine without verification, loss-trajectory pin)
+  and failing-sequence minimization.
+
+CLI: ``python -m apex_tpu.resilience.remediation`` (the exit-nonzero
+``--selftest`` gate wired into the verify skill next to the elastic
+and replay gates, and ``--supervise`` to run a command under
+remediation restarts).
+
+The jax-free pieces (policy, state, controller, supervisor) import
+eagerly — the machine must be auditable on a box with no jax at all;
+the jax-bearing pieces (canary, campaign) load lazily via PEP 562.
+"""
+
+from apex_tpu.resilience.remediation.controller import (
+    ControllerSink,
+    DETECTOR_KINDS,
+    RemediationController,
+    RemediationDecision,
+)
+from apex_tpu.resilience.remediation.policy import (
+    CASE_KINDS,
+    RemediationPolicy,
+    TERMINAL_STATES,
+    TRANSITIONS,
+    advance,
+)
+from apex_tpu.resilience.remediation.state import (
+    RemediationState,
+    quarantine_checkpoints,
+    state_path,
+)
+from apex_tpu.resilience.remediation.supervisor import (
+    SupervisorReport,
+    supervise,
+)
+
+__all__ = [
+    "CASE_KINDS",
+    "ControllerSink",
+    "DETECTOR_KINDS",
+    "RemediationController",
+    "RemediationDecision",
+    "RemediationPolicy",
+    "RemediationState",
+    "SupervisorReport",
+    "TERMINAL_STATES",
+    "TRANSITIONS",
+    "advance",
+    "quarantine_checkpoints",
+    "state_path",
+    "supervise",
+    # jax-bearing pieces, lazy via PEP 562 below
+    "GPTCanary",
+    "FaultEvent",
+    "TrainingCache",
+    "random_sequence",
+    "run_sequence",
+    "check_invariants",
+    "minimize_failing",
+    "run_campaign",
+]
+
+_LAZY = {
+    "GPTCanary": "apex_tpu.resilience.remediation.canary",
+    "FaultEvent": "apex_tpu.resilience.remediation.campaign",
+    "TrainingCache": "apex_tpu.resilience.remediation.campaign",
+    "random_sequence": "apex_tpu.resilience.remediation.campaign",
+    "run_sequence": "apex_tpu.resilience.remediation.campaign",
+    "check_invariants": "apex_tpu.resilience.remediation.campaign",
+    "minimize_failing": "apex_tpu.resilience.remediation.campaign",
+    "run_campaign": "apex_tpu.resilience.remediation.campaign",
+}
+
+
+def __getattr__(name):
+    # PEP-562 lazy exports (the analysis/__init__ contract): the canary
+    # and campaign pull the replayer (jax) — the controller/supervisor
+    # half must stay importable jax-free
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -1,0 +1,360 @@
+"""``python -m apex_tpu.resilience.remediation`` — selftest, supervise.
+
+Modes:
+
+- ``--selftest`` (default): exit-nonzero gate (the verify-skill
+  contract, next to the elastic and replay gates) proving the whole
+  closed loop end-to-end on the virtual 8-device CPU topology:
+
+  1. a clean reference sequence completes with ZERO remediation cases
+     (the periodic canary audit replays every segment clean);
+  2. an injected silent bit flip — the SDC the sentinel misses — is
+     detected by the canary audit, confirmed, quarantined (8→4, the
+     corrupt checkpoints moved aside, restart from the clean anchor),
+     ridden through probation on the reduced topology, and readmitted
+     (4→8), with exactly ONE terminal ``kind="remediation"`` verdict
+     and the final loss pinned to the uninterrupted reference;
+  3. a straggler flag whose canary replays clean closes ``cleared``
+     with zero restarts (the false-positive path);
+  4. the DELIBERATELY BROKEN policy (quarantine without canary
+     verification) is caught by the campaign's invariant checker;
+  5. the fleet edge cases (zero-MAD outlier, <3 hosts) flow through
+     the LiveFleetMonitor → controller hand-off soundly;
+  6. the supervisor turns exit codes into bounded relaunches
+     (injected runner — no subprocesses in the gate).
+
+- ``--supervise --save DIR --devices N -- <command...>``: run a
+  training command under remediation restarts (supervisor.py); a
+  literal ``{devices}`` in the command is substituted per incarnation.
+
+- ``--campaign N``: run N seeded randomized fault sequences plus the
+  clean reference through the invariant checker (the slow-tier
+  acceptance surface; the gate keeps to the single-scenario selftest
+  for budget).
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+from apex_tpu.resilience.exit_codes import ExitCode
+
+
+def _ensure_cpu_mesh_env():
+    """Force the 8-virtual-device CPU topology BEFORE jax initializes
+    its backends (the tests/conftest.py pattern)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+
+def _check(failures, ok, label):
+    status = "ok" if ok else "FAIL"
+    print(f"  [{status}] {label}", flush=True)
+    if not ok:
+        failures.append(label)
+
+
+def selftest(directory=None) -> int:
+    _ensure_cpu_mesh_env()
+    from apex_tpu.data import IndexedTokenDataset, LMDataset
+    from apex_tpu.monitor import MemorySink, MetricRouter
+    from apex_tpu.monitor.goodput import LiveFleetMonitor
+    from apex_tpu.monitor.goodput.accountant import account
+    from apex_tpu.monitor.router import make_record
+    from apex_tpu.resilience.remediation.campaign import (
+        FaultEvent,
+        SequenceResult,
+        TrainingCache,
+        campaign_config,
+        check_invariants,
+        run_sequence,
+    )
+    from apex_tpu.resilience.remediation.canary import GPTCanary
+    from apex_tpu.resilience.remediation.controller import (
+        RemediationController,
+    )
+    from apex_tpu.resilience.remediation.policy import RemediationPolicy
+    from apex_tpu.resilience.remediation.supervisor import supervise
+    from apex_tpu.resilience.replay.journal import journal_path
+    from apex_tpu.resilience.replay.targets import synthetic_corpus
+
+    directory = directory or tempfile.mkdtemp(prefix="apex_tpu_remediation_")
+    failures = []
+    print(f"remediation selftest (dir {directory})", flush=True)
+
+    cfg = campaign_config()
+    cache = TrainingCache(cfg)
+    prefix = synthetic_corpus(cfg.vocab, n_tokens=20_000)
+    lm = LMDataset(IndexedTokenDataset(prefix), seq_len=cfg.seq_len)
+    steps = 8
+
+    # 1) clean reference: completes, zero cases, audits all clean
+    reference = run_sequence(
+        [], os.path.join(directory, "reference"), cache, lm, prefix,
+        steps=steps,
+    )
+    _check(failures, reference.outcome == "completed",
+           "clean reference sequence completes")
+    _check(failures, not reference.remediation,
+           "clean reference opens ZERO remediation cases (audits clean)")
+    _check(failures, len(reference.incarnations) == 1,
+           "clean reference needs one incarnation")
+
+    # 2) the headline: silent bit flip -> detect -> canary-confirm ->
+    # quarantine 8->4 -> probation -> readmit 4->8, zero human steps
+    flip_dir = os.path.join(directory, "bitflip")
+    result = run_sequence(
+        [FaultEvent("bitflip", 3)], flip_dir, cache, lm, prefix,
+        steps=steps,
+    )
+    _check(failures, result.outcome == "completed",
+           "bitflip sequence completes with zero human intervention")
+    devices_seq = [i["devices"] for i in result.incarnations]
+    _check(failures, 4 in devices_seq and devices_seq[0] == 8
+           and devices_seq[-1] == 8,
+           f"quarantine reduced 8->4 and readmitted 4->8 "
+           f"(incarnation topologies {devices_seq})")
+    terminals = result.terminals
+    _check(failures, len(terminals) == 1
+           and terminals[0].get("finding") == "sdc"
+           and terminals[0].get("verdict") == "readmitted",
+           f"exactly one terminal verdict, (sdc, readmitted) "
+           f"(got {[(t.get('finding'), t.get('verdict')) for t in terminals]})")
+    quarantines = [r for r in result.remediation
+                   if r.get("action") == "quarantine"]
+    _check(failures, len(quarantines) == 1
+           and quarantines[0].get("tombstoned")
+           and quarantines[0].get("restore_step") is not None
+           and quarantines[0].get("excluded"),
+           "quarantine record carries excluded devices + tombstoned "
+           "checkpoints + clean-anchor restore step")
+    opens = [r for r in result.remediation if r.get("action") == "open"
+             and r.get("finding") == "sdc"]
+    exact_leaf = bool(opens) and any(
+        isinstance(ev, dict) and len(ev.get("leaves") or []) == 1
+        for ev in (opens[0].get("evidence") or [])
+    )
+    _check(failures, exact_leaf,
+           "canary evidence pins the EXACT flipped leaf (boundary "
+           "corruption, one differing crc)")
+    violations = check_invariants(
+        result, reference_losses=reference.losses, final_step=steps - 1,
+    )
+    _check(failures, violations == [],
+           f"invariant checker passes the healed sequence "
+           f"(violations: {violations})")
+    rep = account(result.records, run_id=result.run_id)
+    _check(failures, rep.incarnations == len(result.incarnations)
+           and rep.badput_s.get("remediation", 0.0) > 0.0,
+           "goodput: every incarnation accounted, canary/audit time "
+           "booked as remediation badput")
+
+    # 3) false positive: a straggler flag whose canary replays clean
+    # closes cleared — no restart, no topology change
+    training8 = cache.get(8)[1]
+    ref_dir = os.path.join(directory, "reference")
+    router3 = MetricRouter([MemorySink()])
+    ctrl = RemediationController(
+        policy=RemediationPolicy(),
+        router=router3,
+        save_dir=None,
+        world_devices=8,
+        canary_fn=GPTCanary(journal_path(ref_dir), ref_dir,
+                            training=training8, lm=lm),
+    )
+    ctrl.observe(make_record("fleet", 6, check="straggler",
+                             flagged_host=2, median_step_s=9.9, z=11.0))
+    decision = ctrl.process(6)
+    records3 = ctrl.records
+    _check(failures, decision is None
+           and any(r.get("action") == "clear"
+                   and r.get("verdict") == "cleared" for r in records3),
+           "straggler flag + clean canary replay -> verdict=cleared, "
+           "no restart (false-positive path)")
+    _check(failures, not ctrl.open_cases and not ctrl.state.excluded,
+           "cleared case leaves no open case and no exclusion")
+    router3.close()
+
+    # 4) the deliberately broken policy (quarantine WITHOUT canary
+    # verification) is caught by the invariant checker
+    broken_dir = os.path.join(directory, "broken")
+    os.makedirs(broken_dir, exist_ok=True)
+    ctrl_b = RemediationController(
+        policy=RemediationPolicy(verify_before_quarantine=False),
+        save_dir=broken_dir,
+        world_devices=8,
+    )
+    ctrl_b.observe(make_record("fleet", 6, check="straggler",
+                               flagged_host=2, median_step_s=9.9, z=11.0))
+    decision_b = ctrl_b.process(6)
+    _check(failures, decision_b is not None
+           and decision_b.action == "restart"
+           and decision_b.exit_code == int(ExitCode.REMEDIATION_RESTART),
+           "broken policy DOES quarantine the unverified straggler "
+           "(the failure shape under test)")
+    fake = SequenceResult(
+        faults=[FaultEvent("slow", 6)], run_id="broken",
+        outcome="completed", incarnations=[], records=ctrl_b.records,
+        remediation=ctrl_b.records, losses={},
+    )
+    broken_violations = check_invariants(fake)
+    _check(failures, any("WITHOUT canary verification" in v
+                         for v in broken_violations),
+           f"invariant checker catches the unverified quarantine "
+           f"(violations: {broken_violations})")
+
+    # 5) fleet edge cases through the LiveFleetMonitor -> controller
+    # hand-off: zero-MAD outlier flags (inf z) and flows; <3 hosts
+    # cannot flag and opens nothing
+    def fleet_window(n_hosts, slow_host=None):
+        recs = []
+        for h in range(n_hosts):
+            for s in range(4):
+                dur = 5.0 if h == slow_host else 0.1
+                recs.append({"kind": "span", "phase": "step", "step": s,
+                             "host": h, "start": float(s), "dur_s": dur})
+        return recs
+
+    window = MemorySink()
+    for r in fleet_window(4, slow_host=3):
+        window.emit(r)
+    router5 = MetricRouter([MemorySink()])
+    mon = LiveFleetMonitor(router5, window, interval_steps=1)
+    mon.maybe_check(0)  # anchors the cadence
+    report = mon.maybe_check(1)
+    stub_ctrl = RemediationController(
+        policy=RemediationPolicy(), router=router5, world_devices=8,
+        canary_fn=lambda: {"ok": True, "audited": [[0, 2]]},
+    )
+    touched = stub_ctrl.observe_fleet(report, 1)
+    stub_ctrl.process(1)
+    _check(failures, report is not None and not report.ok
+           and len(touched) == 1
+           and any(r.get("verdict") == "cleared"
+                   for r in stub_ctrl.records),
+           "zero-MAD straggler (robust z=inf) flows monitor -> "
+           "controller -> canary -> cleared")
+    window2 = MemorySink()
+    for r in fleet_window(2, slow_host=1):
+        window2.emit(r)
+    mon2 = LiveFleetMonitor(router5, window2, interval_steps=1)
+    mon2.maybe_check(0)
+    report2 = mon2.maybe_check(1)
+    ctrl2 = RemediationController(policy=RemediationPolicy(),
+                                  world_devices=8)
+    touched2 = ctrl2.observe_fleet(report2, 1)
+    _check(failures, report2 is not None and report2.ok
+           and touched2 == [] and not ctrl2.open_cases,
+           "<3 hosts: straggler math refuses, controller opens nothing")
+    router5.close()
+
+    # 6) the supervisor: exit codes -> bounded relaunches, no
+    # subprocesses (injected runner)
+    sup_dir = os.path.join(directory, "supervisor")
+    os.makedirs(sup_dir, exist_ok=True)
+    codes = [int(ExitCode.INCIDENT), int(ExitCode.REMEDIATION_RESTART),
+             int(ExitCode.OK)]
+    seen_envs = []
+
+    def runner(argv, env):
+        seen_envs.append(env.get("XLA_FLAGS"))
+        return codes.pop(0)
+
+    rep6 = supervise(lambda n: ["train", f"--devices={n}"], sup_dir, 8,
+                     runner=runner)
+    from apex_tpu.resilience.remediation.state import RemediationState
+
+    _check(failures, rep6.ok and len(rep6.incarnations) == 3,
+           "supervisor relaunches on 43/44 and stops on 0")
+    pending = RemediationState.load(sup_dir).pending
+    _check(failures, pending is not None
+           and pending.get("exit_code") == int(ExitCode.INCIDENT),
+           "supervisor wrote the incident adoption note")
+    _check(failures,
+           all(env and "device_count=8" in env for env in seen_envs),
+           "supervisor pins the relaunch topology into XLA_FLAGS")
+    rep7 = supervise(lambda n: ["train"], sup_dir, 8,
+                     runner=lambda a, e: int(ExitCode.REMEDIATION_HALT))
+    _check(failures, rep7.outcome == "halted"
+           and len(rep7.incarnations) == 1,
+           "supervisor stops immediately on escalate-to-halt (45)")
+
+    if failures:
+        print(f"remediation selftest: {len(failures)} check(s) FAILED:",
+              flush=True)
+        for f in failures:
+            print(f"  - {f}", flush=True)
+        return int(ExitCode.FAILURE)
+    print("remediation selftest: all checks passed", flush=True)
+    return int(ExitCode.OK)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m apex_tpu.resilience.remediation",
+        description="auto-remediation selftest / supervisor / campaign "
+                    "(docs/resilience.md 'Auto-remediation')",
+    )
+    parser.add_argument("--selftest", action="store_true",
+                        help="end-to-end closed-loop gate (the default "
+                             "mode); exit nonzero on any failed check")
+    parser.add_argument("--dir", default=None,
+                        help="scratch dir (default: a temp dir, kept "
+                             "for inspection)")
+    parser.add_argument("--campaign", type=int, default=None, metavar="N",
+                        help="run N seeded randomized fault sequences "
+                             "through the invariant checker")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--supervise", action="store_true",
+                        help="run a command under remediation restarts: "
+                             "--supervise --save DIR --devices N -- cmd...")
+    parser.add_argument("--save", default=None)
+    parser.add_argument("--devices", type=int, default=None)
+    parser.add_argument("--max-incarnations", type=int, default=8)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.supervise:
+        from apex_tpu.resilience.remediation.supervisor import supervise
+
+        if not args.save or not args.devices:
+            parser.error("--supervise needs --save and --devices")
+        command = [c for c in args.command if c != "--"]
+        if not command:
+            parser.error("--supervise needs a command after --")
+        report = supervise(
+            lambda n: [c.replace("{devices}", str(n)) for c in command],
+            args.save, args.devices,
+            max_incarnations=args.max_incarnations,
+        )
+        print(report.summary(), flush=True)
+        return report.final_exit_code
+
+    if args.campaign:
+        _ensure_cpu_mesh_env()
+        import json
+
+        from apex_tpu.resilience.remediation.campaign import run_campaign
+
+        workroot = args.dir or tempfile.mkdtemp(
+            prefix="apex_tpu_campaign_")
+        report = run_campaign(workroot, n_sequences=args.campaign,
+                              seed=args.seed, minimize=True)
+        print(json.dumps(
+            {k: v for k, v in report.items() if k != "reference_losses"},
+            indent=1), flush=True)
+        print(f"campaign: {report['passed']} passed, "
+              f"{report['failed']} failed", flush=True)
+        return int(ExitCode.OK if report["failed"] == 0
+                   else ExitCode.FAILURE)
+
+    return selftest(args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

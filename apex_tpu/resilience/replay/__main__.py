@@ -30,6 +30,8 @@ import os
 import sys
 import tempfile
 
+from apex_tpu.resilience.exit_codes import ExitCode
+
 
 def _ensure_cpu_mesh_env():
     """Force the 8-virtual-device CPU topology BEFORE jax initializes
@@ -247,9 +249,9 @@ def selftest(directory=None) -> int:
               flush=True)
         for f in failures:
             print(f"  - {f}", flush=True)
-        return 1
+        return int(ExitCode.FAILURE)
     print("replay selftest: all checks passed", flush=True)
-    return 0
+    return int(ExitCode.OK)
 
 
 def main(argv=None) -> int:
@@ -320,7 +322,8 @@ def main(argv=None) -> int:
             if router is not None:
                 for r in report.to_records():
                     router.emit(r)
-            return 0 if report.ok else 2
+            return int(ExitCode.OK if report.ok
+                       else ExitCode.REPLAY_DIVERGENCE)
 
         if not args.journal:
             parser.error("a journal path (or --selftest / --diff) is "
@@ -345,7 +348,7 @@ def main(argv=None) -> int:
                 rtol=args.rtol, router=router,
             )
             print(format_divergence(record), flush=True)
-            return 0
+            return int(ExitCode.OK)
 
         from apex_tpu.resilience.replay.replayer import (
             build_context, replay_segment,
@@ -360,7 +363,8 @@ def main(argv=None) -> int:
         if router is not None:
             for r in report.to_records():
                 router.emit(r)
-        return 0 if report.ok else 2
+        return int(ExitCode.OK if report.ok
+                       else ExitCode.REPLAY_DIVERGENCE)
     finally:
         if router is not None:
             from apex_tpu.monitor import goodput

@@ -202,11 +202,22 @@ class GPTReplayContext:
     """The reusable expensive half of a replay: the rebuilt training
     step (one compile), the state template (one init), and the corpus.
     The bisector reuses ONE context across all its probes — a fresh
-    build per probe would pay a fresh trace+compile each time."""
+    build per probe would pay a fresh trace+compile each time.
+
+    ``training=``/``lm=`` hand in a PREBUILT :class:`GPTTraining` and
+    dataset instead of rebuilding from the journal header — the
+    in-process callers that already hold the recording run's exact
+    step (the remediation canary inside the training process, the
+    chaos-campaign runner) replay through the very object that
+    recorded, so the rebuild, the numerics-flag re-application, and
+    the device-count check are all vacuous and skipped. The caller
+    vouches the objects match the journal; cross-process replay (the
+    CLI) must keep rebuilding from the header — identity by
+    construction is the whole bitwise claim there."""
 
     target_kind = "gpt"
 
-    def __init__(self, journal: Journal):
+    def __init__(self, journal: Journal, training=None, lm=None):
         self.journal = journal
         header = journal.header
         if header.get("target") != self.target_kind:
@@ -216,24 +227,30 @@ class GPTReplayContext:
                 f"targets rebuild from their config; use compare_journals "
                 f"for fingerprint-level cross-run diffs)"
             )
-        self.flags = determinism_guard(header)
-        self.cfg = GPTTargetConfig.from_json(header.get("config") or {})
-        import jax
+        if training is not None:
+            self.flags = None  # same process as the recorder: flags match
+            self.cfg = training.cfg
+            self.training = training
+        else:
+            self.flags = determinism_guard(header)
+            self.cfg = GPTTargetConfig.from_json(header.get("config") or {})
+            import jax
 
-        want = header.get("devices")
-        if want is not None and len(jax.devices()) != int(want):
-            raise ReplayError(
-                f"journal was recorded on {want} device(s), this process "
-                f"has {len(jax.devices())} — the data-parallel split (and "
-                f"therefore the computation) would differ; re-run with the "
-                f"recorded topology (the CLI forces it automatically for "
-                f"CPU journals via XLA_FLAGS="
-                f"--xla_force_host_platform_device_count={want})"
-            )
-        self.training = build_gpt_training(self.cfg)
+            want = header.get("devices")
+            if want is not None and len(jax.devices()) != int(want):
+                raise ReplayError(
+                    f"journal was recorded on {want} device(s), this process "
+                    f"has {len(jax.devices())} — the data-parallel split (and "
+                    f"therefore the computation) would differ; re-run with the "
+                    f"recorded topology (the CLI forces it automatically for "
+                    f"CPU journals via XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={want})"
+                )
+            self.training = build_gpt_training(self.cfg)
         self._template = None
         self._bag = None
-        self.lm = self._build_corpus(header.get("corpus") or {})
+        self.lm = (lm if lm is not None
+                   else self._build_corpus(header.get("corpus") or {}))
 
     def _build_corpus(self, corpus: dict):
         from apex_tpu.data import IndexedTokenDataset, LMDataset

@@ -41,7 +41,7 @@ import dataclasses
 import functools
 import os
 import tempfile
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -97,6 +97,13 @@ class GPTTargetConfig:
     skip_budget: int = 1
     rollback_budget: int = 2
     collect_layer_rms: bool = False
+    #: cap the mesh to the first N visible devices (None = all). The
+    #: in-process topology changes of the remediation selftest/campaign
+    #: build an 8-device and a 4-device training in ONE process (the
+    #: elastic-selftest sub-mesh trick, through parallel_state's
+    #: ``devices=``); cross-process runs keep None and size the world
+    #: with XLA_FLAGS instead.
+    max_devices: Optional[int] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -238,7 +245,9 @@ def build_gpt_training(cfg: GPTTargetConfig) -> GPTTraining:
     import optax
 
     mesh = parallel_state.initialize_model_parallel(
-        tensor_model_parallel_size=cfg.tp
+        tensor_model_parallel_size=cfg.tp,
+        devices=(None if cfg.max_devices is None
+                 else jax.devices()[: cfg.max_devices]),
     )
     dp = parallel_state.get_data_parallel_world_size()
     num_micro = cfg.global_batch // (cfg.micro_batch * dp)

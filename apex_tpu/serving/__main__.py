@@ -293,14 +293,16 @@ def selftest() -> int:
            "zero-grace drain deadline-evicts and reclaims")
 
     router.close()
+    from apex_tpu.resilience.exit_codes import ExitCode
+
     if failures:
         print(f"serving selftest: {len(failures)} check(s) FAILED:",
               flush=True)
         for f in failures:
             print(f"  - {f}", flush=True)
-        return 1
+        return int(ExitCode.FAILURE)
     print("serving selftest: all checks passed", flush=True)
-    return 0
+    return int(ExitCode.OK)
 
 
 def main(argv=None) -> int:

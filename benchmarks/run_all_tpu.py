@@ -635,7 +635,13 @@ def run_ckpt(deadline, out_path):
     is better — the sentinel gates it like every ``_s`` metric) with
     the fraction in the section record. The fraction is measured
     against a small host-bound step, so it is an UPPER bound — real
-    device steps are longer and the absolute cost is what transfers."""
+    device steps are longer and the absolute cost is what transfers.
+
+    And the remediation controller's decision latency (ISSUE 15,
+    ``remediation_decide_s``): one finding → canary verdict (stubbed —
+    the replay's own cost is journaled above) → quarantine decision →
+    persisted state, i.e. the host hot-path cost the self-healing layer
+    adds per detector finding."""
     import functools
     import shutil
     import tempfile
@@ -764,6 +770,46 @@ def run_ckpt(deadline, out_path):
                                 rec["replay_journal_overhead_frac"]})
         else:
             incomplete.append("journal")
+        if time.monotonic() < deadline:
+            # remediation decision latency (ISSUE 15): one full
+            # finding -> canary-verdict -> quarantine-decision ->
+            # persisted-state round trip of the controller, canary
+            # stubbed (the replay cost is the CANARY's own bench story
+            # above — this measures the machine around it, which runs
+            # once per detector finding on the host hot path). jax-free
+            # and sentinel-gated like every _s metric.
+            from apex_tpu.monitor.router import make_record
+            from apex_tpu.resilience.remediation import (
+                RemediationController, RemediationPolicy,
+            )
+
+            reps = 20
+            t0 = time.monotonic()
+            for i in range(reps):
+                rd = os.path.join(d, f"remediation-{i}")
+                os.makedirs(rd, exist_ok=True)
+                ctrl = RemediationController(
+                    policy=RemediationPolicy(),
+                    save_dir=rd, world_devices=n,
+                    canary_fn=lambda: {
+                        "ok": False, "clean_anchor": 1,
+                        "evidence": {"kind": "canary"},
+                    },
+                )
+                ctrl.observe(make_record(
+                    "fleet", i, check="corruption", flagged_host=1,
+                    field="loss", value=1.0, median=2.0))
+                assert ctrl.process(i) is not None
+            decide_s = (time.monotonic() - t0) / reps
+            rec["remediation_decide_s"] = round(decide_s, 6)
+            rec["measured_n"] += 1
+            emit(out_path, {"section": "ckpt_remediation", "ok": True,
+                            "completed": True,
+                            "metric": "remediation_decide_s",
+                            "value": rec["remediation_decide_s"],
+                            "unit": "s"})
+        else:
+            incomplete.append("remediation")
     finally:
         shutil.rmtree(d, ignore_errors=True)
     if incomplete:

@@ -193,6 +193,34 @@ def parse_args():
                    help="batches whose host-side load may fail (skipped "
                         "and logged, surfaced as data_skipped in metrics "
                         "records) before the run fails loudly")
+    # auto-remediation (apex_tpu.resilience.remediation;
+    # docs/resilience.md "Auto-remediation"): the policy-driven
+    # controller that turns detector findings into bounded recovery
+    # actions — canary-verified quarantine, probation, readmit,
+    # escalate-to-halt — with kind="remediation" records and the
+    # exit-code contract a supervisor restarts on
+    # (python -m apex_tpu.resilience.remediation --supervise)
+    p.add_argument("--remediate", action="store_true",
+                   help="arm the auto-remediation controller (requires "
+                        "--save: the persisted plan, the replay journal "
+                        "the canary re-executes, and the checkpoints "
+                        "quarantine falls back to all live there); the "
+                        "run exits 44 to request a restart (reduced "
+                        "topology / readmit / post-preemption rejoin) "
+                        "and 45 on escalate-to-halt")
+    p.add_argument("--remediation-probation", type=int, default=8,
+                   help="clean steps a quarantined/restarted incarnation "
+                        "must run before the case closes (readmit)")
+    p.add_argument("--remediation-max-restarts", type=int, default=4,
+                   help="controller-driven restarts before "
+                        "escalate-to-halt")
+    p.add_argument("--remediation-verify",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="canary-verify findings before any quarantine "
+                        "(--no-remediation-verify is the DELIBERATELY "
+                        "BROKEN policy the chaos campaign's "
+                        "false-positive pin exists to catch — drills "
+                        "only)")
     p.add_argument("--fleet-interval", type=int, default=None,
                    help="run the live fleet-health check (straggler "
                         "robust-z + cross-host replicated-value "
@@ -490,6 +518,46 @@ def main():
         # verified checkpoint it restored
         recorder.anchor(step0, init=(step0 == 0))
 
+    # auto-remediation (apex_tpu.resilience.remediation): detector
+    # records tap straight off the router (ControllerSink — fleet flags,
+    # watchdog stalls, the sentinel's skip/rollback/halt trail), the
+    # canary re-executes journaled segments through THIS process's own
+    # compiled step (zero extra builds), and decisions come back as exit
+    # codes the supervisor restarts on. Created after AutoResume/recorder
+    # so it can adopt the persisted plan (a quarantine entering
+    # probation, a supervisor-recorded incident exit).
+    controller = None
+    if args.remediate:
+        if not args.save:
+            raise SystemExit(
+                "--remediate requires --save: the persisted remediation "
+                "plan, the replay journal, and the quarantine fallback "
+                "checkpoints all live in the save directory"
+            )
+        from apex_tpu.resilience import remediation
+        canary = remediation.GPTCanary(
+            journal_path(args.save), args.save, training=training, lm=lm,
+            floor_step=step0,
+        ) if recorder is not None else None
+        # world_devices is the FULL topology (the controller contract:
+        # what a readmit restores, the ordinal space state.excluded is
+        # numbered in) — in a supervisor-relaunched reduced incarnation
+        # the visible devices are world minus the quarantined ordinals,
+        # so reconstruct the world from both
+        _rstate = remediation.RemediationState.load(args.save)
+        controller = remediation.RemediationController(
+            policy=remediation.RemediationPolicy(
+                probation_steps=args.remediation_probation,
+                max_restarts=args.remediation_max_restarts,
+                verify_before_quarantine=args.remediation_verify,
+            ),
+            router=router, save_dir=args.save,
+            world_devices=len(jax.devices()) + len(_rstate.excluded),
+            canary_fn=canary, state=_rstate, run_id=run_id,
+        )
+        router.add_sink(remediation.ControllerSink(controller))
+        controller.adopt_pending(step0)
+
     # hung-job defense (apex_tpu.resilience.health, docs/resilience.md
     # "Incident response"): warn -> forensic kind="incident" dump ->
     # (opt-in) coordinated self-termination. Created here, STARTED after
@@ -699,6 +767,7 @@ def main():
     mgr.buffer.snapshot(step0, (params, opt_state, scaler_state, sent_state))
     init_span.close()  # everything before the loop is init (or a nested
     # higher-priority phase: ckpt_restore from ar.restore above)
+    exit_code = 0
     steps_run = 0
     steps_since_emit = 0
     last_emit_t = time.perf_counter()
@@ -823,6 +892,14 @@ def main():
                 # the journaled trajectory ends here (the replayer
                 # refuses to replay across a halt)
                 recorder.event(step_i, "halt", good_step=good_step)
+            if controller is not None:
+                # the halt record (via ControllerSink) opened an
+                # escalation case; its terminal verdict + the
+                # REMEDIATION_HALT code tell the supervisor NOT to
+                # restart a fault the ladder already failed to heal
+                decision = controller.process(step_i)
+                if decision is not None:
+                    exit_code = decision.exit_code
             print(f"halting at step {step_i}: anomaly persisted; "
                   f"checkpointed known-good step {good_step}")
             break
@@ -845,6 +922,11 @@ def main():
                   f"(loss {loss_f:.4f})")
         else:
             mgr.observe_good(step_i + 1, state)
+        if controller is not None and verdict_code == 0:
+            # probation / observation counters: a clean verdict-OK step
+            # advances every open case toward its closure (readmit /
+            # recover)
+            controller.on_clean_step(step_i)
         if step_i % args.log_interval == 0 or step_i == args.steps - 1:
             # ONE device-to-host metrics fetch per interval (the packed
             # MetricBag vector); everything else in the record is host math
@@ -869,6 +951,11 @@ def main():
                 # MetricBag-adjacent HOST metric: batches lost to the
                 # bounded skip-and-log loader this run (data/robust.py)
                 data_skipped=batches.skipped,
+                # remediation gauges (probation steps left, open cases);
+                # both in CsvSink.TOLERATED_EXTRA_KEYS so frozen-header
+                # CSV resumes survive the schema growth
+                **(controller.metrics_fields()
+                   if controller is not None else {}),
             )
             # interval-mean step timer as a kind='timer' record; reset=True
             # (the write-parity fix) so each write covers ITS interval only
@@ -905,7 +992,54 @@ def main():
                 # a checkpoint that was not committed
                 print(f"termination at step {step_i + 1}: "
                       f"{ar.termination_decision} (grace budget); exiting")
+            if controller is not None:
+                # under a supervisor a preemption is a RESTART, not an
+                # ending: persist the case, exit 44, rejoin on relaunch
+                decision = controller.on_preemption(step_i)
+                exit_code = decision.exit_code
+                print(f"[remediation] {decision.reason} "
+                      f"(exit {decision.exit_code})")
             break
+        if controller is not None:
+            anchor_due = bool(
+                ar is not None and args.save_interval
+                and (step_i + 1) % args.save_interval == 0
+            )
+            # stand the dog down around the controller's own work (the
+            # halt-save idiom above): a canary replay is minutes of
+            # legitimate host time, and a watchdog that flags its own
+            # remediation layer as a stall would feed the controller a
+            # spurious case
+            fence = responder is not None and (
+                anchor_due or controller.has_pending
+            )
+            if fence:
+                responder.stop()
+            if anchor_due:
+                # a checkpoint anchor just landed: commit it (the canary
+                # can only audit VERIFIED anchors — at run end there is
+                # no next anchor to catch up on) and run the periodic
+                # canary audit; the replay cost books as
+                # phase="remediation" badput
+                ar.finalize()
+                controller.on_anchor(step_i + 1)
+            decision = controller.process(step_i)
+            if decision is None and fence:
+                responder.start()
+            if decision is not None:
+                # act on the controller's verdict: flush the durable
+                # state (the journal sidecar + any pending save) and
+                # hand the supervisor the exit code + new topology
+                if ar is not None:
+                    ar.finalize()
+                if recorder is not None:
+                    recorder.flush()
+                exit_code = decision.exit_code
+                print(f"[remediation] {decision.reason} "
+                      f"(exit {decision.exit_code}, "
+                      f"devices {decision.device_count}, "
+                      f"restore step {decision.restore_step})")
+                break
         # compile accounting LAST in the iteration, so every first-use
         # host-side compile (the interval path is warmed before the
         # loop; AutoResume's consensus reduce builds lazily on its first
@@ -919,6 +1053,18 @@ def main():
     if mgr.events:
         print(f"anomalies this run: {len(mgr.events)} "
               f"(rollbacks {mgr.rollbacks_used}, lr_scale {mgr.lr_scale:.3f})")
+    if controller is not None:
+        if exit_code == 0:
+            # the run completed: close the observation/probation cases
+            # that saw clean recovery (terminal kind="remediation"
+            # verdicts); anything left open persists for the next
+            # incarnation
+            controller.run_end(step_i)
+        closed = controller.state.history
+        if closed or controller.open_cases:
+            print(f"[remediation] {len(closed)} case(s) closed "
+                  f"({[(c['kind'], c['verdict']) for c in closed]}), "
+                  f"{len(controller.open_cases)} open")
     router.event(
         "summary", step_i, steps_run=steps_run, anomalies=len(mgr.events),
         rollbacks=mgr.rollbacks_used, lr_scale=mgr.lr_scale,
@@ -1000,7 +1146,12 @@ def main():
     print(report.summary(), flush=True)
     router.event("goodput", step_i, **report.fields())
     router.close()
+    # the remediation exit-code contract (resilience/exit_codes.py): 0
+    # done, 44 restart-me-with-the-persisted-plan, 45 escalated halt —
+    # what `python -m apex_tpu.resilience.remediation --supervise`
+    # branches on
+    return exit_code
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -56,6 +56,17 @@ def parse_args():
     p.add_argument("--metrics-jsonl", default=None,
                    help="write run/span/goodput (and any other) records "
                         "to this jsonl (apex_tpu.monitor schema)")
+    p.add_argument("--remediate", action="store_true",
+                   help="adopt persisted remediation cases "
+                        "(apex_tpu.resilience.remediation; requires "
+                        "--save): under a supervisor, an exit-43 "
+                        "incident kill leaves a pending case the next "
+                        "incarnation must own — this run adopts it, and "
+                        "a clean scan closes it with a terminal "
+                        "kind='remediation' verdict. The scan is ONE "
+                        "compiled call, so there is no mid-run canary "
+                        "here; the journal supports post-hoc --diff "
+                        "verification instead")
     p.add_argument("--run-deadline", type=float, default=None,
                    help="incident ladder over the compiled scan "
                         "(apex_tpu.resilience.health): the whole run is "
@@ -312,6 +323,25 @@ def main():
                 variables, opt_state, tokens, labels
             ).compile()
     init_span.close()
+    # auto-remediation adoption (docs/resilience.md "Auto-remediation"):
+    # the scan-shaped run cannot verify/quarantine mid-run (one compiled
+    # call), but it CAN own the cross-incarnation half of the loop — a
+    # supervisor-recorded incident exit becomes a case here, and the
+    # clean scan below closes it with a terminal verdict
+    controller = None
+    if args.remediate:
+        if not args.save:
+            raise SystemExit("--remediate requires --save (the persisted "
+                             "remediation plan lives there)")
+        from apex_tpu.resilience import remediation
+
+        controller = remediation.RemediationController(
+            policy=remediation.RemediationPolicy(probation_steps=1),
+            router=router, save_dir=args.save,
+            world_devices=len(jax.devices()), run_id=run_id,
+        )
+        controller.adopt_pending(step0)
+
     # hung-job defense over the scan (docs/resilience.md "Incident
     # response"): the run is ONE compiled call, so the responder guards
     # it as a unit — started after the compile (paid above), stopped on
@@ -346,6 +376,16 @@ def main():
     print(f"final loss {losses[-1]:.4f}; {args.steps} steps in {dt:.2f}s "
           f"on {jax.devices()[0].platform}")
     assert np.isfinite(losses).all()
+    if controller is not None:
+        # the scan landed with finite losses: the adopted incident
+        # case's probation is satisfied by the run as a unit
+        controller.on_clean_step(step0 + args.steps - 1)
+        left = controller.run_end(step0 + args.steps - 1)
+        closed = controller.state.history
+        if closed or left:
+            print(f"[remediation] {len(closed)} case(s) closed "
+                  f"({[(c['kind'], c['verdict']) for c in closed]}), "
+                  f"{len(left)} open")
 
     shutdown_span = goodput.begin_span("shutdown", step=args.steps)
     recorder = None

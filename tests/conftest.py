@@ -53,6 +53,9 @@ _SLOW_TESTS = {
     "test_elastic_selftest_gate",
     "test_replay_selftest_gate",
     "test_serving_selftest_gate",
+    "test_remediation_selftest_gate",
+    "test_remediation_campaign",
+    "test_gpt_remediation_acceptance_drill",
     "test_serving_wedged_decode_bundle",
     "test_serving_overload_drill",
     "test_cross_process_determinism",

@@ -1,0 +1,900 @@
+"""Auto-remediation (apex_tpu.resilience.remediation).
+
+Fast tier: the jax-free halves — the closed policy machine, persisted
+state + checkpoint quarantine, the controller with a STUBBED canary
+(including the LiveFleetMonitor -> controller hand-off: the seeded
+straggler flag a clean canary replay clears, the zero-MAD outlier, the
+<3-host refusal), the exit-code supervisor loop, and the campaign's
+fault drawing / bipartite invariant matching. Slow tier: the
+exit-nonzero selftest gate, the >=20-sequence seeded campaign, and the
+ACCEPTANCE bitflip+hang+SIGTERM drill against the real GPT target.
+"""
+
+import json
+import os
+
+import pytest
+
+from apex_tpu.resilience.exit_codes import (
+    ExitCode,
+    RESTARTABLE_EXIT_CODES,
+)
+
+
+# ---------------------------------------------------------------------------
+# policy machine (jax-free)
+
+
+class TestPolicy:
+    def test_advance_registered_edges(self):
+        from apex_tpu.resilience.remediation import advance
+
+        assert advance("detected", "verifying") == "verifying"
+        assert advance("verifying", "cleared") == "cleared"
+        assert advance("quarantined", "probation") == "probation"
+        assert advance("probation", "readmitted") == "readmitted"
+
+    def test_advance_refuses_unregistered(self):
+        from apex_tpu.resilience.remediation import advance
+
+        with pytest.raises(ValueError, match="unregistered"):
+            advance("detected", "readmitted")
+        with pytest.raises(ValueError, match="unknown"):
+            advance("nonsense", "cleared")
+
+    def test_terminal_states_absorb(self):
+        from apex_tpu.resilience.remediation import TERMINAL_STATES, advance
+
+        for state in TERMINAL_STATES:
+            with pytest.raises(ValueError):
+                advance(state, "detected")
+
+    def test_policy_validation(self):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        with pytest.raises(ValueError, match="probation_steps"):
+            RemediationPolicy(probation_steps=0)
+        with pytest.raises(ValueError, match="quarantine_fraction"):
+            RemediationPolicy(quarantine_fraction=1.0)
+        with pytest.raises(ValueError, match="unknown case kind"):
+            RemediationPolicy(responses={"warp_core": "verify"})
+        with pytest.raises(ValueError, match="unregistered response"):
+            RemediationPolicy(responses={"straggler": "improvise"})
+
+    def test_response_table_defaults(self):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        p = RemediationPolicy(responses={"straggler": "observe"})
+        assert p.response_for("straggler") == "observe"
+        # kinds the custom table omits fall back to the default table
+        assert p.response_for("sdc") == "quarantine"
+        assert p.response_for("halt") == "escalate"
+
+
+# ---------------------------------------------------------------------------
+# exit-code taxonomy (satellite: the ONE home)
+
+
+class TestExitCodes:
+    def test_taxonomy_pins(self):
+        assert int(ExitCode.OK) == 0
+        assert int(ExitCode.FAILURE) == 1
+        assert int(ExitCode.REPLAY_DIVERGENCE) == 2
+        assert int(ExitCode.INCIDENT) == 43
+        assert int(ExitCode.REMEDIATION_RESTART) == 44
+        assert int(ExitCode.REMEDIATION_HALT) == 45
+        assert RESTARTABLE_EXIT_CODES == {
+            ExitCode.INCIDENT, ExitCode.REMEDIATION_RESTART,
+        }
+
+    def test_responder_imports_the_taxonomy(self):
+        # the historical import surface must alias the one home, not
+        # restate the magic number
+        from apex_tpu.resilience.health.responder import INCIDENT_EXIT_CODE
+
+        assert INCIDENT_EXIT_CODE == int(ExitCode.INCIDENT)
+
+
+# ---------------------------------------------------------------------------
+# persisted state + checkpoint quarantine (jax-free)
+
+
+class TestState:
+    def test_save_load_round_trip(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationState
+
+        s = RemediationState.load(str(tmp_path))
+        s.excluded = [4, 5, 6, 7]
+        s.restarts = 2
+        s.cases = [{"id": "case-1", "kind": "sdc", "state": "quarantined"}]
+        s.save()
+        s2 = RemediationState.load(str(tmp_path))
+        assert s2.excluded == [4, 5, 6, 7]
+        assert s2.restarts == 2
+        assert s2.cases[0]["kind"] == "sdc"
+
+    def test_case_ids_unique_across_incarnations(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationState
+
+        s = RemediationState.load(str(tmp_path))
+        a = s.next_case_id()
+        s.save()
+        s2 = RemediationState.load(str(tmp_path))
+        assert s2.next_case_id() != a
+
+    def test_torn_state_file_is_loud(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationState, state_path,
+        )
+
+        with open(state_path(str(tmp_path)), "w") as f:
+            f.write('{"excluded": [4')
+        with pytest.raises(json.JSONDecodeError):
+            RemediationState.load(str(tmp_path))
+
+    def test_device_count_ignores_out_of_world_ordinals(self):
+        from apex_tpu.resilience.remediation import RemediationState
+
+        s = RemediationState(excluded=[2, 3, 12])
+        assert s.device_count(8) == 6
+        assert s.device_count(2) == 2
+
+    def test_quarantine_checkpoints_moves_and_preserves(self, tmp_path):
+        from apex_tpu.resilience.remediation import quarantine_checkpoints
+        from apex_tpu.utils.checkpoint import finalized_steps
+
+        for step in (2, 4, 6):
+            d = tmp_path / f"step_{step}"
+            d.mkdir()
+            (d / "payload.bin").write_bytes(b"x")
+        moved = quarantine_checkpoints(str(tmp_path), 2, "case-9")
+        assert moved == [4, 6]
+        # the restore walk falls back to the clean anchor automatically
+        assert finalized_steps(str(tmp_path)) == [2]
+        # rename, not delete: the corrupt bytes stay for forensics
+        kept = tmp_path / "quarantined-case-9" / "step_4" / "payload.bin"
+        assert kept.read_bytes() == b"x"
+
+
+# ---------------------------------------------------------------------------
+# the controller with a stubbed canary (jax-free)
+
+
+def _stub_canary_clean():
+    return {"ok": True, "audited": [[0, 2]],
+            "evidence": {"kind": "canary", "audited": [[0, 2]]}}
+
+
+def _stub_canary_confirm():
+    return {"ok": False, "clean_anchor": 2, "dirty_anchor": 4,
+            "evidence": {"kind": "canary", "clean_anchor": 2,
+                         "first_divergent_step": 3,
+                         "leaves": ["['blocks'][0]['w']"]}}
+
+
+def _straggler_record(step=6, host=2):
+    from apex_tpu.monitor.router import make_record
+
+    return make_record("fleet", step, check="straggler", flagged_host=host,
+                       median_step_s=9.9, z=11.0)
+
+
+class TestController:
+    def _controller(self, tmp_path=None, canary=None, policy=None,
+                    router=None, world=8):
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+
+        return RemediationController(
+            policy=policy or RemediationPolicy(),
+            router=router,
+            save_dir=str(tmp_path) if tmp_path is not None else None,
+            world_devices=world,
+            canary_fn=canary,
+        )
+
+    def test_straggler_cleared_by_clean_canary(self):
+        """The false-positive pin: a straggler flag whose canary replay
+        clears must produce a verdict="cleared" record and NO restart."""
+        ctrl = self._controller(canary=_stub_canary_clean)
+        case = ctrl.observe(_straggler_record())
+        assert case is not None and case["kind"] == "straggler"
+        decision = ctrl.process(6)
+        assert decision is None                 # zero restarts
+        assert ctrl.state.restarts == 0
+        assert not ctrl.open_cases and not ctrl.state.excluded
+        terminal = [r for r in ctrl.records if r.get("terminal")]
+        assert len(terminal) == 1
+        assert terminal[0]["verdict"] == "cleared"
+        assert terminal[0]["finding"] == "straggler"
+        assert terminal[0]["suspect"] == 2
+        # the triggering detector record rode along as evidence
+        assert terminal[0]["evidence"][0]["check"] == "straggler"
+
+    def test_confirmed_canary_quarantines(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationState
+
+        ctrl = self._controller(tmp_path, canary=_stub_canary_confirm)
+        ctrl.observe(_straggler_record())
+        decision = ctrl.process(6)
+        assert decision is not None
+        assert decision.action == "restart"
+        assert decision.exit_code == int(ExitCode.REMEDIATION_RESTART)
+        assert decision.device_count == 4       # 8 -> 4, the upper half
+        assert decision.restore_step == 2       # the canary's clean anchor
+        # the plan survives the process: the next incarnation reads it
+        persisted = RemediationState.load(str(tmp_path))
+        assert persisted.excluded == [4, 5, 6, 7]
+        assert persisted.restarts == 1
+        assert persisted.cases and persisted.cases[0]["state"] == "quarantined"
+        quarantine = [r for r in ctrl.records
+                      if r.get("action") == "quarantine"]
+        assert quarantine[0]["excluded"] == [4, 5, 6, 7]
+        # the confirming verify record is in the SAME case's trail (what
+        # the campaign's false-positive invariant checks for)
+        verify = [r for r in ctrl.records if r.get("action") == "verify"]
+        assert verify and verify[0]["verdict"] == "confirmed"
+        assert verify[0]["case"] == quarantine[0]["case"]
+
+    def test_probation_readmits_after_clean_steps(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationPolicy, RemediationState,
+        )
+
+        policy = RemediationPolicy(probation_steps=2)
+        ctrl = self._controller(tmp_path, canary=_stub_canary_confirm,
+                                policy=policy)
+        ctrl.observe(_straggler_record())
+        assert ctrl.process(6) is not None      # the quarantine restart
+        # --- the reduced incarnation ---
+        ctrl2 = self._controller(tmp_path, policy=policy)
+        adopted = ctrl2.adopt_pending(7)
+        assert [c["state"] for c in adopted] == ["probation"]
+        ctrl2.on_clean_step(7)
+        assert ctrl2.poll() is None             # probation not served yet
+        ctrl2.on_clean_step(8)
+        decision = ctrl2.poll()
+        assert decision is not None and decision.action == "restart"
+        assert decision.device_count == 8       # readmit 4 -> 8
+        assert RemediationState.load(str(tmp_path)).excluded == []
+        terminal = [r for r in ctrl2.records if r.get("terminal")]
+        assert terminal and terminal[0]["verdict"] == "readmitted"
+
+    def test_no_canary_demotes_verify_to_observe(self):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        ctrl = self._controller(
+            canary=None, policy=RemediationPolicy(clean_steps_to_close=1),
+        )
+        ctrl.observe(_straggler_record())
+        assert ctrl.process(6) is None
+        assert [c["state"] for c in ctrl.open_cases] == ["observing"]
+        ctrl.on_clean_step(7)
+        terminal = [r for r in ctrl.records if r.get("terminal")]
+        assert terminal and terminal[0]["verdict"] == "recovered"
+
+    def test_raising_canary_demotes_not_quarantines(self):
+        def boom():
+            raise RuntimeError("journal unreadable")
+
+        ctrl = self._controller(canary=boom)
+        ctrl.observe(_straggler_record())
+        assert ctrl.process(6) is None          # no restart on a broken canary
+        assert [c["state"] for c in ctrl.open_cases] == ["observing"]
+        assert not ctrl.state.excluded
+
+    def test_skipped_canary_is_not_a_clearance(self):
+        """A canary with nothing sound to re-execute must not close the
+        case "cleared" — the vacuous pass the machine exists to refuse."""
+        ctrl = self._controller(
+            canary=lambda: {"ok": True, "skipped": True, "reason": "empty"},
+        )
+        ctrl.observe(_straggler_record())
+        assert ctrl.process(6) is None
+        assert [c["state"] for c in ctrl.open_cases] == ["observing"]
+        assert not any(r.get("verdict") == "cleared" for r in ctrl.records)
+
+    def test_repeat_flags_attach_not_fan_out(self):
+        ctrl = self._controller(canary=None)
+        for step in range(10):
+            ctrl.observe(_straggler_record(step=step))
+        assert len(ctrl.open_cases) == 1
+        case = ctrl.open_cases[0]
+        assert case["n_evidence"] == 10
+        assert len(case["evidence"]) <= 6       # capped verbatim, all counted
+        # a DIFFERENT suspect is a different case
+        ctrl.observe(_straggler_record(step=10, host=5))
+        assert len(ctrl.open_cases) == 2
+
+    def test_restart_budget_escalates_to_halt(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        ctrl = self._controller(
+            tmp_path, canary=_stub_canary_confirm,
+            policy=RemediationPolicy(max_restarts=0),
+        )
+        ctrl.observe(_straggler_record())
+        decision = ctrl.process(6)
+        assert decision is not None and decision.action == "halt"
+        assert decision.exit_code == int(ExitCode.REMEDIATION_HALT)
+        terminal = [r for r in ctrl.records if r.get("terminal")]
+        assert terminal and terminal[0]["verdict"] == "halted"
+
+    def test_second_quarantine_shrinks_the_remaining_topology(
+            self, tmp_path):
+        """A second confirmed corruption after an earlier quarantine
+        must exclude devices from the REMAINING ordinals (8->4->2), not
+        re-exclude the same upper half and relaunch the identical
+        topology while claiming action was taken."""
+        from apex_tpu.resilience.remediation import (
+            RemediationPolicy, RemediationState,
+        )
+
+        policy = RemediationPolicy(probation_steps=2, max_restarts=4)
+        ctrl = self._controller(tmp_path, canary=_stub_canary_confirm,
+                                policy=policy)
+        ctrl.observe(_straggler_record())
+        first = ctrl.process(6)
+        assert first.device_count == 4
+        # --- the reduced incarnation confirms ANOTHER corruption ---
+        ctrl2 = self._controller(tmp_path, canary=_stub_canary_confirm,
+                                 policy=policy)
+        ctrl2.adopt_pending(7)
+        ctrl2.observe(_straggler_record(step=8, host=1))
+        second = ctrl2.process(8)
+        assert second is not None and second.action == "restart"
+        assert second.device_count == 2          # 4 -> 2, NOT 4 again
+        assert RemediationState.load(str(tmp_path)).excluded == [2, 3, 4,
+                                                                 5, 6, 7]
+
+    def test_overlapping_readmit_lifts_only_its_own_devices(self, tmp_path):
+        """Two quarantine cases in probation at once (the 8->4->2 path):
+        the first case's readmit must lift ONLY the ordinals it
+        excluded — the second case's devices stay out until its own
+        probation completes."""
+        from apex_tpu.resilience.remediation import (
+            RemediationPolicy, RemediationState,
+        )
+
+        policy = RemediationPolicy(probation_steps=2, max_restarts=6)
+        ctrl = self._controller(tmp_path, canary=_stub_canary_confirm,
+                                policy=policy)
+        ctrl.observe(_straggler_record())
+        assert ctrl.process(6).device_count == 4        # excluded [4..7]
+        ctrl2 = self._controller(tmp_path, canary=_stub_canary_confirm,
+                                 policy=policy)
+        ctrl2.adopt_pending(7)
+        ctrl2.on_clean_step(7)                  # case-1 one step ahead
+        ctrl2.observe(_straggler_record(step=8, host=1))
+        assert ctrl2.process(8).device_count == 2       # + excluded [2,3]
+        # --- both cases in probation in the next incarnation ---
+        ctrl3 = self._controller(tmp_path, policy=policy)
+        ctrl3.adopt_pending(9)
+        ctrl3.on_clean_step(9)                  # case-1 completes first
+        first = ctrl3.poll()
+        assert first is not None and first.device_count == 6
+        assert RemediationState.load(str(tmp_path)).excluded == [2, 3]
+        ctrl3.on_clean_step(10)                 # now case-2 completes
+        second = ctrl3.poll()
+        assert second is not None and second.device_count == 8
+        assert RemediationState.load(str(tmp_path)).excluded == []
+
+    def test_supervisor_timeout_is_a_restartable_incident(self, tmp_path):
+        """A wedged incarnation killed by the supervisor's own timeout
+        must be recorded and treated as a restartable incident, not
+        crash the supervisor with TimeoutExpired."""
+        import sys
+
+        from apex_tpu.resilience.remediation import supervise
+
+        report = supervise(
+            lambda n: [sys.executable, "-c",
+                       "import time; time.sleep(30)"],
+            str(tmp_path), 8, max_incarnations=1, timeout_s=0.5,
+            env_for=lambda n: dict(os.environ),
+        )
+        assert report.outcome == "exhausted"
+        assert report.incarnations[0].exit_code == int(ExitCode.INCIDENT)
+
+    def test_min_devices_floor_escalates(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        ctrl = self._controller(
+            tmp_path, canary=_stub_canary_confirm,
+            policy=RemediationPolicy(min_devices=8),
+        )
+        ctrl.observe(_straggler_record())
+        decision = ctrl.process(6)
+        assert decision is not None and decision.action == "halt"
+        assert not ctrl.state.excluded          # no half-applied quarantine
+
+    def test_controller_sink_taps_the_router(self):
+        from apex_tpu.monitor import MemorySink, MetricRouter
+        from apex_tpu.resilience.remediation import ControllerSink
+
+        router = MetricRouter([MemorySink()])
+        ctrl = self._controller(canary=_stub_canary_clean, router=router)
+        router.add_sink(ControllerSink(ctrl))
+        router.event("fleet", 6, check="straggler", flagged_host=2,
+                     median_step_s=9.9, z=11.0)
+        router.event("metrics", 6, loss=1.0)    # not a detector kind
+        assert ctrl.process(6) is None
+        assert any(r.get("verdict") == "cleared" for r in ctrl.records)
+        # the remediation records ALSO went through the tapped router
+        # without deadlocking (the sink only enqueues)
+        router.close()
+
+    def test_summary_fleet_records_open_no_case(self):
+        from apex_tpu.monitor.router import make_record
+
+        ctrl = self._controller(canary=_stub_canary_clean)
+        assert ctrl.observe(make_record(
+            "fleet", 6, check="summary", ok=True, n_hosts=4,
+            stragglers=0, suspects=0)) is None
+        assert not ctrl.open_cases
+
+    def test_preemption_restart_and_recovery(self, tmp_path):
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        policy = RemediationPolicy(probation_steps=1)
+        ctrl = self._controller(tmp_path, policy=policy)
+        decision = ctrl.on_preemption(5)
+        assert decision.action == "restart"
+        assert decision.exit_code == int(ExitCode.REMEDIATION_RESTART)
+        assert decision.device_count == 8       # same topology
+        # --- the rejoined incarnation ---
+        ctrl2 = self._controller(tmp_path, policy=policy)
+        adopted = ctrl2.adopt_pending(5)
+        assert [c["kind"] for c in adopted] == ["preemption"]
+        ctrl2.on_clean_step(6)
+        terminal = [r for r in ctrl2.records if r.get("terminal")]
+        assert terminal and terminal[0]["verdict"] == "recovered"
+
+    def test_observing_case_survives_a_restart(self, tmp_path):
+        """The campaign-caught drop: a case mid-observation when an
+        UNRELATED restart ends the incarnation must be re-bound by the
+        next one and finish its clean-step closure — not vanish with no
+        terminal verdict."""
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        policy = RemediationPolicy(clean_steps_to_close=2)
+        ctrl = self._controller(tmp_path, canary=None, policy=policy)
+        ctrl.observe(_straggler_record())
+        ctrl.process(6)                         # demoted to observing
+        ctrl.on_anchor(6)                       # persists open cases
+        # --- the next incarnation (restarted for an unrelated reason) ---
+        ctrl2 = self._controller(tmp_path, policy=policy)
+        adopted = ctrl2.adopt_pending(7)
+        assert [c["state"] for c in adopted] == ["observing"]
+        ctrl2.on_clean_step(7)
+        ctrl2.on_clean_step(8)
+        terminal = [r for r in ctrl2.records if r.get("terminal")]
+        assert [t["verdict"] for t in terminal] == ["recovered"]
+        assert [t["finding"] for t in terminal] == ["straggler"]
+
+    def test_supervisor_pending_adopted_as_incident(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationPolicy, RemediationState,
+        )
+
+        s = RemediationState.load(str(tmp_path))
+        s.pending = {"kind": "incident", "exit_code": 43, "incarnation": 0}
+        s.save()
+        ctrl = self._controller(
+            tmp_path, policy=RemediationPolicy(probation_steps=1),
+        )
+        adopted = ctrl.adopt_pending(4)
+        assert [c["kind"] for c in adopted] == ["incident"]
+        # the incident restart already happened (we are it): it counts
+        # against the bounded budget
+        assert ctrl.state.restarts == 1
+        assert RemediationState.load(str(tmp_path)).pending is None
+
+    def test_canary_runs_inside_remediation_span(self):
+        from apex_tpu.monitor import MemorySink, MetricRouter
+        from apex_tpu.monitor import goodput
+
+        mem = MemorySink()
+        router = MetricRouter([mem])
+        prev = goodput.get_router()
+        goodput.set_router(router)
+        try:
+            ctrl = self._controller(canary=_stub_canary_clean,
+                                    router=router)
+            ctrl.observe(_straggler_record())
+            ctrl.process(6)
+        finally:
+            goodput.set_router(prev)
+            router.close()
+        spans = [r for r in mem.snapshot() if r.get("kind") == "span"
+                 and r.get("phase") == "remediation"]
+        assert spans                            # recovery time is badput
+
+    def test_metrics_fields_gauges_are_tolerated_keys(self):
+        from apex_tpu.monitor.router import CsvSink
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        ctrl = self._controller(
+            canary=None, policy=RemediationPolicy(probation_steps=3),
+        )
+        assert ctrl.metrics_fields() == {
+            "probation": 0, "remediation_cases": 0,
+        }
+        ctrl.on_preemption(5)                   # a case in probation
+        ctrl.on_clean_step(6)
+        fields = ctrl.metrics_fields()
+        assert fields == {"probation": 2, "remediation_cases": 1}
+        # frozen-header CSV resumes survive the schema growth
+        assert set(fields) <= CsvSink.TOLERATED_EXTRA_KEYS
+
+
+# ---------------------------------------------------------------------------
+# LiveFleetMonitor -> controller hand-off (satellite: edge cases)
+
+
+def _fleet_window(n_hosts, slow_host=None, n_steps=4):
+    """Per-host step spans: identical 0.1s except the slow host's 5s —
+    zero MAD by construction, so the outlier's robust z is inf."""
+    recs = []
+    for h in range(n_hosts):
+        for s in range(n_steps):
+            recs.append({"kind": "span", "phase": "step", "step": s,
+                         "host": h, "start": float(s),
+                         "dur_s": 5.0 if h == slow_host else 0.1})
+    return recs
+
+
+class TestFleetHandoff:
+    def test_zero_mad_straggler_flows_to_cleared(self):
+        """The seeded straggler flag a clean canary replay clears: one
+        case, verdict="cleared", zero restarts — through the REAL
+        monitor -> observe_fleet -> controller path."""
+        import math
+
+        from apex_tpu.monitor import MemorySink, MetricRouter
+        from apex_tpu.monitor.goodput import LiveFleetMonitor
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+
+        window = MemorySink()
+        for r in _fleet_window(4, slow_host=3):
+            window.emit(r)
+        router = MetricRouter([MemorySink()])
+        mon = LiveFleetMonitor(router, window, interval_steps=1)
+        assert mon.maybe_check(0) is None       # anchors the cadence
+        report = mon.maybe_check(1)
+        assert report is not None and not report.ok
+        # zero MAD: the deviation is infinitely many MADs out
+        assert math.isinf(report.stragglers[0]["z"])
+        ctrl = RemediationController(
+            policy=RemediationPolicy(), router=router, world_devices=8,
+            canary_fn=_stub_canary_clean,
+        )
+        touched = ctrl.observe_fleet(report, 1)
+        assert len(touched) == 1 and touched[0]["kind"] == "straggler"
+        assert ctrl.process(1) is None          # cleared, no restart
+        assert ctrl.state.restarts == 0
+        terminal = [r for r in ctrl.records if r.get("terminal")]
+        assert [t["verdict"] for t in terminal] == ["cleared"]
+        router.close()
+
+    def test_under_three_hosts_opens_nothing(self):
+        """<3 hosts: the straggler math refuses to name an outlier, the
+        report is ok, and the controller opens no case."""
+        from apex_tpu.monitor import MemorySink, MetricRouter
+        from apex_tpu.monitor.goodput import LiveFleetMonitor
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+
+        window = MemorySink()
+        for r in _fleet_window(2, slow_host=1):
+            window.emit(r)
+        router = MetricRouter([MemorySink()])
+        mon = LiveFleetMonitor(router, window, interval_steps=1)
+        mon.maybe_check(0)
+        report = mon.maybe_check(1)
+        assert report is not None and report.ok
+        ctrl = RemediationController(policy=RemediationPolicy(),
+                                     world_devices=8)
+        assert ctrl.observe_fleet(report, 1) == []
+        assert not ctrl.open_cases
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# the false-positive pin against the broken policy (jax-free)
+
+
+class TestBrokenPolicyPin:
+    def test_unverified_quarantine_is_caught(self, tmp_path):
+        """A policy that quarantines WITHOUT canary verification is the
+        deliberately broken table; the campaign's invariant checker
+        must flag its record shape."""
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+        from apex_tpu.resilience.remediation.campaign import (
+            FaultEvent, SequenceResult, check_invariants,
+        )
+
+        ctrl = RemediationController(
+            policy=RemediationPolicy(verify_before_quarantine=False),
+            save_dir=str(tmp_path), world_devices=8,
+        )
+        ctrl.observe(_straggler_record())
+        decision = ctrl.process(6)
+        assert decision is not None and decision.action == "restart"
+        fake = SequenceResult(
+            faults=[FaultEvent("slow", 6)], run_id="broken",
+            outcome="completed", incarnations=[], records=ctrl.records,
+            remediation=ctrl.records, losses={},
+        )
+        violations = check_invariants(fake)
+        assert any("WITHOUT canary verification" in v for v in violations)
+
+    def test_verified_quarantine_passes_the_same_check(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+        from apex_tpu.resilience.remediation.campaign import (
+            SequenceResult, _quarantine_verified,
+        )
+
+        ctrl = RemediationController(
+            policy=RemediationPolicy(), save_dir=str(tmp_path),
+            world_devices=8, canary_fn=_stub_canary_confirm,
+        )
+        ctrl.observe(_straggler_record())
+        ctrl.process(6)
+        fake = SequenceResult(
+            faults=[], run_id="ok", outcome="completed", incarnations=[],
+            records=ctrl.records, remediation=ctrl.records, losses={},
+        )
+        case = ctrl.records[0]["case"]
+        assert _quarantine_verified(fake, case)
+
+
+# ---------------------------------------------------------------------------
+# the supervisor (jax-free, injected runner)
+
+
+class TestSupervisor:
+    def test_restarts_on_44_stops_on_0(self, tmp_path):
+        from apex_tpu.resilience.remediation import supervise
+
+        codes = [int(ExitCode.REMEDIATION_RESTART), int(ExitCode.OK)]
+        argvs = []
+
+        def runner(argv, env):
+            argvs.append(list(argv))
+            return codes.pop(0)
+
+        report = supervise(lambda n: ["train", f"--devices={n}"],
+                           str(tmp_path), 8, runner=runner)
+        assert report.ok and report.outcome == "completed"
+        assert len(report.incarnations) == 2
+        assert report.final_exit_code == 0
+        assert argvs[0] == ["train", "--devices=8"]
+
+    def test_relaunch_honors_the_persisted_topology(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationState, supervise,
+        )
+
+        s = RemediationState.load(str(tmp_path))
+        s.excluded = [4, 5, 6, 7]
+        s.save()
+        seen = []
+
+        def runner(argv, env):
+            seen.append((list(argv), env.get("XLA_FLAGS")))
+            return int(ExitCode.OK)
+
+        report = supervise(lambda n: [f"--devices={n}"], str(tmp_path), 8,
+                           runner=runner)
+        assert report.ok
+        assert seen[0][0] == ["--devices=4"]
+        assert "device_count=4" in seen[0][1]
+        assert report.incarnations[0].device_count == 4
+
+    def test_halt_45_is_terminal(self, tmp_path):
+        from apex_tpu.resilience.remediation import supervise
+
+        report = supervise(
+            lambda n: ["x"], str(tmp_path), 8,
+            runner=lambda a, e: int(ExitCode.REMEDIATION_HALT),
+        )
+        assert report.outcome == "halted"
+        assert len(report.incarnations) == 1
+        assert report.final_exit_code == int(ExitCode.REMEDIATION_HALT)
+
+    def test_non_restartable_code_stops(self, tmp_path):
+        from apex_tpu.resilience.remediation import supervise
+
+        report = supervise(lambda n: ["x"], str(tmp_path), 8,
+                           runner=lambda a, e: 7)
+        assert report.outcome == "failed"
+        assert len(report.incarnations) == 1
+
+    def test_incarnation_budget_bounds_the_loop(self, tmp_path):
+        from apex_tpu.resilience.remediation import supervise
+
+        report = supervise(
+            lambda n: ["x"], str(tmp_path), 8, max_incarnations=3,
+            runner=lambda a, e: int(ExitCode.REMEDIATION_RESTART),
+        )
+        assert report.outcome == "exhausted"
+        assert len(report.incarnations) == 3
+
+    def test_incident_exit_writes_the_adoption_note(self, tmp_path):
+        from apex_tpu.resilience.remediation import (
+            RemediationState, supervise,
+        )
+
+        codes = [int(ExitCode.INCIDENT), int(ExitCode.REMEDIATION_HALT)]
+        pending_seen = []
+
+        def runner(argv, env):
+            pending_seen.append(
+                RemediationState.load(str(tmp_path)).pending
+            )
+            return codes.pop(0)
+
+        supervise(lambda n: ["x"], str(tmp_path), 8, runner=runner)
+        # the note did not exist for the first launch, and the SECOND
+        # incarnation sees the supervisor-written incident evidence
+        assert pending_seen[0] is None
+        assert pending_seen[1] == {
+            "kind": "incident", "exit_code": int(ExitCode.INCIDENT),
+            "incarnation": 0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# campaign units (jax-free)
+
+
+class TestCampaignUnits:
+    def test_random_sequence_is_seed_deterministic(self):
+        from apex_tpu.resilience.remediation.campaign import random_sequence
+
+        assert random_sequence(17) == random_sequence(17)
+        assert any(random_sequence(s) != random_sequence(s + 1)
+                   for s in range(5))
+
+    def test_random_sequence_shape(self):
+        from apex_tpu.resilience.remediation.campaign import (
+            FAULT_KINDS, random_sequence,
+        )
+
+        for seed in range(40):
+            events = random_sequence(seed, steps=8, max_faults=3)
+            assert 1 <= len(events) <= 3
+            kinds = [e.kind for e in events]
+            steps = [e.step for e in events]
+            assert len(set(kinds)) == len(kinds)      # distinct kinds
+            assert len(set(steps)) == len(steps)      # distinct steps
+            assert all(k in FAULT_KINDS for k in kinds)
+            assert all(1 <= s <= 6 for s in steps)
+            if "bitflip" in kinds:
+                # the flip lands last so earlier faults' canary replays
+                # re-execute still-clean segments
+                assert max(events, key=lambda e: e.step).kind == "bitflip"
+
+    def test_fault_terminal_matching_is_exact(self):
+        from apex_tpu.resilience.remediation.campaign import (
+            FaultEvent, _match_faults,
+        )
+
+        faults = [FaultEvent("nan", 2), FaultEvent("slow", 4)]
+        assert _match_faults(faults, [
+            {"finding": "stall", "verdict": "cleared"},
+            {"finding": "sentinel", "verdict": "recovered"},
+        ])
+        # a missing terminal, an extra terminal, and a wrong verdict
+        # each break the bipartite match
+        assert not _match_faults(faults, [
+            {"finding": "sentinel", "verdict": "recovered"},
+        ])
+        assert not _match_faults(faults, [
+            {"finding": "stall", "verdict": "cleared"},
+            {"finding": "sentinel", "verdict": "recovered"},
+            {"finding": "sdc", "verdict": "readmitted"},
+        ])
+        assert not _match_faults(faults, [
+            {"finding": "sentinel", "verdict": "halted"},
+            {"finding": "stall", "verdict": "cleared"},
+        ])
+
+    def test_minimize_failing_shrinks_to_the_culprit(self):
+        from apex_tpu.resilience.remediation.campaign import (
+            FaultEvent, minimize_failing,
+        )
+
+        faults = [FaultEvent("nan", 2), FaultEvent("slow", 4),
+                  FaultEvent("sigterm", 6)]
+
+        def run_and_check(candidate):
+            # the failure needs exactly the (nan, sigterm) pair
+            kinds = {e.kind for e in candidate}
+            return (["boom"] if {"nan", "sigterm"} <= kinds else [])
+
+        minimal, violations = minimize_failing(faults, run_and_check)
+        assert {e.kind for e in minimal} == {"nan", "sigterm"}
+        assert violations == ["boom"]
+
+
+# ---------------------------------------------------------------------------
+# the gate + the campaign + the acceptance drill (slow tier)
+
+
+def test_remediation_selftest_gate(tmp_path):
+    """``python -m apex_tpu.resilience.remediation --selftest`` exits 0:
+    inject SDC -> canary detect+confirm -> quarantine 8->4 -> probation
+    -> readmit 4->8, the false-positive clear, the broken-policy catch,
+    the fleet edge cases, and the supervisor's exit-code contract."""
+    from apex_tpu.resilience.remediation.__main__ import main
+
+    assert main(["--selftest", "--dir", str(tmp_path)]) == 0
+
+
+def test_remediation_campaign(tmp_path):
+    """>= 20 seeded randomized fault sequences pass the invariant
+    checker (the acceptance criterion's campaign surface)."""
+    from apex_tpu.resilience.remediation.campaign import run_campaign
+
+    report = run_campaign(str(tmp_path), n_sequences=20, seed=0)
+    failing = [e for e in report["sequences"] if e["violations"]]
+    assert report["failed"] == 0, failing
+    assert report["passed"] == 20
+
+
+def test_gpt_remediation_acceptance_drill(tmp_path):
+    """The acceptance drill: bitflip + hang + SIGTERM in ONE run against
+    the GPT target completes with zero human intervention — quarantine
+    8->4 under the same run id, probation readmit 4->8, final loss
+    within 5e-2 of the uninterrupted reference, goodput partition
+    identity digit-for-digit across all incarnations, and every fault
+    mapped to exactly one terminal remediation verdict."""
+    from apex_tpu.data import IndexedTokenDataset, LMDataset
+    from apex_tpu.resilience.remediation.campaign import (
+        FaultEvent, TrainingCache, campaign_config, check_invariants,
+        run_sequence,
+    )
+    from apex_tpu.resilience.replay.targets import synthetic_corpus
+
+    cfg = campaign_config()
+    cache = TrainingCache(cfg)
+    prefix = synthetic_corpus(cfg.vocab, n_tokens=20_000)
+    lm = LMDataset(IndexedTokenDataset(prefix), seq_len=cfg.seq_len)
+    steps = 8
+
+    reference = run_sequence(
+        [], str(tmp_path / "reference"), cache, lm, prefix, steps=steps,
+    )
+    assert reference.outcome == "completed"
+    assert not reference.remediation
+
+    faults = [FaultEvent("sigterm", 2), FaultEvent("hang", 4),
+              FaultEvent("bitflip", 6)]
+    result = run_sequence(
+        faults, str(tmp_path / "drill"), cache, lm, prefix, steps=steps,
+    )
+    assert result.outcome == "completed", result.incarnations
+    violations = check_invariants(
+        result, reference_losses=reference.losses, final_step=steps - 1,
+    )
+    assert violations == [], violations
+    # quarantine reduced 8->4 and the readmit restored 8, all under the
+    # ONE run id (every incarnation's records carry it)
+    devices = [i["devices"] for i in result.incarnations]
+    assert 4 in devices and devices[0] == 8 and devices[-1] == 8
+    run_ids = {r.get("run_id") for r in result.records
+               if r.get("kind") == "run"}
+    assert run_ids == {result.run_id}
+    # exactly one terminal verdict per fault (the bipartite pin also ran
+    # inside check_invariants; restated here as the headline)
+    assert len(result.terminals) == len(faults)
