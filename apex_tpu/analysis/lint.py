@@ -112,6 +112,17 @@ Plus the new rules this framework exists to host:
   returns its documented code — and allowlisted (require_hit, with the
   reason) for the one deliberate hard-exit home,
   ``resilience/health/responder.py``'s coordinated self-termination.
+- ``lint.serving-clock`` — no bare ``time.monotonic()``/``time.time()``
+  calls in ``apex_tpu/serving/`` scheduling paths: the serving stack's
+  clock is INJECTED (``time_fn=`` on the engine and the fleet router) so
+  deadline math, drain budgets and failover detection are drivable by a
+  fake clock in tests and replayable in drills. A bare clock call
+  splits time into two sources — the injected one the tests control and
+  a hidden one they cannot — which is exactly how a deadline test goes
+  flaky. Referencing ``time.monotonic`` as a DEFAULT (``time_fn=
+  time.monotonic``) is the injection idiom and fine; ``perf_counter``
+  duration measurements (EMA timings) are fine; ``time.sleep`` is not a
+  clock read and fine.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -975,6 +986,74 @@ def nondeterminism(ctx: LintContext) -> Iterable[Finding]:
                     ),
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                     data={"call": f"random.{attr}"},
+                )
+
+
+#: the clock reads lint.serving-clock polices in serving/ (perf_counter
+#: is a duration probe, sleep is not a read — neither feeds deadline
+#: math, so neither is in this set)
+_SERVING_CLOCK_READS = frozenset({"monotonic", "time", "time_ns",
+                                  "monotonic_ns"})
+
+
+@lint_rule("lint.serving-clock", scopes=("apex_tpu/serving/",))
+def serving_clock(ctx: LintContext) -> Iterable[Finding]:
+    """Bare clock CALLS in serving scheduling paths (module docstring).
+
+    AST-based: flags ``time.monotonic()``/``time.time()`` (and the
+    ``_ns`` variants) called through the stdlib module name or its
+    conventional ``_time`` alias, plus ``from time import monotonic/
+    time`` imports that would hide those call sites behind bare names.
+    A bare ATTRIBUTE reference (``time_fn=time.monotonic`` — the
+    injection default idiom) is not a call and not flagged."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.serving-clock",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ImportFrom) and node.module == "time"):
+                for a in node.names:
+                    if a.name in _SERVING_CLOCK_READS:
+                        yield Finding(
+                            rule="lint.serving-clock",
+                            message=(
+                                f"'from time import {a.name}' hides bare "
+                                f"clock reads from review in serving code "
+                                f"— take the clock from the injected "
+                                f"``time_fn`` instead"
+                            ),
+                            site=f"{rel}:{node.lineno}",
+                            severity=SEV_ERROR,
+                            data={"import": a.name},
+                        )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _SERVING_CLOCK_READS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("time", "_time")
+            ):
+                yield Finding(
+                    rule="lint.serving-clock",
+                    message=(
+                        f"bare time.{func.attr}() in serving code — the "
+                        f"serving clock is INJECTED (time_fn= on "
+                        f"ServingEngine/FleetRouter) so deadlines, drain "
+                        f"budgets and failover detection are drivable by "
+                        f"a fake clock; read ``self.time_fn()`` (or "
+                        f"thread a ``now`` parameter) instead"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                    data={"call": f"time.{func.attr}"},
                 )
 
 

@@ -92,6 +92,15 @@ __all__ = [
 #:   the KV cache (apex_tpu.serving; productive, like ``step``)
 #: - ``decode``        — a serving decode tick: one token per in-flight
 #:   request through the batched KV-cache step (productive)
+#: - ``handoff``       — a fleet KV handoff: a request's cache blocks
+#:   moving between a prefill replica's pool and a decode replica's
+#:   (serving.fleet, docs/serving.md "Fleet"). Badput by definition —
+#:   no tokens move while blocks are in flight — and ledgered like a
+#:   collective (the HandoffLedger books both sides' bytes).
+#: - ``failover``      — the fleet router's failover envelope: a dead
+#:   replica detected and its in-flight requests re-dispatched. Outranks
+#:   the serving work phases the way ``remediation`` outranks ``step``:
+#:   automated recovery time is still recovery time.
 #: - ``drain``         — the graceful-drain window after a termination
 #:   notice: admission closed, in-flight requests finishing or being
 #:   deadline-evicted (docs/serving.md). Outranked by prefill/decode so
@@ -106,6 +115,8 @@ PHASES = (
     "step",
     "prefill",
     "decode",
+    "handoff",
+    "failover",
     "ckpt_save",
     "ckpt_restore",
     "rollback",
@@ -143,15 +154,23 @@ PRODUCTIVE_PHASES = ("step", "prefill", "decode")
 #: a re-executed step moves no NEW tokens — the whole envelope is
 #: recovery badput by definition, so the envelope must claim the wall
 #: time before the nested work phases can.
+#: ``failover`` sits with the recovery envelopes (below ``remediation``,
+#: above ``step``): a re-dispatch storm's wall time is recovery badput
+#: even where a survivor's decode span overlaps it.
+#: ``handoff`` sits just below the serving work phases: the block copy
+#: blocks the fleet loop, but a decode tick overlapping it (another
+#: replica's lane advancing) is still productive time.
 #: ``drain`` sits below the serving work phases (a drain window is an
 #: envelope: decode ticks inside it are still productive) but above
 #: ``init``/``shutdown`` so its exposed overhead is named, not generic.
 PHASE_PRIORITY = (
     "incident",
     "remediation",
+    "failover",
     "step",
     "prefill",
     "decode",
+    "handoff",
     "ckpt_save",
     "ckpt_restore",
     "rollback",

@@ -115,6 +115,16 @@ class CompileWatcher:
     def available(self) -> bool:
         return self._counter.available
 
+    def rebaseline(self) -> None:
+        """Swallow the compiles since the last ``on_step`` WITHOUT
+        flagging them: the counter is process-wide, so a compile burst
+        another component both owns and books (a serving fleet
+        compiling a scale-up replica's buckets, booked as that
+        replica's ``compile`` span) must not land on this watcher's
+        violation count. Deliberate and caller-audited — a rebaseline
+        without a booked span elsewhere is hiding a recompile."""
+        self._last = self._counter.snapshot()
+
     def on_step(self, step: int) -> Optional[dict]:
         """Account compiles since the previous call; returns the emitted
         record (also kept in ``records``) or None when nothing compiled."""

@@ -140,6 +140,11 @@ class FaultPlan:
     prompt) instead of their real payload.
     ``burst_steps``: load-generator pumps at which ``burst_n`` extra
     arrivals land at once (the burst-arrival overload shape).
+    ``kill_replica_steps``: fleet-router ticks at which a serving
+    replica is killed outright (heartbeats stop, in-flight KV vanishes
+    — the process-death shape the fleet's failover path must answer by
+    re-dispatching; serving.fleet, docs/serving.md "Fleet"). The
+    router picks the victim (the busiest live replica, deterministic).
     ``persistent``: re-arm faults on replay (halt-path testing) instead
     of the default fire-once behavior (recovery-path testing).
     """
@@ -153,6 +158,7 @@ class FaultPlan:
     abandon_requests: FrozenSet[int] = frozenset()
     malformed_requests: FrozenSet[int] = frozenset()
     burst_steps: FrozenSet[int] = frozenset()
+    kill_replica_steps: FrozenSet[int] = frozenset()
     slow_s: float = 0.0
     slow_decode_s: float = 0.0
     burst_n: int = 8
@@ -171,6 +177,7 @@ class FaultPlan:
         self.abandon_requests = parse_steps(self.abandon_requests)
         self.malformed_requests = parse_steps(self.malformed_requests)
         self.burst_steps = parse_steps(self.burst_steps)
+        self.kill_replica_steps = parse_steps(self.kill_replica_steps)
         self._fired_nan: Set[int] = set()
         self._fired_sigterm: Set[int] = set()
         self._fired_hang: Set[int] = set()
@@ -180,6 +187,7 @@ class FaultPlan:
         self._fired_abandon: Set[int] = set()
         self._fired_malformed: Set[int] = set()
         self._fired_burst: Set[int] = set()
+        self._fired_kill_replica: Set[int] = set()
 
     def _due(self, step: int, steps: FrozenSet[int], fired: Set[int]) -> bool:
         if step in steps and (self.persistent or step not in fired):
@@ -250,6 +258,19 @@ class FaultPlan:
             )
             return int(self.burst_n)
         return 0
+
+    def take_kill_replica(self, step: int) -> bool:
+        """True when a serving replica should be killed at fleet tick
+        ``step`` (the fleet router consumes this and kills its busiest
+        live replica — deterministic victim choice, seeded drills)."""
+        if self._due(int(step), self.kill_replica_steps,
+                     self._fired_kill_replica):
+            logger.warning(
+                "chaos: killing a serving replica at fleet tick %d",
+                int(step),
+            )
+            return True
+        return False
 
     def maybe_bitflip(self, step: int, tree, path_filter=None):
         """``(new_tree, info)`` with one bit flipped when scheduled for

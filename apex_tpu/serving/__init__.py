@@ -30,10 +30,14 @@ _EXPORTS = {
     "PoissonLoadGenerator": "loadgen",
     "LoadReport": "loadgen",
     "percentile": "loadgen",
+    # fleet (router/replica engine-touching; prefix/handoff/autoscaler
+    # jax-free — the fleet package applies the same split internally)
+    "FleetConfig": "fleet",
+    "FleetRouter": "fleet",
 }
 
 __all__ = sorted(_EXPORTS) + [
-    "engine", "kvcache", "lifecycle", "loadgen",
+    "engine", "fleet", "kvcache", "lifecycle", "loadgen",
 ]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)

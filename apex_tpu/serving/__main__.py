@@ -28,6 +28,16 @@ elastic and replay gates):
     terminal ``kind="request"`` record, the KV pool returns to fully
     free, and the goodput partition identity over the run's spans
     holds with ``==``.
+
+``--fleet`` runs the FLEET gate instead (docs/serving.md "Fleet"):
+three in-process replicas behind a :class:`FleetRouter`, a
+prefill/decode disaggregated pair proving token parity THROUGH a KV
+handoff with the ledger's byte audit matched, then a chaos replica kill
+mid-load — detection, re-dispatch, restart, probation close — plus an
+SLO-driven scale-up, with the same closure assertions fleet-wide:
+exactly one terminal record per global request id, zero steady-state
+compiles on every surviving replica, and the goodput partition identity
+exact over the shared stream.
 """
 
 import argparse
@@ -305,6 +315,215 @@ def selftest() -> int:
     return int(ExitCode.OK)
 
 
+def fleet_selftest() -> int:
+    _ensure_cpu_env()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.models.generate import generate
+    from apex_tpu.monitor import MemorySink, MetricRouter
+    from apex_tpu.monitor.goodput import account, run_header
+    from apex_tpu.resilience.chaos import FaultPlan
+    from apex_tpu.serving.engine import ServingConfig, ServingEngine
+    from apex_tpu.serving.fleet import FleetConfig, FleetRouter
+    from apex_tpu.serving.lifecycle import TERMINAL_STATES
+    from apex_tpu.transformer import TransformerConfig
+
+    failures = []
+    tcfg = TransformerConfig(
+        num_layers=2, hidden_size=32, num_attention_heads=4, vocab_size=61,
+        max_position_embeddings=64, hidden_dropout=0.0,
+        attention_dropout=0.0, position_embedding_type="rope",
+        compute_dtype=jnp.float32,
+    )
+    model = GPTModel(config=tcfg)
+    rng = np.random.RandomState(0)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    # references FIRST (the process-global compile watcher contract):
+    # greedy decode, so parity survives a mid-flight KV handoff —
+    # temperature 0 makes the KV bytes the WHOLE decode cursor
+    prompts = [rng.randint(0, 61, size=n).astype(np.int32)
+               for n in (9, 12)]
+    max_news = (5, 4)
+    refs = [
+        np.asarray(generate(model, variables, jnp.asarray(p)[None],
+                            max_new_tokens=m))[0, len(p):].tolist()
+        for p, m in zip(prompts, max_news)
+    ]
+    cfg = ServingConfig(
+        lanes=2, block_size=8, num_blocks=8, max_seq_len=32,
+        max_queue_depth=16, seed=0,
+    )
+
+    def factory_for(router):
+        def factory(name, incarnation):
+            return ServingEngine(model, variables, cfg, router=router)
+        return factory
+
+    def terminal_closure(mem, fleet):
+        records = mem.snapshot()
+        terminal = {}
+        for rec in records:
+            if rec.get("kind") == "request" and rec.get("terminal"):
+                terminal.setdefault(rec["id"], []).append(rec["state"])
+        ids_ok = set(terminal) == set(range(fleet._next_rid))
+        once_ok = all(len(v) == 1 and v[0] in TERMINAL_STATES
+                      for v in terminal.values())
+        return ids_ok and once_ok
+
+    # -- part A: disaggregated parity through a ledgered KV handoff ------
+    print("fleet selftest A: prefill/decode disaggregation", flush=True)
+    mem_a = MemorySink(kinds=("request", "run", "span", "fleet", "handoff"))
+    router_a = MetricRouter([mem_a])
+    run_header(router_a, "fleet-selftest-a")
+    fleet_a = FleetRouter(
+        factory_for(router_a),
+        FleetConfig(replicas=2, prefill_replicas=1),
+        router=router_a,
+    )
+    fleet_a.start()
+    reqs = [fleet_a.submit(p, max_new_tokens=m)
+            for p, m in zip(prompts, max_news)]
+    n = 0
+    while not fleet_a.idle and n < 100:
+        fleet_a.tick()
+        n += 1
+    _check(failures,
+           all(r.state == "completed" for r in reqs)
+           and all(r.tokens_out == ref for r, ref in zip(reqs, refs)),
+           "disaggregated decode == models.generate, through a handoff")
+    audit = fleet_a.ledger.audit()
+    _check(failures,
+           audit["matched"] and audit["handoffs"] >= 2
+           and audit["bytes_out"] > 0
+           and audit["bytes_in"] == audit["bytes_out"],
+           "handoff ledger matched: every byte out arrived, both booked")
+    _check(failures,
+           all(r.tags.get("replica") == "r1" for r in reqs),
+           "requests re-homed onto the decode replica")
+    fleet_a.drain(grace_s=5.0)
+    phases_a = {r.get("phase") for r in mem_a.snapshot()
+                if r.get("kind") == "span"}
+    _check(failures, "handoff" in phases_a,
+           "handoff booked as its own goodput phase")
+    _check(failures, terminal_closure(mem_a, fleet_a),
+           "part A: exactly one terminal record per global id")
+    _check(failures,
+           all(rep.engine.allocator.free_blocks == cfg.num_blocks
+               for rep in fleet_a.replicas),
+           "part A: every replica's KV pool fully free after drain")
+    router_a.close()
+
+    # -- part B: replica kill -> failover -> restart, plus a scale-up ----
+    print("fleet selftest B: chaos kill + failover + autoscale",
+          flush=True)
+    mem_b = MemorySink(kinds=("request", "run", "span", "fleet", "handoff"))
+    router_b = MetricRouter([mem_b])
+    run_header(router_b, "fleet-selftest-b")
+    plan = FaultPlan(kill_replica_steps={4})
+    fleet_b = FleetRouter(
+        factory_for(router_b),
+        FleetConfig(
+            replicas=3, miss_ticks_to_detect=2,
+            # the autoscaler's budget, NOT the engines' (admission never
+            # sheds here): micro-budget so the armed estimate breaches
+            # immediately and the scale-up provably fires under load
+            ttft_budget_s=1e-4, breach_ticks=2,
+            min_replicas=1, max_replicas=4,
+        ),
+        router=router_b, fault_plan=plan,
+    )
+    fleet_b.start()
+    load = []
+    for i in range(10):
+        p = prompts[i % 2]
+        m = max_news[i % 2]
+        load.append(fleet_b.submit(p, max_new_tokens=m))
+    n = 0
+    while not fleet_b.idle and n < 400:
+        fleet_b.tick()
+        n += 1
+    for _ in range(10):  # probation needs clean ticks past idle
+        fleet_b.tick()
+    fleet_records = [r for r in mem_b.snapshot()
+                     if r.get("kind") == "fleet"]
+    actions = {(r.get("check"), r.get("action")) for r in fleet_records}
+    _check(failures, ("chaos", "kill_replica") in actions,
+           "chaos kill fired mid-load")
+    _check(failures,
+           ("replica", "detected") in actions
+           and any(r.get("check") == "failover" for r in fleet_records),
+           "missed heartbeats opened a case and ran failover")
+    _check(failures,
+           ("replica", "restarted") in actions
+           and ("replica", "readmitted") in actions,
+           "killed replica restarted and closed its case via probation")
+    _check(failures,
+           all(r.healthy for r in fleet_b.replicas),
+           "every replica healthy after recovery")
+    req_records = [r for r in mem_b.snapshot()
+                   if r.get("kind") == "request"]
+    _check(failures,
+           any(r.get("attempt", 1) > 1 for r in req_records),
+           "orphaned in-flight requests re-dispatched (attempt > 1)")
+    # NB: fleet.requests(), not the submit-time objects — a re-dispatched
+    # request terminates on its LATEST attempt's Request
+    _check(failures,
+           all(r.state == "completed" for r in fleet_b.requests())
+           and len(fleet_b.requests()) == len(load),
+           "every request completed despite the kill")
+    _check(failures,
+           any(r.get("prefix_hit_tokens", 0) > 0 for r in req_records)
+           and fleet_b.prefix.stats()["hits"] > 0,
+           "prefix-cache hits emitted on request records")
+    _check(failures,
+           ("autoscale", "scale_up") in actions
+           and ("autoscale", "added") in actions,
+           "SLO breach scaled the fleet up")
+    _check(failures,
+           sum(rep.engine.steady_state_compiles
+               for rep in fleet_b.replicas) == 0,
+           "zero steady-state compiles on every replica "
+           "(restart + scale-up bursts booked, not charged)")
+    report = fleet_b.drain(grace_s=5.0)
+    _check(failures,
+           fleet_b.drain()["redundant"] is True,
+           "second fleet drain is redundant, not an exception")
+    del report
+    _check(failures, terminal_closure(mem_b, fleet_b),
+           "part B: exactly one terminal record per global id, "
+           "through kill and failover")
+    phases_b = {r.get("phase") for r in mem_b.snapshot()
+                if r.get("kind") == "span"}
+    _check(failures, "failover" in phases_b,
+           "failover booked as its own goodput phase")
+    rep_acct = account(mem_b.snapshot())
+    lhs = rep_acct.productive_s
+    for phase in sorted(rep_acct.badput_s):
+        lhs = lhs + rep_acct.badput_s[phase]
+    _check(failures,
+           lhs + rep_acct.unattributed_s == rep_acct.wall_s
+           and rep_acct.productive_s > 0.0,
+           "fleet-wide goodput partition identity holds digit-for-digit")
+    router_b.close()
+
+    from apex_tpu.resilience.exit_codes import ExitCode
+
+    if failures:
+        print(f"fleet selftest: {len(failures)} check(s) FAILED:",
+              flush=True)
+        for f in failures:
+            print(f"  - {f}", flush=True)
+        return int(ExitCode.FAILURE)
+    print("fleet selftest: all checks passed", flush=True)
+    return int(ExitCode.OK)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m apex_tpu.serving",
@@ -313,9 +532,15 @@ def main(argv=None) -> int:
                     "target with zero post-warmup recompiles asserted",
     )
     parser.add_argument("--selftest", action="store_true",
-                        help="run the self-test (the default and only mode)")
+                        help="run the self-test (the default mode)")
+    parser.add_argument("--fleet", action="store_true",
+                        help="run the FLEET gate instead: 3 in-process "
+                             "replicas, KV handoff parity, a chaos "
+                             "replica kill with failover, and an "
+                             "SLO-driven scale-up")
     args = parser.parse_args(argv)
-    del args.selftest  # the only mode
+    if args.fleet:
+        return fleet_selftest()
     return selftest()
 
 

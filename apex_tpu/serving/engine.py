@@ -209,6 +209,7 @@ class ServingEngine:
         self._next_rid = 0
         self._tick = 0
         self._draining = False
+        self._drain_report: Optional[dict] = None
         self._started = False
         self._prefill_ema: Optional[float] = None
         self._decode_ema: Optional[float] = None
@@ -503,14 +504,27 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               rid: Optional[int] = None,
+               tags: Optional[dict] = None) -> Request:
         """Admission control at the door (module docstring): the request
         is QUEUED, or REJECTED with a booked reason — this method never
-        raises on bad input and never buffers beyond the bounds."""
+        raises on bad input and never buffers beyond the bounds.
+
+        ``rid`` lets a fleet router supply a GLOBALLY unique request id
+        (the stream's closure assertion keys on ``id``, so engine-local
+        counters would collide across replicas); ``tags`` are merged
+        into every record the request emits (lifecycle.Request.tags —
+        replica placement, prefix-cache hit rate, re-dispatch attempt).
+        """
         self._ensure_started()
         now = self.time_fn()
-        rid = self._next_rid
-        self._next_rid += 1
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            rid = int(rid)
+            self._next_rid = max(self._next_rid, rid + 1)
         if deadline_s is None:
             deadline_s = self.config.default_deadline_s
         arr, n_new, temp, ddl, bad_reason, detail = (
@@ -519,6 +533,7 @@ class ServingEngine:
         req = Request(
             rid=rid, prompt=arr, max_new_tokens=max(n_new, 1),
             temperature=temp, deadline_s=ddl, submit_t=now,
+            tags=dict(tags) if tags else {},
         )
         self._requests[rid] = req
 
@@ -769,6 +784,113 @@ class ServingEngine:
                     and now > r.expires_at()]:
             self._release(req, TIMED_OUT, "deadline")
 
+    # -- fleet KV handoff (extract/adopt) -----------------------------------
+
+    def extract(self, rid: int) -> Optional[dict]:
+        """Remove a mid-decode request from this engine WITHOUT booking
+        a terminal state, returning a handoff payload ``adopt`` can
+        install on another replica (the fleet's prefill/decode
+        disaggregation; docs/serving.md "Fleet").
+
+        The payload carries the request object, its lane's decode
+        cursor (position, last sampled token) and the request's KV
+        block CONTENTS as host arrays — a pure device-to-host read, no
+        compiled ops, so the zero-recompile contract holds across a
+        handoff. Returns None unless ``rid`` is live in a decode lane
+        (queued/terminal requests have nothing to hand off). The lane
+        and blocks are reclaimed here; the request leaves this engine's
+        books entirely — its lifecycle continues on the adopter.
+        """
+        req = self._requests.get(rid)
+        if req is None or req.state != DECODE or req.lane is None:
+            return None
+        lane = req.lane
+        if self._active.get(lane) is not req:
+            return None
+        ids = list(req.blocks)
+        kv = {}
+        nbytes = 0
+        for k in self._pool:
+            host = np.array(np.asarray(self._pool[k])[ids])
+            kv[k] = host
+            nbytes += host.nbytes
+        payload = {
+            "request": req,
+            "position": int(self._positions[lane]),
+            "last_token": int(self._last_tok[lane]),
+            "kv": kv,
+            "n_blocks": len(ids),
+            "bytes": int(nbytes),
+        }
+        del self._active[lane]
+        self._lane_mask[lane] = False
+        self._tables[lane, :] = self.config.num_blocks
+        self._positions[lane] = 0
+        self._last_tok[lane] = 0
+        self._temps[lane] = 0.0
+        self.allocator.free(req.blocks)
+        req.lane, req.blocks = None, ()
+        del self._requests[rid]
+        return payload
+
+    def adopt(self, payload: dict) -> bool:
+        """Install an ``extract`` payload into a free lane of THIS
+        engine: allocate blocks, scatter the handed-off KV contents
+        into the pool (host round-trip + ``device_put`` — no compiled
+        ops, so no steady-state compile), and resume the decode cursor
+        exactly where the source left it. False when this engine cannot
+        take it (no free lane, pool short, rid already present, or a
+        mismatched pool geometry) — the caller then tries another
+        replica or re-queues; the request object is untouched on
+        refusal, so adoption is all-or-nothing like ``alloc``.
+
+        Greedy (temperature 0) decode resumes bit-identically — the KV
+        bytes are the whole cursor; sampled decode resumes on the
+        adopting lane's OWN rng stream (per-lane keys are engine
+        state, not request state).
+        """
+        self._ensure_started()
+        req: Request = payload["request"]
+        if req.rid in self._requests or req.state != DECODE:
+            return False
+        first = next(iter(payload["kv"].values()))
+        if (set(payload["kv"]) != set(self._pool)
+                or first.shape[1:] != next(
+                    iter(self._pool.values())).shape[1:]):
+            return False
+        lane = self._free_lane()
+        if lane is None:
+            return False
+        ids = self.allocator.alloc(payload["n_blocks"])
+        if ids is None:
+            return False
+        import jax
+
+        for k, blocks in payload["kv"].items():
+            host = np.array(np.asarray(self._pool[k]))
+            host[list(ids)] = blocks
+            self._pool[k] = jax.device_put(host)
+        req.lane, req.blocks = lane, ids
+        self._requests[req.rid] = req
+        self._active[lane] = req
+        self._tables[lane, :] = self.config.num_blocks
+        self._tables[lane, :len(ids)] = ids
+        self._positions[lane] = payload["position"]
+        self._last_tok[lane] = payload["last_token"]
+        self._temps[lane] = req.temperature
+        self._lane_mask[lane] = True
+        return True
+
+    def acknowledge_compiles(self) -> None:
+        """Re-anchor the compile watcher after a BOOKED external
+        compile burst: the jax compile counter is process-global, so a
+        fleet scale-up compiling a NEW replica's buckets in-process
+        would otherwise land on every SURVIVOR's violation counter.
+        The burst is booked as the new replica's own ``compile`` span;
+        only unbooked compiles are steady-state violations."""
+        if self._compile_watch is not None:
+            self._compile_watch.rebaseline()
+
     # -- drain --------------------------------------------------------------
 
     def drain(self, grace_s: Optional[float] = None,
@@ -782,8 +904,17 @@ class ServingEngine:
         is relative from now. With neither, the drain runs until the
         batch empties (deadlines on the requests themselves still
         apply). Returns a summary dict.
+
+        Re-entrant by contract: a SECOND drain call returns the first
+        drain's summary marked ``redundant=True`` — it never re-runs
+        the reject loop, re-opens a drain span, or raises (a fleet
+        scale-down and a SIGTERM racing to drain the same replica must
+        both get a closed answer). ``submit`` after drain likewise
+        sheds with a booked ``draining`` rejection, never an exception.
         """
         self._ensure_started()
+        if self._drain_report is not None:
+            return dict(self._drain_report, redundant=True)
         self._draining = True
         t0 = self.time_fn()
         if deadline is None and grace_s is not None:
@@ -817,6 +948,7 @@ class ServingEngine:
             "evicted": evicted,
             "timed_out": timed_out,
         }
+        self._drain_report = dict(out)
         logger.info(
             "drain complete in %.3fs: %d finished, %d deadline-evicted, "
             "%d timed out on their own deadlines",

@@ -37,7 +37,6 @@ and its records must be testable and auditable on a box with no jax.
 """
 
 import dataclasses
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
@@ -110,6 +109,10 @@ class Request:
     #: per-step next-token logits (host np arrays), populated only under
     #: the engine's ``collect_logits`` debug/test mode
     logits: Optional[List[Any]] = None
+    #: caller-owned routing metadata merged into EVERY record this
+    #: request emits (the fleet router stamps replica placement, the
+    #: prefix-cache hit rate, and the re-dispatch attempt here)
+    tags: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     @property
     def prompt_len(self) -> int:
@@ -147,6 +150,13 @@ def transition(req: Request, new_state: str, now: Optional[float] = None,
     — an engine bug must fail loudly at the transition, not surface as
     a request stuck in a state the accountants have no bucket for.
     Terminal states are absorbing: transitioning OUT of one raises.
+
+    ``now`` is the caller's injected clock reading (the engine passes
+    its ``time_fn()``; the ``lint.serving-clock`` rule forbids a bare
+    wall-clock fallback here — fleet chaos drills replay on virtual
+    time). With ``now=None`` the edge is walked but no timestamp is
+    stamped: ``admit_t``/``end_t`` stay as they were, and the record's
+    latency fields simply don't exist yet (None-not-fake-number).
     """
     if new_state not in STATES:
         raise ValueError(
@@ -164,14 +174,14 @@ def transition(req: Request, new_state: str, now: Optional[float] = None,
             f"illegal transition {req.state!r} -> {new_state!r} for "
             f"request {req.rid} (allowed: {sorted(allowed)})"
         )
-    now = time.monotonic() if now is None else now
     req.state = new_state
     if reason is not None:
         req.reason = reason
-    if new_state == ADMITTED:
-        req.admit_t = now
-    if new_state in TERMINAL_STATES:
-        req.end_t = now
+    if now is not None:
+        if new_state == ADMITTED:
+            req.admit_t = now
+        if new_state in TERMINAL_STATES:
+            req.end_t = now
     return req
 
 
@@ -193,6 +203,8 @@ def emit_request_record(router, tick: int, req: Request,
         "max_new": int(req.max_new_tokens),
         "tokens_out": len(req.tokens_out),
     }
+    if req.tags:
+        fields.update(req.tags)
     if req.queue_wait_s is not None:
         fields["queue_wait_s"] = float(req.queue_wait_s)
     if req.ttft_s is not None:

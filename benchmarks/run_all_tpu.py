@@ -1309,6 +1309,35 @@ def run_serving(deadline, out_path):
                         "completed": True, "metric": metric,
                         "value": value, "unit": unit,
                         "rate_rps": 20.0, "lanes": cfg.lanes})
+
+    # fleet resilience gate: the --fleet selftest (KV-handoff parity on a
+    # disaggregated pair, then a chaos replica kill with failover/restart
+    # and an SLO scale-up) as a CPU subprocess — it exercises the fleet
+    # ROUTER, not the chip, so it must not hold the relay while it runs
+    budget = max(5.0, deadline - time.monotonic())
+    if budget < 60.0:
+        rec.setdefault("incomplete", []).append("fleet_gate")
+        return rec
+    import subprocess
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "apex_tpu.serving", "--selftest",
+             "--fleet"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=min(budget, 600.0),
+        )
+        fleet_rc = proc.returncode
+        tail = (proc.stdout or "").splitlines()[-3:]
+    except subprocess.TimeoutExpired:
+        fleet_rc, tail = -1, ["timeout"]
+    rec["fleet_gate_rc"] = fleet_rc
+    rec["measured_n"] += 1
+    emit(out_path, {"section": "serving_fleet_gate", "ok": fleet_rc == 0,
+                    "completed": True, "rc": fleet_rc, "tail": tail})
     return rec
 
 

@@ -58,6 +58,9 @@ _SLOW_TESTS = {
     "test_gpt_remediation_acceptance_drill",
     "test_serving_wedged_decode_bundle",
     "test_serving_overload_drill",
+    "test_serving_cancel_and_drain_hardening",
+    "test_fleet_selftest_gate",
+    "test_fleet_chaos_drill",
     "test_cross_process_determinism",
     "test_gpt_replay_bitflip_drill",
     "test_gpt_elastic_chaos_drill",

@@ -614,6 +614,51 @@ class TestLintFramework:
         res = _ral().apply(fins, check_stale=False)
         assert res.ok
 
+    def test_serving_clock_seeded(self):
+        files = {
+            "apex_tpu/serving/fake.py":
+                "import time\n"
+                "import time as _time\n"
+                "from time import monotonic\n"
+                "a = time.time()\n"
+                "b = time.monotonic()\n"
+                "c = _time.monotonic_ns()\n",
+        }
+        fins = run_lint(rules=["lint.serving-clock"], files=files)
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/serving/fake.py:3", "apex_tpu/serving/fake.py:4",
+            "apex_tpu/serving/fake.py:5", "apex_tpu/serving/fake.py:6",
+        ]
+        assert {f.data.get("call") for f in fins if "call" in f.data} == {
+            "time.time", "time.monotonic", "time.monotonic_ns",
+        }
+
+    def test_serving_clock_injection_idiom_exempt(self):
+        # the injected-default REFERENCE is the idiom the rule protects;
+        # perf_counter is a duration probe and sleep is not a read —
+        # none of them feed deadline math off a hidden clock
+        files = {
+            "apex_tpu/serving/fake.py":
+                "import time\n"
+                "def f(time_fn=time.monotonic):\n"
+                "    now = time_fn()\n"
+                "    t0 = time.perf_counter()\n"
+                "    time.sleep(0.0)\n"
+                "    return now\n",
+        }
+        assert run_lint(rules=["lint.serving-clock"], files=files) == []
+        # scoped to apex_tpu/serving/ only: elsewhere bare clock reads
+        # are lint.nondeterminism's business, not this rule's
+        outside = {
+            "apex_tpu/utils/fake.py": "import time\nt = time.time()\n",
+        }
+        assert run_lint(rules=["lint.serving-clock"], files=outside) == []
+
+    def test_serving_clock_repo_scan_clean(self):
+        # the serving tree speaks injected-clock everywhere, with no
+        # allowlist entries needed
+        assert run_lint(rules=["lint.serving-clock"]) == []
+
     def test_registered_taps_seeded(self):
         files = {
             "apex_tpu/fake.py":

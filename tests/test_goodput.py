@@ -164,6 +164,7 @@ class TestAccountant:
             "ckpt_save": 0.5, "ckpt_restore": 2.0, "rollback": 0.0,
             "compile": 3.0, "data_wait": 0.0, "stall": 0.0,
             "incident": 0.0, "remediation": 0.0, "drain": 0.0,
+            "handoff": 0.0, "failover": 0.0,
             "init": 2.0, "shutdown": 0.0,
         }
         assert rep.unattributed_s == 0.0

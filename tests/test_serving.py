@@ -594,3 +594,104 @@ def test_serving_overload_drill(tmp_path):
     post_warmup = [r for r in records
                    if r.get("kind") == "compile" and r.get("recompile")]
     assert post_warmup == []
+
+
+def test_serving_cancel_and_drain_hardening():
+    """ISSUE 16 satellites: cancel() from every live state (queued,
+    prefill, decode) books exactly one terminal record and reclaims the
+    lane/blocks; a SECOND drain returns the first report marked
+    ``redundant=True`` and submit-after-drain sheds with a booked
+    ``draining`` rejection — records, never exceptions."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.transformer import TransformerConfig
+
+    tcfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4,
+        vocab_size=37, max_position_embeddings=0,
+        position_embedding_type="rope", hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    model = GPTModel(config=tcfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    mem = MemorySink()
+    router = MetricRouter([mem])
+    cfg = ServingConfig(lanes=2, block_size=8, num_blocks=8,
+                        max_seq_len=32, prefill_buckets=(8,), seed=0)
+    eng = ServingEngine(model, variables, cfg, router=router)
+    eng.start()
+    pool = cfg.num_blocks
+
+    # fill both lanes, then a third request has to WAIT in the queue
+    a = eng.submit(np.array([1, 2, 3], np.int32), max_new_tokens=8)
+    b = eng.submit(np.array([4, 5, 6], np.int32), max_new_tokens=8)
+    eng.tick()      # one admission per tick (max_prefills_per_tick=1)
+    eng.tick()
+    assert a.state == "decode" and b.state == "decode"
+    c = eng.submit(np.array([7, 8, 9], np.int32), max_new_tokens=8)
+    assert c.state == "queued"
+
+    # 1. cancel from QUEUED: never placed, so the pool is untouched
+    free_before = eng.allocator.free_blocks
+    assert eng.cancel(c.rid) is True
+    assert c.state == "cancelled" and c.reason == "client_cancel"
+    assert eng.allocator.free_blocks == free_before
+    assert eng.cancel(c.rid) is False     # terminal: cancel is a no-op
+
+    # 2. cancel from DECODE: the lane and its blocks come back
+    lane_a, blocks_a = a.lane, a.blocks
+    assert eng.cancel(a.rid) is True
+    assert a.state == "cancelled"
+    assert lane_a not in eng._active
+    assert eng.allocator.free_blocks == free_before + len(blocks_a)
+
+    # 3. cancel from PREFILL: the state is intra-tick (admission runs
+    # the prefill in the same tick), so build the mid-prefill shape the
+    # cancel path must handle — lane and blocks assigned, not yet in a
+    # decode lane — and cancel through the engine's one eviction path
+    free_mid = eng.allocator.free_blocks
+    req = lifecycle.Request(
+        rid=997, prompt=np.array([1, 2], np.int32), max_new_tokens=4,
+        submit_t=eng.time_fn(),
+    )
+    for state in ("queued", "admitted", "prefill"):
+        lifecycle.transition(req, state, now=eng.time_fn())
+    req.lane = eng._free_lane()
+    req.blocks = eng.allocator.alloc(2)
+    eng._requests[997] = req
+    assert eng.cancel(997) is True
+    assert req.state == "cancelled"
+    assert eng.allocator.free_blocks == free_mid
+
+    n = 0
+    while not eng.idle and n < 60:
+        eng.tick()
+        n += 1
+    assert b.state == "completed"
+    assert eng.allocator.free_blocks == pool
+
+    # 4. drain re-entrancy: the second call replays the first report
+    first = eng.drain(grace_s=5.0)
+    assert "redundant" not in first
+    second = eng.drain()
+    assert second["redundant"] is True
+    assert second["finished"] == first["finished"]
+    assert second["evicted"] == first["evicted"]
+
+    # 5. submit-after-drain: a booked rejection, never an exception
+    late = eng.submit(np.array([1, 2, 3], np.int32), max_new_tokens=4)
+    assert late.terminal and late.state == "rejected"
+    assert late.reason == "draining"
+    router.close()
+
+    # every id that ever appeared reached EXACTLY one terminal record
+    terminal = {}
+    for r in mem.snapshot():
+        if r.get("kind") == "request" and r.get("terminal"):
+            terminal.setdefault(r["id"], []).append(r["state"])
+    assert set(terminal) == {a.rid, b.rid, c.rid, 997, late.rid}
+    assert all(len(v) == 1 for v in terminal.values())
