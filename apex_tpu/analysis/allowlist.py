@@ -471,6 +471,31 @@ _LINT = [
         require_hit=True,
     ),
     AllowlistEntry(
+        rule="lint.trace-emit",
+        match="apex_tpu/serving/trace/emit.py",
+        reason=(
+            "the ONE blessed kind=\"trace\" construction site: "
+            "TraceEmitter._emit is where every span record is built, so "
+            "span ids, parent links, attempt tags and the start/dur_s "
+            "schema stay consistent across engine, fleet and handoff "
+            "emitters — the lint.raw-collective/ledger.py contract, "
+            "applied to the request x-ray"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
+        rule="lint.trace-emit",
+        match="apex_tpu/serving/trace/slo.py",
+        reason=(
+            "the ONE blessed kind=\"slo\" construction site: "
+            "SLOMonitor.poll emits the burn-rate record after draining "
+            "its tap, so window/violations/burn_rate/alert fields are "
+            "computed in one place with the documented rolling-window "
+            "semantics"
+        ),
+        require_hit=True,
+    ),
+    AllowlistEntry(
         rule="lint.nondeterminism",
         match="apex_tpu/resilience/retry.py",
         reason=(

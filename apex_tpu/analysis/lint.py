@@ -123,6 +123,19 @@ Plus the new rules this framework exists to host:
   time.monotonic``) is the injection idiom and fine; ``perf_counter``
   duration measurements (EMA timings) are fine; ``time.sleep`` is not a
   clock read and fine.
+- ``lint.trace-emit`` — no ad-hoc construction of ``kind="trace"`` /
+  ``kind="slo"`` records outside the two blessed homes,
+  ``serving/trace/emit.py`` (the span schema:
+  trace/span/parent/phase/start/dur_s/attempt/site) and
+  ``serving/trace/slo.py`` (the burn-rate row). The offline analyzer
+  rebuilds causal trees and re-adds a digit-exact partition identity
+  from those records — a second construction site would fork the
+  schema, and the fork's spans would silently fail tree completeness
+  or corrupt the partition. Flags ``event(...)``/``make_record(...)``
+  calls whose kind is the literal ``"trace"``/``"slo"`` (positional or
+  ``kind=``) and dict literals carrying ``"kind": "trace"/"slo"``;
+  READING the kinds (comparisons, sink filters) is fine and not
+  flagged. The homes carry require_hit allowlist entries.
 - ``lint.span-phases`` — every goodput span call site
   (``span``/``begin_span``/``Span``/``emit_span`` and their import
   aliases) must name its phase with literals from the CLOSED registry
@@ -1055,6 +1068,90 @@ def serving_clock(ctx: LintContext) -> Iterable[Finding]:
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                     data={"call": f"time.{func.attr}"},
                 )
+
+
+#: the record kinds whose CONSTRUCTION is fenced to the trace package
+#: (emit.py builds "trace", slo.py builds "slo"); the analyzer's derived
+#: offline kind "trace_decomp" is not a span and deliberately not fenced
+_FENCED_TRACE_KINDS = frozenset({"trace", "slo"})
+
+#: record-constructor callee names lint.trace-emit inspects (the shared
+#: schema's two mouths: MetricRouter.event and make_record)
+_RECORD_CONSTRUCTORS = frozenset({"event", "make_record"})
+
+
+@lint_rule("lint.trace-emit", scopes=("apex_tpu/", "examples/"))
+def trace_emit(ctx: LintContext) -> Iterable[Finding]:
+    """Ad-hoc ``kind="trace"``/``"slo"`` record construction outside the
+    blessed trace-package homes (module docstring). AST-based: flags
+    ``event``/``make_record`` calls whose kind argument (first
+    positional, or ``kind=``) is one of the fenced literals, and dict
+    literals whose ``"kind"`` key maps to one — both are records
+    entering the stream; comparisons and sink filters merely read."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.trace-emit",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = (
+                    func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else None
+                )
+                if name not in _RECORD_CONSTRUCTORS:
+                    continue
+                kind = None
+                if node.args:
+                    a0 = node.args[0]
+                    if (isinstance(a0, ast.Constant)
+                            and isinstance(a0.value, str)):
+                        kind = a0.value
+                for kw in node.keywords:
+                    if (kw.arg == "kind"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        kind = kw.value.value
+                if kind in _FENCED_TRACE_KINDS:
+                    yield Finding(
+                        rule="lint.trace-emit",
+                        message=(
+                            f'{name}(kind="{kind}") outside the blessed '
+                            f"home — {kind!r} records have ONE "
+                            f"construction site (serving/trace/"
+                            f"{'emit' if kind == 'trace' else 'slo'}.py) "
+                            f"so the span schema the critical-path "
+                            f"analyzer re-adds its identity from cannot "
+                            f"fork; route through TraceEmitter/SLOMonitor"
+                        ),
+                        site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                        data={"kind": kind, "callee": name},
+                    )
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "kind"
+                            and isinstance(v, ast.Constant)
+                            and v.value in _FENCED_TRACE_KINDS):
+                        yield Finding(
+                            rule="lint.trace-emit",
+                            message=(
+                                f'hand-built record dict with "kind": '
+                                f'"{v.value}" — trace/slo records have '
+                                f"ONE construction site (serving/trace/) "
+                                f"so their schema cannot fork; route "
+                                f"through TraceEmitter/SLOMonitor"
+                            ),
+                            site=f"{rel}:{node.lineno}",
+                            severity=SEV_ERROR,
+                            data={"kind": v.value, "form": "dict"},
+                        )
 
 
 @lint_rule("lint.float64", scopes=("apex_tpu/",))

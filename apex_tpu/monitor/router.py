@@ -26,7 +26,13 @@ segment's comparison outcome; "divergence" — the bisector's forensic
 verdict, all from apex_tpu.resilience.replay), the serving kind
 ("request" — one record per request-lifecycle transition from the
 apex_tpu.serving scheduler: queued/admitted/prefill/decode plus the
-terminal states, docs/serving.md), and the remediation kind
+terminal states, docs/serving.md), the request-x-ray kinds ("trace" —
+one causal span per wall-clock segment a request occupies, the global
+request id as trace id, emitted only by apex_tpu.serving.trace.emit;
+"slo" — rolling error-budget burn-rate rows from the SLO monitor,
+apex_tpu.serving.trace.slo; "trace_decomp" — the offline analyzer's
+per-request critical-path partition, ``python -m
+apex_tpu.serving.trace --json``), and the remediation kind
 ("remediation" — one record per auto-remediation case transition from
 apex_tpu.resilience.remediation: detect/verify/quarantine/probation/
 readmit/escalate with the triggering detector records attached as
@@ -207,9 +213,14 @@ class CsvSink(Sink):
     #: the wild froze their headers, exactly like "host" before it —
     #: and "probation"/"remediation_cases" (the auto-remediation
     #: controller's per-interval gauges, resilience.remediation) after
-    #: that, for the same frozen-header-resume reason.
+    #: that, for the same frozen-header-resume reason — and the serving
+    #: fleet's request-record tags "redispatch_t" (the re-attempt's
+    #: local enqueue instant) and "recovery_s" (accumulated failover
+    #: envelope seconds), which joined with the request x-ray
+    #: (apex_tpu.serving.trace).
     TOLERATED_EXTRA_KEYS = frozenset({
         "host", "data_skipped", "probation", "remediation_cases",
+        "redispatch_t", "recovery_s",
     })
 
     def __init__(self, path: str, kinds=("metrics",)):
@@ -259,7 +270,11 @@ class StdoutSink(Sink):
     as is "request" (the serving scheduler's per-transition lifecycle
     records, apex_tpu.serving): a loaded server emits several per tick,
     and the console surface is the engine's summary line, not the
-    firehose. "remediation" (the auto-remediation controller,
+    firehose. "trace" (the request x-ray's causal spans,
+    apex_tpu.serving.trace) and "slo" (its burn-rate rows) are skipped
+    for the same per-tick-firehose reason — the jsonl stream is their
+    durable home and ``python -m apex_tpu.serving.trace`` their
+    console. "remediation" (the auto-remediation controller,
     resilience.remediation) is skipped for the incident reason: each
     record attaches its triggering evidence records wholesale, far too
     large for a one-liner — the controller logs compact action lines
@@ -269,7 +284,7 @@ class StdoutSink(Sink):
 
     def __init__(self, stream=None,
                  skip_kinds=("span", "run", "incident", "journal",
-                             "request", "remediation")):
+                             "request", "remediation", "trace", "slo")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 

@@ -9,7 +9,8 @@ machine over :mod:`~apex_tpu.resilience.remediation.policy`'s closed
 transition graph:
 
 - **detect** — detector records (``kind="fleet"``/``"stall"``/
-  ``"skip"``/``"rollback"``/``"halt"``/``"divergence"``) open a *case*;
+  ``"skip"``/``"rollback"``/``"halt"``/``"divergence"``, plus serving's
+  ``kind="slo"`` burn-rate alerts) open a *case*;
   :class:`ControllerSink` taps them straight off the MetricRouter so
   the wiring is one ``add_sink`` call, and repeated flags for the same
   (kind, suspect) attach as evidence to the open case instead of
@@ -81,9 +82,13 @@ __all__ = [
     "ControllerSink",
 ]
 
-#: record kinds the controller consumes as detector findings
+#: record kinds the controller consumes as detector findings.
+#: ``slo`` is the serving burn-rate monitor's stream (trace/slo.py):
+#: only records with ``alert=True`` open a case — the monitor emits
+#: window summaries continuously, and a healthy window is evidence the
+#: check ran, not a finding.
 DETECTOR_KINDS = frozenset({
-    "fleet", "stall", "skip", "rollback", "halt", "divergence",
+    "fleet", "stall", "skip", "rollback", "halt", "divergence", "slo",
 })
 
 #: evidence records kept verbatim per case (the rest are counted — a
@@ -290,6 +295,14 @@ class RemediationController:
                 case_kind, suspect = "sentinel", None
             elif kind == "halt":
                 case_kind, suspect = "halt", None
+            elif kind == "slo":
+                # burn-rate summaries flow continuously; only a fired
+                # fast-burn alert is a finding (repeat alerts attach as
+                # evidence to the open case, so a sustained burn is one
+                # case with a deep evidence trail, not an alert storm)
+                if not record.get("alert"):
+                    return None
+                case_kind, suspect = "slo", None
             else:  # divergence: the bisector's forensic verdict
                 if not record.get("found"):
                     return None

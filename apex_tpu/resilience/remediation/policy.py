@@ -26,6 +26,7 @@ sdc            canary-audit divergence / ``kind="divergence"``  quarantine
 incident       exit-43 adoption (supervisor ``pending``)     restart
 preemption     SIGTERM termination (``on_preemption``)       restart
 halt           ``kind="halt"`` (escalation ladder exhausted) escalate
+slo            ``kind="slo"`` ``alert=True`` (burn monitor)  observe
 =============  ============================================  ==========
 
 Responses:
@@ -80,7 +81,7 @@ __all__ = [
 #: every detector finding the controller opens a case for
 CASE_KINDS = (
     "straggler", "corruption", "stall", "sentinel", "sdc",
-    "incident", "preemption", "halt",
+    "incident", "preemption", "halt", "slo",
 )
 
 #: the closed response vocabulary (module docstring)
@@ -153,6 +154,12 @@ _DEFAULT_RESPONSES: Dict[str, str] = {
     "incident": "restart",
     "preemption": "restart",
     "halt": "escalate",
+    # an SLO fast-burn alert is a SYMPTOM, not a located fault: the
+    # autoscaler/fleet machinery is already reacting (the alert vetoes
+    # scale-down debounce), so the case just tracks whether the burn
+    # clears — restarting replicas on a demand spike would convert
+    # badput into MORE badput
+    "slo": "observe",
 }
 
 

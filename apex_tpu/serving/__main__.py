@@ -37,7 +37,13 @@ mid-load — detection, re-dispatch, restart, probation close — plus an
 SLO-driven scale-up, with the same closure assertions fleet-wide:
 exactly one terminal record per global request id, zero steady-state
 compiles on every surviving replica, and the goodput partition identity
-exact over the shared stream.
+exact over the shared stream. Both fleet parts additionally run the
+request x-ray (apex_tpu.serving.trace): every terminal request —
+including a KV-handoff-migrated one and an attempt>1 failed-over one —
+must have a COMPLETE span tree, a per-request partition identity that
+re-adds with ``==`` through a json round trip, and recovery/handoff
+seconds that reconcile with the goodput accountant's badput; part B
+also asserts the SLO burn-rate monitor alerted under its micro-budget.
 """
 
 import argparse
@@ -331,6 +337,7 @@ def fleet_selftest() -> int:
     from apex_tpu.serving.engine import ServingConfig, ServingEngine
     from apex_tpu.serving.fleet import FleetConfig, FleetRouter
     from apex_tpu.serving.lifecycle import TERMINAL_STATES
+    from apex_tpu.serving.trace.analyze import analyze as xray
     from apex_tpu.transformer import TransformerConfig
 
     failures = []
@@ -378,7 +385,8 @@ def fleet_selftest() -> int:
 
     # -- part A: disaggregated parity through a ledgered KV handoff ------
     print("fleet selftest A: prefill/decode disaggregation", flush=True)
-    mem_a = MemorySink(kinds=("request", "run", "span", "fleet", "handoff"))
+    mem_a = MemorySink(kinds=("request", "run", "span", "fleet",
+                              "handoff", "trace", "slo"))
     router_a = MetricRouter([mem_a])
     run_header(router_a, "fleet-selftest-a")
     fleet_a = FleetRouter(
@@ -417,12 +425,25 @@ def fleet_selftest() -> int:
            all(rep.engine.allocator.free_blocks == cfg.num_blocks
                for rep in fleet_a.replicas),
            "part A: every replica's KV pool fully free after drain")
+    # the request x-ray over the same stream: every terminal id has a
+    # COMPLETE span tree, the partition identity re-adds with == through
+    # a json round trip, and the per-request handoff seconds reconcile
+    # against the accountant's handoff badput digit-for-digit
+    xr_a = xray(mem_a.snapshot())
+    _check(failures, xr_a.n_traces > 0 and xr_a.ok,
+           "part A: trace closure — complete trees, exact identity, "
+           "handoff badput reconciled")
+    deco_a = {d["trace"]: d for d in xr_a.decompositions}
+    _check(failures,
+           all(deco_a[r.rid]["handoff_s"] > 0.0 for r in reqs),
+           "part A: migrated requests book handoff as its own phase")
     router_a.close()
 
     # -- part B: replica kill -> failover -> restart, plus a scale-up ----
     print("fleet selftest B: chaos kill + failover + autoscale",
           flush=True)
-    mem_b = MemorySink(kinds=("request", "run", "span", "fleet", "handoff"))
+    mem_b = MemorySink(kinds=("request", "run", "span", "fleet",
+                              "handoff", "trace", "slo"))
     router_b = MetricRouter([mem_b])
     run_header(router_b, "fleet-selftest-b")
     plan = FaultPlan(kill_replica_steps={4})
@@ -510,6 +531,26 @@ def fleet_selftest() -> int:
            lhs + rep_acct.unattributed_s == rep_acct.wall_s
            and rep_acct.productive_s > 0.0,
            "fleet-wide goodput partition identity holds digit-for-digit")
+    # trace closure THROUGH the kill: every request — including the
+    # failed-over attempt>1 ones — has one complete span tree, the
+    # per-request identity is exact, and the recovery seconds the trees
+    # book reconcile with the accountant's failover badput
+    xr_b = xray(mem_b.snapshot())
+    _check(failures, xr_b.n_traces > 0 and xr_b.ok,
+           "part B: trace closure through kill+failover — complete "
+           "trees, exact identity, failover badput reconciled")
+    recovered = [d for d in xr_b.decompositions
+                 if (d.get("attempt") or 1) > 1]
+    _check(failures,
+           bool(recovered)
+           and all(d["recovery_s"] > 0.0 for d in recovered),
+           "part B: failed-over requests book recovery as its own phase")
+    slo_recs = [r for r in mem_b.snapshot() if r.get("kind") == "slo"]
+    _check(failures,
+           any(r.get("alert") for r in slo_recs)
+           and all(r["n"] >= r["violations"] >= 0 for r in slo_recs),
+           "part B: SLO burn-rate records emitted, fast-burn alert "
+           "fired under the micro-budget")
     router_b.close()
 
     from apex_tpu.resilience.exit_codes import ExitCode

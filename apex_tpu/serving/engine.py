@@ -53,7 +53,12 @@ Robustness surface (the ops layer transferring wholesale):
 Telemetry: ``kind="request"`` lifecycle records (lifecycle.py) plus
 goodput spans — ``prefill`` and ``decode`` are PRODUCTIVE phases, so
 the PR-7 accountant's partition identity extends to request wall clock
-digit-for-digit.
+digit-for-digit. Every lifecycle emission also feeds the engine's
+:class:`~apex_tpu.serving.trace.emit.TraceEmitter` (the ``trace=``
+hook on ``emit_request_record``), growing one causal ``kind="trace"``
+span tree per request — queue wait, prefill, decode segments, drain
+evictions and hang exposure all become spans the request x-ray
+(``python -m apex_tpu.serving.trace``) can decompose.
 """
 
 import collections
@@ -80,6 +85,7 @@ from apex_tpu.serving.lifecycle import (
     emit_request_record,
     transition,
 )
+from apex_tpu.serving.trace.emit import TraceEmitter
 
 logger = logging.getLogger("apex_tpu.serving")
 
@@ -200,6 +206,10 @@ class ServingEngine:
         self.fault_plan = fault_plan
         self.watchdog = watchdog
         self.time_fn = time_fn
+        #: the request x-ray's span producer; the fleet stamps ``site``
+        #: with the replica incarnation so span ids stay unique across
+        #: restarts (trace/emit.py)
+        self.trace = TraceEmitter(router, time_fn=time_fn)
         self._validate_model()
 
         self.allocator = BlockAllocator(config.num_blocks)
@@ -539,7 +549,8 @@ class ServingEngine:
 
         def reject(reason, **extra):
             transition(req, REJECTED, now=now, reason=reason)
-            emit_request_record(self.router, self._tick, req, **extra)
+            emit_request_record(self.router, self._tick, req,
+                                trace=self.trace, **extra)
             logger.warning("request %d rejected (%s)%s", rid, reason,
                            f": {detail}" if detail else "")
             return req
@@ -559,7 +570,8 @@ class ServingEngine:
             return reject("queue_full")
         transition(req, QUEUED, now=now)
         self._queue.append(req)
-        emit_request_record(self.router, self._tick, req)
+        emit_request_record(self.router, self._tick, req,
+                            trace=self.trace)
         return req
 
     def cancel(self, rid: int) -> bool:
@@ -572,7 +584,8 @@ class ServingEngine:
             self._queue.remove(req)
             transition(req, CANCELLED, now=self.time_fn(),
                        reason="client_cancel")
-            emit_request_record(self.router, self._tick, req)
+            emit_request_record(self.router, self._tick, req,
+                                trace=self.trace)
             return True
         self._release(req, CANCELLED, "client_cancel")
         return True
@@ -639,7 +652,12 @@ class ServingEngine:
         if self.fault_plan is not None:
             # the wedge fault blocks HERE, inside the loop the watchdog
             # guards — exactly like the training examples inject it
+            hang_t0 = self.time_fn()
             self.fault_plan.maybe_hang(t)
+            hang_s = self.time_fn() - hang_t0
+            if hang_s > 0.0:
+                self.trace.stall(t, list(self._active.values()),
+                                 hang_t0, hang_s)
         n_pref = 0
         while (self._queue and not self._draining
                and n_pref < self.config.max_prefills_per_tick):
@@ -650,7 +668,7 @@ class ServingEngine:
             lane, blocks, P = placement
             req.lane, req.blocks, req.bucket = lane, blocks, P
             transition(req, ADMITTED, now=self.time_fn())
-            emit_request_record(self.router, t, req)
+            emit_request_record(self.router, t, req, trace=self.trace)
             self._run_prefill(req, t)
             n_pref += 1
         if self._active:
@@ -671,7 +689,7 @@ class ServingEngine:
     def _run_prefill(self, req: Request, t: int) -> None:
         cfg = self.config
         transition(req, PREFILL, now=self.time_fn())
-        emit_request_record(self.router, t, req)
+        emit_request_record(self.router, t, req, trace=self.trace)
         L, P = req.prompt_len, req.bucket
         n_pb = P // cfg.block_size
         tokens = np.zeros((P,), np.int32)
@@ -693,7 +711,7 @@ class ServingEngine:
             self.allocator.free(req.blocks)
             transition(req, FAILED, now=self.time_fn(),
                        reason=f"engine_error: {type(e).__name__}")
-            emit_request_record(self.router, t, req)
+            emit_request_record(self.router, t, req, trace=self.trace)
             return
         self._prefill_ema = _ema(
             self._prefill_ema, time.perf_counter() - t0)
@@ -705,10 +723,10 @@ class ServingEngine:
             # single-token request: prefill IS the whole generation
             self.allocator.free(req.blocks)
             transition(req, COMPLETED, now=self.time_fn())
-            emit_request_record(self.router, t, req)
+            emit_request_record(self.router, t, req, trace=self.trace)
             return
         transition(req, DECODE, now=self.time_fn())
-        emit_request_record(self.router, t, req)
+        emit_request_record(self.router, t, req, trace=self.trace)
         lane = req.lane
         self._tables[lane, :] = cfg.num_blocks
         self._tables[lane, :len(req.blocks)] = req.blocks
@@ -769,7 +787,8 @@ class ServingEngine:
             self._temps[lane] = 0.0
         self.allocator.free(req.blocks)
         transition(req, state, now=self.time_fn(), reason=reason)
-        emit_request_record(self.router, self._tick, req)
+        emit_request_record(self.router, self._tick, req,
+                            trace=self.trace)
 
     def _expire(self, now: float) -> None:
         """Deadline enforcement, EVERY tick, queue and batch alike."""
@@ -778,7 +797,8 @@ class ServingEngine:
                     and now > r.expires_at()]:
             self._queue.remove(req)
             transition(req, TIMED_OUT, now=now, reason="deadline")
-            emit_request_record(self.router, self._tick, req)
+            emit_request_record(self.router, self._tick, req,
+                                trace=self.trace)
         for req in [r for r in self._active.values()
                     if r.expires_at() is not None
                     and now > r.expires_at()]:
@@ -831,6 +851,9 @@ class ServingEngine:
         self.allocator.free(req.blocks)
         req.lane, req.blocks = None, ()
         del self._requests[rid]
+        # the request's decode segment on THIS engine ends here; its
+        # story continues on the adopter (or at the fleet)
+        self.trace.extracted(self._tick, req)
         return payload
 
     def adopt(self, payload: dict) -> bool:
@@ -879,6 +902,7 @@ class ServingEngine:
         self._last_tok[lane] = payload["last_token"]
         self._temps[lane] = req.temperature
         self._lane_mask[lane] = True
+        self.trace.adopted(self._tick, req)
         return True
 
     def acknowledge_compiles(self) -> None:
@@ -926,7 +950,8 @@ class ServingEngine:
                 req = self._queue.popleft()
                 transition(req, REJECTED, now=self.time_fn(),
                            reason="draining")
-                emit_request_record(self.router, self._tick, req)
+                emit_request_record(self.router, self._tick, req,
+                                    trace=self.trace)
             while self._active:
                 if deadline is not None and self.time_fn() > deadline:
                     for req in list(self._active.values()):

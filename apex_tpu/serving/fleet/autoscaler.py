@@ -42,10 +42,15 @@ __all__ = ["FleetAutoscaler"]
 class FleetAutoscaler:
     """Two-sided debounced scaling decisions (module docstring).
 
-    ``observe(tick, signal_s, n_replicas)`` with the fleet's current
-    best-placement TTFT estimate (None until any replica's estimator
-    arms — cold fleets neither grow nor shrink on no evidence) returns
-    the decided action or None.
+    ``observe(tick, signal_s, n_replicas, burning=False)`` with the
+    fleet's current best-placement TTFT estimate (None until any
+    replica's estimator arms — cold fleets neither grow nor shrink on
+    no evidence) returns the decided action or None. ``burning`` is the
+    SLO monitor's fast-burn alert (trace/slo.py): secondary evidence
+    that counts toward the breach debounce even when the estimator has
+    no signal (a shed-heavy fleet burns error budget without ever
+    breaching the estimate), doubles the count when both agree, and
+    vetoes the clear path — a fleet on fire never looks surplus.
     """
 
     def __init__(self, ttft_budget_s: float,
@@ -77,17 +82,23 @@ class FleetAutoscaler:
         self.scale_downs = 0
 
     def observe(self, tick: int, signal_s: Optional[float],
-                n_replicas: int) -> Optional[str]:
+                n_replicas: int, burning: bool = False) -> Optional[str]:
         """One fleet tick of evidence; returns the decided action."""
-        if signal_s is None:
-            # no estimator armed anywhere: no evidence, no action, and
-            # the debounce counters hold (a dead spot in the signal must
-            # not count as "cleared")
+        if signal_s is None and not burning:
+            # no estimator armed anywhere and no burn alert: no
+            # evidence, no action, and the debounce counters hold (a
+            # dead spot in the signal must not count as "cleared")
             return None
-        if signal_s > self.ttft_budget_s:
-            self._breaches += 1
+        breach = signal_s is not None and signal_s > self.ttft_budget_s
+        if breach or burning:
+            # the burn alert counts as a breach tick on its own (sheds
+            # burn error budget without a TTFT estimate); when BOTH the
+            # estimator and the burn window agree, the evidence is
+            # corroborated — count double so the debounce halves
+            self._breaches += 1 + (1 if (breach and burning) else 0)
             self._clears = 0
-        elif signal_s < self.low_water * self.ttft_budget_s:
+        elif (signal_s is not None
+                and signal_s < self.low_water * self.ttft_budget_s):
             self._clears += 1
             self._breaches = 0
         else:
@@ -101,9 +112,11 @@ class FleetAutoscaler:
             self.scale_ups += 1
             self._breaches = 0
             logger.warning(
-                "fleet autoscale: TTFT estimate %.3fs held above budget "
-                "%.3fs for %d ticks — scaling %d -> %d replicas",
-                signal_s, self.ttft_budget_s, self.breach_ticks,
+                "fleet autoscale: TTFT evidence (estimate %s, budget "
+                "%.3fs, slo_burning=%s) held for %d ticks — scaling "
+                "%d -> %d replicas",
+                ("n/a" if signal_s is None else f"{signal_s:.3f}s"),
+                self.ttft_budget_s, burning, self.breach_ticks,
                 n_replicas, n_replicas + 1,
             )
         elif (self._clears >= self.clear_ticks
@@ -120,8 +133,9 @@ class FleetAutoscaler:
         if action is not None and self.router is not None:
             self.router.event(
                 "fleet", int(tick), check="autoscale", action=action,
-                signal_s=float(signal_s), budget_s=self.ttft_budget_s,
-                replicas=int(n_replicas),
+                signal_s=(None if signal_s is None else float(signal_s)),
+                budget_s=self.ttft_budget_s,
+                replicas=int(n_replicas), slo_burning=bool(burning),
             )
         return action
 

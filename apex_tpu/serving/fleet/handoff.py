@@ -12,7 +12,11 @@ adopt) as ``kind="handoff"`` records through the shared MetricRouter
 schema:
 
     {"t", "step", "kind": "handoff", "host", "seq", "id", "src",
-     "dst", "blocks", "bytes", "side"}
+     "dst", "blocks", "bytes", "side", "trace"}
+
+(``trace`` duplicates the request's global id under the trace-id key so
+a jq over the stream joins the byte audit with the request's span tree
+— the x-ray cross-link, docs/serving.md.)
 
 and :meth:`audit` closes the loop: every ``seq`` must have exactly one
 ``out`` and one ``in`` with EQUAL bytes and block counts — a half-booked
@@ -77,7 +81,7 @@ class HandoffLedger:
             self.router.event(
                 "handoff", int(tick), seq=seq, id=int(rid), src=str(src),
                 dst=None, blocks=int(n_blocks), bytes=int(nbytes),
-                side="out",
+                side="out", trace=int(rid),
             )
         return seq
 
@@ -102,7 +106,7 @@ class HandoffLedger:
             self.router.event(
                 "handoff", int(tick), seq=int(seq), id=entry.rid,
                 src=entry.src, dst=str(dst), blocks=int(n_blocks),
-                bytes=int(nbytes), side="in",
+                bytes=int(nbytes), side="in", trace=entry.rid,
             )
 
     def abandon(self, seq: int, tick: int, reason: str) -> None:
@@ -120,6 +124,7 @@ class HandoffLedger:
                 "handoff", int(tick), seq=int(seq), id=entry.rid,
                 src=entry.src, dst=None, blocks=entry.n_blocks,
                 bytes=0, side="abandoned", reason=str(reason),
+                trace=entry.rid,
             )
 
     def entries(self) -> List[HandoffEntry]:

@@ -81,6 +81,7 @@ class Replica:
         self._factory = engine_factory
         self.incarnation = 0
         self.engine = engine_factory(self.name, self.incarnation)
+        self._stamp_trace_site()
         self.alive = True
         self.missed_beats = 0
         self.restarts = 0
@@ -89,6 +90,15 @@ class Replica:
         self.case_state: Optional[str] = None
         self.case_kind: Optional[str] = None
         self._probation_clean = 0
+
+    def _stamp_trace_site(self) -> None:
+        """Name the engine's trace-span emitter after THIS incarnation
+        (``r0.1`` = replica r0's first restart): span ids stay unique
+        across restarts, and the x-ray shows which incarnation did the
+        work. getattr-guarded — test fakes need not carry an emitter."""
+        tr = getattr(self.engine, "trace", None)
+        if tr is not None:
+            tr.site = f"{self.name}.{self.incarnation}"
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -182,6 +192,7 @@ class Replica:
                 exit_code=int(ExitCode.FAILURE))
         self.engine = engine
         self.incarnation += 1
+        self._stamp_trace_site()
         self.restarts += 1
         self.alive = True
         self.missed_beats = 0

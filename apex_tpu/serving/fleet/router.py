@@ -57,12 +57,14 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from apex_tpu.monitor.goodput.spans import span
+from apex_tpu.monitor.goodput.spans import begin_span
 from apex_tpu.resilience.remediation.policy import RemediationPolicy
 from apex_tpu.serving.fleet.autoscaler import FleetAutoscaler
 from apex_tpu.serving.fleet.handoff import HandoffLedger
 from apex_tpu.serving.fleet.prefix import RadixPrefixIndex
 from apex_tpu.serving.fleet.replica import Replica
+from apex_tpu.serving.trace.emit import TraceEmitter
+from apex_tpu.serving.trace.slo import SLOMonitor
 from apex_tpu.serving.lifecycle import (
     DECODE,
     FAILED,
@@ -88,7 +90,12 @@ class FleetConfig:
     fleet ticks (tick-keyed: chaos drills replay deterministically).
     ``ttft_budget_s`` arms the autoscaler (None = fixed fleet) between
     ``min_replicas`` and ``max_replicas``; ``scale_down_grace_s`` is
-    the drain budget a retiring replica gets.
+    the drain budget a retiring replica gets. The same TTFT budget also
+    arms the SLO burn-rate monitor (trace/slo.py) when a record router
+    is wired: ``slo_target`` is the promised good-request fraction over
+    the last ``slo_window`` terminals (``slo_min_count`` keeps a
+    near-empty window from paging), and a fast-burn alert feeds the
+    autoscaler's debounce as secondary evidence.
     """
 
     replicas: int = 2
@@ -101,6 +108,9 @@ class FleetConfig:
     clear_ticks: int = 20
     scale_down_grace_s: float = 5.0
     prefix_max_nodes: int = 4096
+    slo_target: float = 0.99
+    slo_window: int = 64
+    slo_min_count: int = 8
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -161,6 +171,20 @@ class FleetRouter:
         block_size = self.replicas[0].engine.config.block_size
         self.prefix = RadixPrefixIndex(
             block_size=block_size, max_nodes=config.prefix_max_nodes)
+        #: the fleet's own trace-span producer: dispatch markers plus
+        #: the recovery/handoff spans no single engine can see
+        self.trace = TraceEmitter(router, site="fleet", time_fn=time_fn)
+        self.slo = None
+        if router is not None and config.ttft_budget_s is not None:
+            self.slo = SLOMonitor(
+                router, ttft_budget_s=config.ttft_budget_s,
+                target=config.slo_target, window=config.slo_window,
+                min_count=config.slo_min_count,
+            )
+            # enqueue-only tap (the ControllerSink idiom): terminal
+            # request records feed the burn window; classification
+            # happens at poll time, outside the router fan-out
+            router.add_sink(self.slo.sink())
         self.autoscaler = None
         if config.ttft_budget_s is not None:
             self.autoscaler = FleetAutoscaler(
@@ -280,6 +304,7 @@ class FleetRouter:
             deadline_s=deadline_s, rid=rid, tags=tags,
         )
         if req.state == QUEUED:
+            self.trace.dispatched(self._tick, req, target.name)
             if toks is not None:
                 self.prefix.insert(toks, target.name)
             self._dispatch[rid] = {
@@ -353,6 +378,8 @@ class FleetRouter:
         if self.config.prefill_replicas:
             self._migrate(t)
         self._health(t)
+        if self.slo is not None:
+            self.slo.poll(t)
         if self.autoscaler is not None and not self._draining:
             self._autoscale(t)
         self._tick += 1
@@ -398,11 +425,16 @@ class FleetRouter:
                     moves.append((rep, req.rid))
         if not moves:
             return
-        with span("handoff", router=self.router, step=t, moves=len(moves)):
+        hops = []   # (rid, attempt, start, end, src, dst) per extract
+        gp_span = begin_span("handoff", router=self.router, step=t,
+                             moves=len(moves))
+        try:
             for src, rid in moves:
+                h0 = self.time_fn()
                 payload = src.engine.extract(rid)
                 if payload is None:
                     continue
+                req = payload["request"]
                 seq = self.ledger.book_out(
                     rid, src.name, payload["n_blocks"], payload["bytes"], t)
                 targets = [r for r in self._admissible(role_ok=("decode",))
@@ -419,21 +451,37 @@ class FleetRouter:
                     entry = self._dispatch.get(rid)
                     if entry is not None:
                         entry["replica"] = placed.name
-                    req = payload["request"]
                     req.tags["replica"] = placed.name
+                    hops.append((rid, req, h0, self.time_fn(),
+                                 src.name, placed.name))
                     continue
                 if src.engine.adopt(payload):
                     # decode pool full this tick: stay home, retry next
-                    # tick — the extract/adopt round-trip moved nothing
+                    # tick — the extract/adopt round-trip moved nothing,
+                    # but the request still SPENT the round trip in
+                    # handoff machinery; its trace span says so
                     self.ledger.book_in(
                         seq, src.name, payload["n_blocks"],
                         payload["bytes"], t)
+                    hops.append((rid, req, h0, self.time_fn(),
+                                 src.name, src.name))
                     continue
                 self.ledger.abandon(seq, t, "no_adopter")
-                req = payload["request"]
                 transition(req, FAILED, now=self.time_fn(),
                            reason="handoff_no_adopter")
-                emit_request_record(self.router, t, req)
+                emit_request_record(self.router, t, req,
+                                    trace=self.trace)
+                hops.append((rid, req, h0, self.time_fn(),
+                             src.name, None))
+        finally:
+            # close FIRST, then emit the per-request handoff spans: the
+            # closed goodput record's start/dur ride along as twins so
+            # the analyzer reconciles both views digit-for-digit
+            gp = gp_span.close()
+        for rid, req, h0, h1, src_name, dst_name in hops:
+            self.trace.handoff(
+                t, rid, int(req.tags.get("attempt", 1)), h0, h1, gp,
+                src=src_name, dst=dst_name)
 
     # -- health / failover --------------------------------------------------
 
@@ -454,8 +502,10 @@ class FleetRouter:
         outranks compile in the phase priority: the whole envelope is
         recovery time)."""
         self.failovers += 1
-        with span("failover", router=self.router, step=t,
-                  replica=rep.name):
+        fo_t0 = self.time_fn()
+        gp_span = begin_span("failover", router=self.router, step=t,
+                             replica=rep.name)
+        try:
             self.prefix.evict_replica(rep.name)
             orphans = [
                 (rid, entry) for rid, entry in self._dispatch.items()
@@ -479,6 +529,20 @@ class FleetRouter:
                             other.engine.acknowledge_compiles()
             elif rep.case_state == "detected":
                 rep.quarantine(t)
+        finally:
+            gp = gp_span.close()
+        fo_t1 = self.time_fn()
+        for rid, entry in orphans:
+            req = entry["req"]
+            # the whole envelope (detect-to-restart) is recovery time
+            # for every orphan; accumulate it on the request's tags
+            # (satellite of the trace span below — terminal records
+            # then carry the recovery total the decomposition books)
+            req.tags["recovery_s"] = (
+                float(req.tags.get("recovery_s", 0.0)) + (fo_t1 - fo_t0))
+            self.trace.recovery(
+                t, rid, int(req.tags.get("attempt", 1)), fo_t0, fo_t1,
+                gp, replica=rep.name)
 
     def _redispatch(self, rid: int, entry: Dict[str, Any], t: int) -> None:
         """Second attempt under the SAME global id and ORIGINAL submit
@@ -486,7 +550,16 @@ class FleetRouter:
         is dead); this attempt does — exactly once — so the stream's
         one-terminal-per-id closure holds through the failure. TTFT
         stays honest: the clock started when the CLIENT submitted, not
-        when the fleet recovered."""
+        when the fleet recovered.
+
+        Pinned semantics (tests/test_trace.py): ``queue_wait_s`` and
+        ``ttft_s`` on the flat records keep measuring from the ORIGINAL
+        submission — client-visible latency, recovery included. The
+        SPLIT lives in the trace tree: the recovery envelope is its own
+        ``recovery`` span (mirroring the ``failover`` goodput span),
+        and the re-attempt's queue span anchors at the actual local
+        re-enqueue instant (``redispatch_t`` tag), so recovery time is
+        never double-booked as queue wait in the decomposition."""
         dead = entry["replica"]
         role_ok = (("prefill",) if self.config.prefill_replicas
                    else ("any",))
@@ -503,7 +576,7 @@ class FleetRouter:
             req.tags["attempt"] = attempt
             transition(req, FAILED, now=self.time_fn(),
                        reason="no_replica_for_failover")
-            emit_request_record(self.router, t, req)
+            emit_request_record(self.router, t, req, trace=self.trace)
             return
         target = self._pick(reps)
         tags = dict(entry["req"].tags)
@@ -513,6 +586,10 @@ class FleetRouter:
             temperature=entry["temperature"],
             deadline_s=entry["deadline_s"], rid=rid, tags=tags,
         )
+        # the engine stamped the LOCAL re-enqueue instant; keep it as a
+        # tag (the trace queue span's anchor) before restoring the
+        # client-visible original submit time
+        req.tags["redispatch_t"] = float(req.submit_t)
         req.submit_t = entry["submit_t"]
         entry.update(replica=target.name, req=req, attempt=attempt)
         self.redispatched += 1
@@ -534,7 +611,9 @@ class FleetRouter:
                    if r.alive and r.case_state != "escalated")
 
     def _autoscale(self, t: int) -> None:
-        action = self.autoscaler.observe(t, self._signal(), self._n_live())
+        action = self.autoscaler.observe(
+            t, self._signal(), self._n_live(),
+            burning=self.slo.burning if self.slo is not None else False)
         if action == "scale_up":
             rep = self._new_replica()
             rep.start()

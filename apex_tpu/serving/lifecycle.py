@@ -185,33 +185,43 @@ def transition(req: Request, new_state: str, now: Optional[float] = None,
     return req
 
 
-def emit_request_record(router, tick: int, req: Request,
+def emit_request_record(router, tick: int, req: Request, trace=None,
                         **extra) -> Optional[dict]:
     """One ``kind="request"`` record for ``req``'s current state.
 
     Called once per transition by the engine; with ``router=None`` the
     record is a no-op (un-wired library cost: nothing). Latency fields
     are included only once they exist — None-not-fake-number.
+
+    ``trace`` is the emitter's :class:`~apex_tpu.serving.trace.emit.
+    TraceEmitter` (or None): because this function is the SINGLE
+    request-record emission point, hooking it here grows the request's
+    causal span tree on every transition without per-call-site wiring —
+    the hook runs after the flat record so the stream reads
+    transition-then-spans.
     """
-    if router is None:
-        return None
-    fields = {
-        "id": int(req.rid),
-        "state": req.state,
-        "reason": req.reason,
-        "prompt_len": int(req.prompt_len),
-        "max_new": int(req.max_new_tokens),
-        "tokens_out": len(req.tokens_out),
-    }
-    if req.tags:
-        fields.update(req.tags)
-    if req.queue_wait_s is not None:
-        fields["queue_wait_s"] = float(req.queue_wait_s)
-    if req.ttft_s is not None:
-        fields["ttft_s"] = float(req.ttft_s)
-    if req.end_t is not None:
-        fields["total_s"] = float(req.end_t - req.submit_t)
-    if req.terminal:
-        fields["terminal"] = True
-    fields.update(extra)
-    return router.event("request", int(tick), **fields)
+    rec = None
+    if router is not None:
+        fields = {
+            "id": int(req.rid),
+            "state": req.state,
+            "reason": req.reason,
+            "prompt_len": int(req.prompt_len),
+            "max_new": int(req.max_new_tokens),
+            "tokens_out": len(req.tokens_out),
+        }
+        if req.tags:
+            fields.update(req.tags)
+        if req.queue_wait_s is not None:
+            fields["queue_wait_s"] = float(req.queue_wait_s)
+        if req.ttft_s is not None:
+            fields["ttft_s"] = float(req.ttft_s)
+        if req.end_t is not None:
+            fields["total_s"] = float(req.end_t - req.submit_t)
+        if req.terminal:
+            fields["terminal"] = True
+        fields.update(extra)
+        rec = router.event("request", int(tick), **fields)
+    if trace is not None:
+        trace.on_record(int(tick), req)
+    return rec

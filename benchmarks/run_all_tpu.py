@@ -1238,11 +1238,19 @@ def run_serving(deadline, out_path):
     (~73 ms/fetch, docs/benchmarking.md) dominates and the numbers
     measure the relay, not the chip; compare within one platform tag
     only (the sentinel already does).  Zero steady-state recompiles is
-    asserted via the engine's own violation counter."""
+    asserted via the engine's own violation counter.
+
+    The run records into an in-memory router so the request x-ray
+    (apex_tpu.serving.trace, ISSUE 17) can decompose the p99 TTFT
+    request along its critical path — each phase lands as its own
+    ``serving_ttft_p99_<phase>_s`` bench twin, so the sentinel can
+    tell a queueing regression from a prefill regression instead of
+    gating one opaque aggregate."""
     import jax
     import numpy as np
 
     from apex_tpu.models import GPTModel
+    from apex_tpu.monitor.router import MemorySink, MetricRouter
     from apex_tpu.serving import (
         PoissonLoadGenerator, ServingConfig, ServingEngine,
     )
@@ -1260,7 +1268,9 @@ def run_serving(deadline, out_path):
     cfg = ServingConfig(
         lanes=4, block_size=16, num_blocks=48, max_seq_len=128, seed=0,
     )
-    eng = ServingEngine(model, variables, cfg)
+    mem = MemorySink(kinds=("trace", "request", "span", "run"))
+    eng = ServingEngine(model, variables, cfg,
+                        router=MetricRouter([mem]))
     t0 = time.monotonic()
     eng.start()
     compile_s = round(time.monotonic() - t0, 3)
@@ -1309,6 +1319,29 @@ def run_serving(deadline, out_path):
                         "completed": True, "metric": metric,
                         "value": value, "unit": unit,
                         "rate_rps": 20.0, "lanes": cfg.lanes})
+
+    # request x-ray: decompose the p99 TTFT request's critical path
+    # from the run's own trace records (jax-free analysis).  One bench
+    # twin per phase, "_s" suffix = lower-is-better, so the sentinel
+    # gates "queue wait doubled" separately from "prefill got slower".
+    from apex_tpu.serving.trace.analyze import analyze as trace_xray
+    xr = trace_xray(mem.snapshot())
+    rec["trace_ok"] = bool(xr.ok)
+    parts = (xr.ttft or {}).get("p99_parts") or {}
+    for phase in ("queue", "prefill", "handoff", "recovery",
+                  "overhead"):
+        value = parts.get(f"{phase}_s")
+        if value is None:
+            continue
+        metric = f"serving_ttft_p99_{phase}_s"
+        value = round(float(value), 6)
+        rec[metric] = value
+        rec["measured_n"] += 1
+        emit(out_path, {"section": f"serving_{metric}",
+                        "ok": bool(xr.ok), "completed": True,
+                        "metric": metric, "value": value, "unit": "s",
+                        "rate_rps": 20.0, "lanes": cfg.lanes,
+                        "p99_trace": (xr.ttft or {}).get("p99_trace")})
 
     # fleet resilience gate: the --fleet selftest (KV-handoff parity on a
     # disaggregated pair, then a chaos replica kill with failover/restart

@@ -396,7 +396,8 @@ def test_fleet_chaos_drill():
                            jnp.zeros((1, 4), jnp.int32))
     cfg = ServingConfig(lanes=2, block_size=8, num_blocks=16,
                         max_seq_len=32, max_queue_depth=64, seed=0)
-    mem = MemorySink(kinds=("request", "run", "span", "fleet", "handoff"))
+    mem = MemorySink(kinds=("request", "run", "span", "fleet", "handoff",
+                            "trace", "slo"))
     router = MetricRouter([mem])
     run_header(router, "fleet-chaos-drill")
     fleet = FleetRouter(
@@ -495,3 +496,26 @@ def test_fleet_chaos_drill():
         lhs = lhs + acct.badput_s[phase]
     assert lhs + acct.unattributed_s == acct.wall_s
     assert acct.productive_s > 0.0
+
+    # 10. ISSUE 17 trace closure: one complete span tree per terminal
+    # request — through the kill (attempt > 1) and the handoffs — with
+    # the per-request partition identity holding digit-for-digit
+    # through a json round trip, and the failover/handoff badput
+    # reconciling exactly between the accountant and the gp twins
+    from apex_tpu.serving.trace.analyze import analyze as xray
+
+    xr = xray(records)
+    assert xr.n_traces > 0 and xr.ok, xr.summary()
+    assert not xr.untraced_terminals and not xr.identity_violations
+    deco = {d["trace"]: d for d in xr.decompositions}
+    assert all(deco[r.rid]["recovery_s"] > 0.0 for r in reqs
+               if r.tags.get("attempt", 1) > 1), \
+        "failed-over requests must book recovery as its own phase"
+    assert all(v["match"] for v in xr.reconcile.values()), xr.summary()
+
+    # 11. the SLO burn monitor saw the micro-budget violations and the
+    # fast-burn alert fed the autoscaler (secondary evidence)
+    slo_recs = [r for r in records if r.get("kind") == "slo"]
+    assert any(r.get("alert") for r in slo_recs)
+    assert all(r["n"] >= r["violations"] >= r["sheds"] >= 0
+               for r in slo_recs)
