@@ -1,0 +1,342 @@
+"""Compiled-memory differ: XLA's ``memory_analysis()`` vs the HBM ledger.
+
+The analytic ledger (``monitor/xray/hbm/model.py``) predicts a step's
+per-device peak from closed-form arithmetic — what memory the config
+SHOULD pin. XLA's ``compiled.memory_analysis()`` says what the compiled
+program actually books. This pass reconciles the two over the shared
+AOT compile (``StepContext.aot()`` — one ``.lower().compile()`` serves
+the donation auditor and all three HLO passes):
+
+- ``memory.unpredicted``  (error) — bytes the model cannot account
+  for: an argument component whose measured bytes differ from the
+  prediction (params and optimizer state must match EXACTLY — their
+  layout is deterministic), entry-parameter bytes the parser cannot
+  attribute to any predicted component, or temporaries beyond the
+  declared band (``temp_band`` x the predicted transient bytes). The
+  finding carries largest-buffer attribution from the HLO parser's
+  entry-parameter shapes (XLA does not expose individual temp buffers,
+  so the resident table is the anchor the forensics get).
+- ``memory.headroom``     (warning) — the predicted (or measured) peak
+  lands within ``headroom_fraction`` of device capacity: the config
+  compiles today and OOMs on the first shape regression. Skipped when
+  no capacity is known (CPU reports none — None is never faked).
+- ``memory.overpredicted``(info) — model pessimism: the measured peak
+  is below the prediction (XLA rematerialized or aliased what the
+  ledger booked). Not a defect; the delta bounds how much the
+  feasibility oracle over-refuses.
+- ``memory.reconciled``   (info) — positive confirmation: every
+  resident component matched exactly and the temps sat inside the
+  band, with the full component table in the finding data — the gate's
+  jsonl carries the proof, not just the absence of errors.
+- ``memory.unverifiable`` (info) — the backend reports no memory
+  analysis, the HLO could not be parsed, or the target carries no
+  analytic ledger (``StepTarget.hbm``); callers promising verification
+  (the examples' ``--xray-hbm``) must treat this as NOT ok.
+
+Component-to-buffer attribution rides the jax ``op_name`` labels the
+parser extracts per entry parameter: a label root of ``params`` books
+to the ledger's ``weights`` component, ``opt_state`` to
+``optimizer_state``, ``scaler_state`` to ``scaler_state``; every other
+root (tokens, labels, ...) books to ``batch_data``.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from apex_tpu.analysis.findings import Finding, SEV_ERROR, SEV_INFO, SEV_WARNING
+from apex_tpu.analysis.passes import jaxpr_pass
+
+__all__ = [
+    "COMPONENT_ROOTS",
+    "audit_memory",
+    "largest_buffers",
+    "hlo_memory_pass",
+]
+
+#: ledger component name -> the entry-parameter label roots it books;
+#: roots claimed by no component fall through to ``batch_data``
+COMPONENT_ROOTS: Dict[str, Tuple[str, ...]] = {
+    "weights": ("params",),
+    "optimizer_state": ("opt_state",),
+    "scaler_state": ("scaler_state",),
+}
+
+#: measured temps may exceed the predicted transient bytes by this
+#: factor before the differ calls them unpredicted — the declared band
+#: (fusion scratch, reduction workspaces and dtype-widening temps ride
+#: on top of the stash/grads the ledger books analytically)
+DEFAULT_TEMP_BAND = 4.0
+
+#: warn when the peak lands within this fraction of capacity
+DEFAULT_HEADROOM_FRACTION = 0.1
+
+
+def _label_root(param) -> str:
+    """The first path element of a parameter's jax ``op_name`` label
+    (``opt_state.exp_avg['params']...`` -> ``opt_state``)."""
+    label = (param.label or param.name or "").replace("\\'", "'")
+    for sep in ("[", ".", "/"):
+        idx = label.find(sep)
+        if idx >= 0:
+            label = label[:idx]
+    return label.lstrip("%")
+
+
+def largest_buffers(module, n: int = 5) -> List[dict]:
+    """The ``n`` largest entry-parameter buffers, largest first — the
+    attribution table the OOM incident bundle carries."""
+    rows = [
+        {
+            "name": (p.label or p.name).replace("\\'", "'")[:120],
+            "bytes": int(p.nbytes),
+        }
+        for p in module.entry_params
+    ]
+    rows.sort(key=lambda r: r["bytes"], reverse=True)
+    return rows[:n]
+
+
+def _measured_components(module, predicted) -> Tuple[Dict[str, int], int]:
+    """(component name -> measured bytes, unattributed bytes): entry
+    parameters grouped through :data:`COMPONENT_ROOTS`."""
+    root_of = {}
+    for comp, roots in COMPONENT_ROOTS.items():
+        if predicted.component(comp) is not None:
+            for r in roots:
+                root_of[r] = comp
+    has_data = predicted.component("batch_data") is not None
+    measured: Dict[str, int] = {}
+    unattributed = 0
+    for p in module.entry_params:
+        root = _label_root(p)
+        comp = root_of.get(root)
+        if comp is None and has_data:
+            comp = "batch_data"
+        if comp is None:
+            unattributed += p.nbytes
+            continue
+        measured[comp] = measured.get(comp, 0) + p.nbytes
+    return measured, unattributed
+
+
+def audit_memory(
+    fn,
+    *args,
+    donate_argnums=None,
+    target: str = "",
+    compiled=None,
+    module=None,
+    predicted=None,
+    capacity_bytes: Optional[int] = None,
+    headroom_fraction: float = DEFAULT_HEADROOM_FRACTION,
+    temp_band: float = DEFAULT_TEMP_BAND,
+) -> List[Finding]:
+    """Reconcile the analytic breakdown ``predicted`` (an
+    ``hbm.model.HbmBreakdown``) against the compiled program's memory
+    analysis. ``compiled``/``module`` reuse a shared AOT compile and
+    HLO parse when given; ``capacity_bytes`` overrides the device's
+    reported limit for virtual-topology rehearsals."""
+    from apex_tpu.monitor.xray.hbm.report import report_from_compiled
+
+    site0 = f"<step:{target or getattr(fn, '__name__', 'fn')}>"
+
+    if compiled is None:
+        from apex_tpu.analysis.passes import lower_step
+
+        compiled = lower_step(fn, args, donate_argnums).compile()
+    report = report_from_compiled(compiled)
+    if report is None:
+        return [Finding(
+            rule="memory.unverifiable",
+            message=(
+                "backend reports no memory_analysis() for the compiled "
+                "step — HBM NOT verified on this platform"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+        )]
+
+    findings: List[Finding] = []
+    capacity = capacity_bytes or report.device_memory_bytes
+    if predicted is not None and capacity is None:
+        capacity = predicted.capacity_bytes
+
+    if predicted is None:
+        findings.append(Finding(
+            rule="memory.unverifiable",
+            message=(
+                "target carries no analytic HBM ledger (StepTarget.hbm) "
+                "— measured breakdown attached, prediction NOT verified"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+            data={"measured": report.fields()},
+        ))
+    elif module is None or not module.entry_params:
+        findings.append(Finding(
+            rule="memory.unverifiable",
+            message=(
+                "optimized HLO could not be parsed into entry parameters "
+                "— component attribution NOT verified"
+            ),
+            site=site0, severity=SEV_INFO, target=target,
+        ))
+    else:
+        measured, unattributed = _measured_components(module, predicted)
+        table = {}
+        ok = True
+        for comp in sorted(
+            set(measured) | {c.name for c in predicted.components
+                             if not c.transient}
+        ):
+            pred_c = predicted.component(comp)
+            if pred_c is None or pred_c.transient:
+                continue
+            got = measured.get(comp, 0)
+            want = pred_c.bytes
+            table[comp] = {"predicted": want, "measured": got}
+            if got != want:
+                ok = False
+                findings.append(Finding(
+                    rule="memory.unpredicted",
+                    message=(
+                        f"component {comp!r}: predicted {want} bytes but "
+                        f"the compiled program books {got} "
+                        f"(delta {got - want:+d}) — the ledger's layout "
+                        f"arithmetic disagrees with XLA"
+                    ),
+                    site=site0, severity=SEV_ERROR, target=target,
+                    data={
+                        "component": comp, "predicted": want,
+                        "measured": got,
+                        "largest_buffers": largest_buffers(module),
+                    },
+                ))
+        if unattributed:
+            ok = False
+            findings.append(Finding(
+                rule="memory.unpredicted",
+                message=(
+                    f"{unattributed} argument bytes attribute to no "
+                    f"predicted component — the model cannot account "
+                    f"for them"
+                ),
+                site=site0, severity=SEV_ERROR, target=target,
+                data={
+                    "unattributed_bytes": unattributed,
+                    "largest_buffers": largest_buffers(module),
+                },
+            ))
+        entry_total = sum(p.nbytes for p in module.entry_params)
+        if entry_total != report.argument_bytes:
+            ok = False
+            findings.append(Finding(
+                rule="memory.unpredicted",
+                message=(
+                    f"entry parameters sum to {entry_total} bytes but "
+                    f"memory_analysis books {report.argument_bytes} "
+                    f"argument bytes — the parser is missing buffers"
+                ),
+                site=site0, severity=SEV_ERROR, target=target,
+                data={
+                    "entry_param_bytes": entry_total,
+                    "argument_bytes": report.argument_bytes,
+                },
+            ))
+        transient = max(1, predicted.transient_bytes)
+        temp_ratio = report.temp_bytes / transient
+        if temp_ratio > temp_band:
+            ok = False
+            findings.append(Finding(
+                rule="memory.unpredicted",
+                message=(
+                    f"{report.temp_bytes} temp bytes exceed the declared "
+                    f"band ({temp_band:.1f}x the {predicted.transient_bytes}"
+                    f" predicted transient bytes, ratio "
+                    f"{temp_ratio:.2f}) — an unmodeled live-range "
+                    f"dominates the step"
+                ),
+                site=site0, severity=SEV_ERROR, target=target,
+                data={
+                    "temp_bytes": report.temp_bytes,
+                    "predicted_transient_bytes": predicted.transient_bytes,
+                    "temp_band": temp_band,
+                    "largest_buffers": largest_buffers(module),
+                },
+            ))
+        if ok:
+            findings.append(Finding(
+                rule="memory.reconciled",
+                message=(
+                    f"resident components reconciled exactly "
+                    f"({len(table)} components, {entry_total} argument "
+                    f"bytes) and temps within the band "
+                    f"(ratio {temp_ratio:.2f} <= {temp_band:.1f})"
+                ),
+                site=site0, severity=SEV_INFO, target=target,
+                data={
+                    "components": table,
+                    "temp_bytes": report.temp_bytes,
+                    "temp_ratio": round(temp_ratio, 4),
+                    "predicted_peak_bytes": predicted.peak_bytes,
+                    "measured_total_bytes": report.total_bytes,
+                },
+            ))
+        if predicted.peak_bytes > report.total_bytes:
+            findings.append(Finding(
+                rule="memory.overpredicted",
+                message=(
+                    f"predicted peak {predicted.peak_bytes} exceeds the "
+                    f"measured total {report.total_bytes} by "
+                    f"{predicted.peak_bytes - report.total_bytes} bytes — "
+                    f"model pessimism (XLA aliased or rematerialized "
+                    f"booked bytes)"
+                ),
+                site=site0, severity=SEV_INFO, target=target,
+                data={
+                    "predicted_peak_bytes": predicted.peak_bytes,
+                    "measured_total_bytes": report.total_bytes,
+                },
+            ))
+
+    if capacity:
+        peak = max(
+            report.total_bytes,
+            0 if predicted is None else predicted.peak_bytes,
+        )
+        budget = (1.0 - headroom_fraction) * capacity
+        if peak > budget:
+            findings.append(Finding(
+                rule="memory.headroom",
+                message=(
+                    f"peak {peak} bytes lands within "
+                    f"{headroom_fraction:.0%} of the {capacity}-byte "
+                    f"capacity — the config fits today and OOMs on the "
+                    f"first regression"
+                ),
+                site=site0, severity=SEV_WARNING, target=target,
+                data={
+                    "peak_bytes": peak,
+                    "capacity_bytes": capacity,
+                    "headroom_fraction": headroom_fraction,
+                },
+            ))
+    return findings
+
+
+@jaxpr_pass("hlo-memory")
+def hlo_memory_pass(ctx) -> List[Finding]:
+    """The registered-pass wrapper: reuses the target's shared AOT
+    compile and parsed module, and reads the analytic prediction off
+    ``StepTarget.hbm`` (None -> ``memory.unverifiable`` info)."""
+    t = ctx.target
+    _, compiled = ctx.aot()
+    try:
+        module = ctx.hlo_module()
+    except ValueError:
+        module = None
+    return audit_memory(
+        t.fn, *t.args,
+        donate_argnums=t.donate_argnums,
+        target=ctx.name,
+        compiled=compiled,
+        module=module,
+        predicted=getattr(t, "hbm", None),
+    )

@@ -61,6 +61,16 @@ Plus the new rules this framework exists to host:
   of HLO/MLIR text parsing (its ``module_text`` helper is the one
   blessed ``.as_text`` call site), so ad-hoc regexes over compiler
   output cannot quietly rot when XLA's printer changes.
+- ``lint.memory-api`` — no raw ``.memory_stats()`` /
+  ``.memory_analysis()`` outside the blessed hbm homes:
+  ``monitor/xray/hbm/live.py`` owns the watermark probe
+  (``device_watermarks`` — the one ``memory_stats`` call site, None
+  when the backend reports nothing) and ``monitor/xray/hbm/report.py``
+  owns the compile-product account (``report_from_compiled`` — the one
+  ``memory_analysis`` call site). Scattered calls fork the
+  None-vs-fake-zero convention and bypass the record schema the HBM
+  x-ray emits; token-based like ``lint.hlo-text`` so a docstring
+  naming the API does not trip it.
 - ``lint.trace-file`` — no profiler trace-event reading outside
   ``monitor/xray/timeline/``: the ``.trace.json`` literal (the format's
   filename marker) in any string is the tell of an ad-hoc reader of
@@ -399,6 +409,42 @@ def hlo_text(ctx: LintContext) -> Iterable[Finding]:
                         "(module_text / parse_hlo_module / "
                         "realized_aliases) so HLO text parsing has one "
                         "nesting-safe home"
+                    ),
+                    site=f"{rel}:{toks[i].start[0]}",
+                    severity=SEV_ERROR,
+                )
+
+
+@lint_rule("lint.memory-api", scopes=("apex_tpu/", "examples/"))
+def memory_api(ctx: LintContext) -> Iterable[Finding]:
+    """Raw device/compile memory-API access outside the hbm package.
+
+    Token-based (the ``lint.hlo-text`` shape): keys on the NAME tokens
+    ``memory_stats`` / ``memory_analysis`` preceded by a ``.`` operator,
+    so docstrings MENTIONING the APIs (this one, the hbm package's) do
+    not trip it. The rule body spells the names as string literals for
+    the same reason."""
+    homes = {
+        "memory_stats": "apex_tpu/monitor/xray/hbm/live.py",
+        "memory_analysis": "apex_tpu/monitor/xray/hbm/report.py",
+    }
+    for rel, src in sorted(ctx.files.items()):
+        toks = ctx.tokens(src)
+        for i in range(1, len(toks)):
+            if (
+                toks[i].type == tokenize.NAME
+                and toks[i].string in homes
+                and toks[i - 1].string == "."
+            ):
+                yield Finding(
+                    rule="lint.memory-api",
+                    message=(
+                        f"raw .{toks[i].string}() outside "
+                        f"{homes[toks[i].string]} — route through the "
+                        "hbm package (device_watermarks / "
+                        "report_from_compiled) so the "
+                        "None-not-fake-number convention and the "
+                        "memory record schema have one home"
                     ),
                     site=f"{rel}:{toks[i].start[0]}",
                     severity=SEV_ERROR,

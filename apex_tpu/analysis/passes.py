@@ -178,6 +178,11 @@ class StepTarget:
     #: dtypes considered "low precision" for the precision auditor; a
     #: promotion OUT of these to f32/f64 is flagged
     low_dtypes: Tuple = (jnp.bfloat16, jnp.float16)
+    #: the analytic HBM prediction (an ``xray.hbm.model.HbmBreakdown``)
+    #: the ``hlo-memory`` differ reconciles against XLA's
+    #: ``memory_analysis()``; None disables exact reconciliation for the
+    #: target (the pass reports ``memory.unverifiable`` instead)
+    hbm: Optional[Any] = None
 
 
 class StepContext:
@@ -302,3 +307,4 @@ from apex_tpu.analysis import collectives as _collectives  # noqa: E402,F401
 from apex_tpu.analysis import host_sync as _host_sync  # noqa: E402,F401
 from apex_tpu.analysis.hlo import comms_diff as _comms_diff  # noqa: E402,F401
 from apex_tpu.analysis.hlo import sharding_audit as _sharding_audit  # noqa: E402,F401
+from apex_tpu.analysis.hlo import memory_diff as _memory_diff  # noqa: E402,F401

@@ -157,6 +157,28 @@ def gpt_step_target(mesh=None, compression=None) -> StepTarget:
         args=(params, opt_state, scaler_state, tokens, tokens),
         mesh=mesh,
         donate_argnums=(0, 1, 2),
+        hbm=_gpt_hbm_prediction(cfg, b=b, s=s, tp=2, dp=2),
+    )
+
+
+def _gpt_hbm_prediction(cfg, *, b, s, tp, dp):
+    """The analytic HBM ledger for the dp2xtp2 GPT step — built from
+    the SAME config numbers the step builder uses, so the ``hlo-memory``
+    differ reconciles a genuine closed-form prediction (params and
+    fused-Adam state digit-for-digit) against ``memory_analysis()``."""
+    from apex_tpu.monitor.xray.hbm import model as hbm_model
+
+    return hbm_model.predict_train_memory(
+        hbm_model.TransformerDims.from_config(cfg),
+        tp=tp,
+        params_dtype="float32",
+        compute_dtype="bfloat16",
+        microbatch_size=b // dp,
+        seq_len=s,
+        optimizer="fused_adam",
+        grad_scaler=True,
+        remat="none",
+        label="gpt-dp2tp2",
     )
 
 

@@ -86,11 +86,15 @@ def canon_platform(platform: str) -> str:
 
 
 def higher_is_better(metric: str) -> bool:
-    """Direction of a metric by name: times are lower-better, rates and
-    fractions higher-better."""
+    """Direction of a metric by name: times and memory footprints are
+    lower-better, rates and fractions higher-better."""
     if metric in _METRIC_FIELDS:
         return _METRIC_FIELDS[metric]
     if metric.endswith(("_ms", "_s", "_s_per_step", "_seconds")):
+        return False
+    # memory footprints (the HBM x-ray's peak_hbm_bytes and the serving
+    # KV pool's kv_pool_peak_blocks): a regression is the number GROWING
+    if metric.endswith(("_bytes", "_blocks")):
         return False
     return True
 

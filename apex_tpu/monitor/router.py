@@ -12,7 +12,14 @@ producer parsers:
 (named timer averages), the resilience kinds ("skip", "rollback",
 "rollback_restore", "halt") which predate this module and keep their
 exact historical shape — the schema was chosen to match them — the
-xray kinds ("comms", "memory", "compile"), "analysis"
+xray kinds ("comms", "compile", and "memory" — the HBM x-ray's
+per-interval records, ``scope="device"`` watermark rows from
+``device.memory_stats()`` with achieved-vs-predicted utilization and
+``scope="kv_pool"`` serving-cache occupancy/fragmentation rows, both
+from apex_tpu.monitor.xray.hbm.live; plus "oom" — ONE forensic
+incident bundle per RESOURCE_EXHAUSTED catch with the analytic
+component breakdown, largest-buffers table, and ranked knob
+suggestions, apex_tpu.monitor.xray.hbm.oom), "analysis"
 (static-auditor findings from apex_tpu.analysis: rule/site/severity
 plus the allowlist verdict), the goodput kinds ("run", "span",
 "stall", "goodput", "fleet", "bench" — apex_tpu.monitor.goodput), and
@@ -217,10 +224,13 @@ class CsvSink(Sink):
     #: fleet's request-record tags "redispatch_t" (the re-attempt's
     #: local enqueue instant) and "recovery_s" (accumulated failover
     #: envelope seconds), which joined with the request x-ray
-    #: (apex_tpu.serving.trace).
+    #: (apex_tpu.serving.trace) — and the HBM x-ray's
+    #: "peak_hbm_bytes"/"hbm_utilization" (the watermark monitor's
+    #: ``metrics_fields()``, monitor.xray.hbm.live), merged into the
+    #: metrics record the same way remediation's gauges are.
     TOLERATED_EXTRA_KEYS = frozenset({
         "host", "data_skipped", "probation", "remediation_cases",
-        "redispatch_t", "recovery_s",
+        "redispatch_t", "recovery_s", "peak_hbm_bytes", "hbm_utilization",
     })
 
     def __init__(self, path: str, kinds=("metrics",)):
@@ -278,13 +288,21 @@ class StdoutSink(Sink):
     resilience.remediation) is skipped for the incident reason: each
     record attaches its triggering evidence records wholesale, far too
     large for a one-liner — the controller logs compact action lines
-    and the file sinks carry the case history. The ``host`` field is
-    likewise plumbing and never rendered.
+    and the file sinks carry the case history. "memory" (the HBM
+    x-ray's per-interval watermark and KV-pool rows,
+    monitor.xray.hbm.live) is skipped for the per-interval-firehose
+    reason — the examples print their own achieved-vs-predicted banner
+    and the jsonl stream is the durable home — and "oom" for the
+    incident reason: the bundle carries the full component breakdown
+    and largest-buffers table, and the guard logs its own compact
+    error line. The ``host`` field is likewise plumbing and never
+    rendered.
     """
 
     def __init__(self, stream=None,
                  skip_kinds=("span", "run", "incident", "journal",
-                             "request", "remediation", "trace", "slo")):
+                             "request", "remediation", "trace", "slo",
+                             "memory", "oom")):
         self.stream = stream or sys.stdout
         self.skip_kinds = frozenset(skip_kinds or ())
 

@@ -13,10 +13,15 @@ program itself, all emitting through the same MetricRouter record schema
   per-dimension comms accounting as a production feature; EQuARX shows
   XLA collective cost is the dominant scaling lever — this measures ours
   before anyone optimizes it.
-- ``memory``        — :func:`memory_report`: XLA's own HBM breakdown
-  (args / outputs / temps / generated code) of a jitted step vs device
-  capacity (``kind="memory"`` records) — the OOM that kills the run, on
-  the startup banner instead.
+- ``hbm``           — the HBM x-ray (``hbm/``): the jax-free analytic
+  peak-memory ledger (:func:`predict_fits` feasibility oracle), the one
+  blessed home of :func:`memory_report` / ``memory_analysis()``
+  (``hbm/report.py``) and ``device.memory_stats()`` watermark sampling
+  (``hbm/live.py``, ``kind="memory"`` records incl. serving KV-pool
+  occupancy), plus ``RESOURCE_EXHAUSTED`` forensics (``hbm/oom.py``,
+  ``kind="oom"`` incident bundles). ``memory`` is the compat re-export
+  of the one-shot report — the OOM that kills the run, on the startup
+  banner instead.
 - ``compile_watch`` — :class:`CompileWatcher`: compiles and
   compile-seconds per step (``kind="compile"`` records), warning loudly
   on a post-warmup recompile — the classic silent 10x throughput killer.
@@ -46,7 +51,7 @@ _EXPORTS = {
     "axis_size": "ledger",
     "record": "ledger",
     "ici_bandwidth_per_device": "ledger",
-    # XLA memory reports
+    # XLA memory reports (compat path; canonical home is hbm/)
     "MemoryReport": "memory",
     "memory_report": "memory",
     "device_memory_limit": "memory",
@@ -55,7 +60,7 @@ _EXPORTS = {
 }
 
 __all__ = sorted(_EXPORTS) + [
-    "ledger", "memory", "compile_watch", "timeline",
+    "hbm", "ledger", "memory", "compile_watch", "timeline",
 ]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)

@@ -1,0 +1,70 @@
+"""The HBM x-ray: predict -> confirm -> measure for device memory.
+
+- ``model``  — jax-free analytic ledger (:class:`HbmBreakdown`,
+  :func:`predict_fits`): closed-form per-device peak prediction.
+- ``report`` — the one blessed ``compiled.memory_analysis()`` home
+  (:func:`memory_report`, :func:`report_from_compiled`).
+- ``live``   — the one blessed ``device.memory_stats()`` home:
+  watermark sampling, ``kind="memory"`` records, KV-pool occupancy.
+- ``oom``    — ``RESOURCE_EXHAUSTED`` forensics: the ``kind="oom"``
+  incident bundle and its jax-free reader.
+
+Lazy exports (PEP 562) so ``import apex_tpu.monitor.xray.hbm`` — and
+the jax-free ``model``/``oom`` halves — never initialize jax; only
+touching ``report``/``live`` device functionality does.
+"""
+
+import importlib
+
+_EXPORTS = {
+    # model.py — jax-free analytic ledger
+    "Component": "model",
+    "HbmBreakdown": "model",
+    "TransformerDims": "model",
+    "StashDepth": "model",
+    "FitVerdict": "model",
+    "gpt_param_elements": "model",
+    "adam_state_bytes": "model",
+    "zero_padded_total": "model",
+    "zero_shard_elements": "model",
+    "distributed_adam_state_bytes": "model",
+    "stash_depth": "model",
+    "activation_stash_bytes": "model",
+    "kv_pool_bytes": "model",
+    "predict_train_memory": "model",
+    "predict_serving_memory": "model",
+    "predict_fits": "model",
+    # report.py — compiled-program breakdown
+    "MemoryReport": "report",
+    "memory_report": "report",
+    "report_from_compiled": "report",
+    # live.py — runtime watermarks
+    "device_watermarks": "live",
+    "device_memory_limit": "live",
+    "HbmWatermarkMonitor": "live",
+    "kv_pool_fields": "live",
+    # oom.py — forensics
+    "is_oom_error": "oom",
+    "suggest_knobs": "oom",
+    "oom_record": "oom",
+    "OomIncident": "oom",
+    "read_oom_records": "oom",
+    "oom_guard": "oom",
+}
+
+__all__ = sorted(_EXPORTS) + ["live", "model", "oom", "report"]
+
+_SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        mod = importlib.import_module(f"{__name__}.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    if name in _SUBMODULES:
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,222 @@
+"""Live HBM watermarks: the measure leg of the HBM x-ray.
+
+``model.py`` predicts, ``analysis/hlo/memory_diff.py`` confirms at
+compile time; this module samples what the allocator ACTUALLY holds at
+runtime — ``device.memory_stats()`` (this module is the one blessed
+call site, fenced by ``lint.memory-api``) emitted as ``kind="memory"``
+records through the MetricRouter, with the per-step peak joined against
+the analytic prediction.
+
+CPU caveat (docs/observability.md): host backends report no allocator
+stats, so watermarks are ``None`` — achieved-vs-predicted utilization
+is reported as ``None``, never a fake number. Records still flow so
+the join's absence is visible in the stream, not silently skipped.
+
+:class:`HbmWatermarkMonitor` follows the ``goodput/live.LiveFleetMonitor``
+cadence contract (anchor on first call, then every ``interval_steps``);
+a headroom breach emits a ``headroom_breach=True`` record — the
+detector finding the remediation controller opens a ``memory`` case on
+— and a ``logger.warning``. The serving engine reuses the same record
+kind for KV-pool occupancy via :func:`kv_pool_fields` (jax-free, pure
+allocator arithmetic).
+"""
+
+import logging
+from typing import Optional
+
+__all__ = [
+    "device_watermarks",
+    "device_memory_limit",
+    "HbmWatermarkMonitor",
+    "kv_pool_fields",
+]
+
+logger = logging.getLogger(__name__)
+
+
+def device_watermarks(device) -> Optional[dict]:
+    """Allocator watermarks for one device: ``{"bytes_in_use",
+    "peak_bytes_in_use", "bytes_limit"}`` (values may be None when the
+    backend omits a field), or None when the backend reports no stats
+    at all (CPU)."""
+    try:
+        stats = device.memory_stats() or {}
+    except NotImplementedError:
+        stats = {}
+    if not stats:
+        return None
+    return {
+        "bytes_in_use": stats.get("bytes_in_use"),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        "bytes_limit": stats.get("bytes_limit"),
+    }
+
+
+def device_memory_limit(device=None) -> Optional[int]:
+    """Usable device memory in bytes (allocator ``bytes_limit``), or
+    None when the backend does not report it (CPU)."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    wm = device_watermarks(device)
+    return None if wm is None else wm.get("bytes_limit")
+
+
+class HbmWatermarkMonitor:
+    """Per-interval watermark sampling joined against the prediction.
+
+    ``predicted`` is an ``hbm.model.HbmBreakdown`` (or None — the
+    monitor still samples, utilization just stays None);
+    ``capacity_bytes`` overrides the allocator's ``bytes_limit`` when
+    given (virtual-topology rehearsals). A sample whose bytes-in-use
+    exceed ``(1 - headroom_fraction) * capacity`` is a breach: the
+    record carries ``headroom_breach=True`` and the monitor logs a
+    warning. ``metrics_fields()`` exposes the newest sample as metric
+    gauges (``peak_hbm_bytes``, ``hbm_utilization``) for merging into
+    ``router.metrics`` calls — the keys ``CsvSink`` tolerates.
+    """
+
+    def __init__(self, router, *, interval_steps: int = 50, predicted=None,
+                 capacity_bytes: Optional[int] = None,
+                 headroom_fraction: float = 0.1, device=None):
+        if interval_steps < 1:
+            raise ValueError(
+                f"interval_steps must be >= 1, got {interval_steps}"
+            )
+        if not (0.0 <= headroom_fraction < 1.0):
+            raise ValueError(
+                f"headroom_fraction must be in [0, 1), got "
+                f"{headroom_fraction}"
+            )
+        self.router = router
+        self.interval_steps = interval_steps
+        self.predicted = predicted
+        self.capacity_bytes = capacity_bytes
+        self.headroom_fraction = headroom_fraction
+        self._device = device
+        self._last_check: Optional[int] = None
+        self.last_sample: Optional[dict] = None
+        self.breaches = 0
+
+    def _resolve_device(self):
+        if self._device is None:
+            import jax
+
+            self._device = jax.local_devices()[0]
+        return self._device
+
+    def sample(self, step: int) -> dict:
+        """Sample now, emit one ``kind="memory"`` record, return its
+        fields. None fields mean the backend reports no stats (CPU)."""
+        wm = device_watermarks(self._resolve_device())
+        bytes_in_use = peak = limit = None
+        if wm is not None:
+            bytes_in_use = wm.get("bytes_in_use")
+            peak = wm.get("peak_bytes_in_use")
+            limit = wm.get("bytes_limit")
+        capacity = self.capacity_bytes if self.capacity_bytes else limit
+        predicted_peak = (
+            None if self.predicted is None else self.predicted.peak_bytes
+        )
+        utilization = None
+        if peak is not None and predicted_peak:
+            utilization = peak / predicted_peak
+        breach = False
+        watermark = peak if peak is not None else bytes_in_use
+        if watermark is not None and capacity:
+            breach = watermark > (1.0 - self.headroom_fraction) * capacity
+        fields = {
+            "scope": "device",
+            "bytes_in_use": bytes_in_use,
+            "peak_bytes_in_use": peak,
+            "capacity_bytes": capacity,
+            "predicted_peak_bytes": predicted_peak,
+            "utilization": utilization,
+            "headroom_breach": breach,
+        }
+        self.router.event("memory", step, **fields)
+        self.last_sample = fields
+        if breach:
+            self.breaches += 1
+            logger.warning(
+                "HBM headroom breach at step %d: %d bytes in use vs "
+                "%d capacity (required free fraction %.2f)",
+                step, watermark, capacity, self.headroom_fraction,
+            )
+        return fields
+
+    def maybe_sample(self, step: int) -> Optional[dict]:
+        """Sample on the monitor's cadence (anchor on first call, like
+        ``LiveFleetMonitor.maybe_check``)."""
+        if self._last_check is None:
+            self._last_check = step
+            return None
+        if step - self._last_check < self.interval_steps:
+            return None
+        self._last_check = step
+        return self.sample(step)
+
+    def metrics_fields(self) -> dict:
+        """Newest sample as metric gauges; empty on CPU (None is never
+        forged into a number)."""
+        out = {}
+        if self.last_sample:
+            peak = self.last_sample.get("peak_bytes_in_use")
+            if peak is not None:
+                out["peak_hbm_bytes"] = peak
+            util = self.last_sample.get("utilization")
+            if util is not None:
+                out["hbm_utilization"] = util
+        return out
+
+    def summary(self) -> dict:
+        """End-of-run achieved-vs-predicted join for the examples'
+        closing banner."""
+        peak = util = None
+        if self.last_sample:
+            peak = self.last_sample.get("peak_bytes_in_use")
+            util = self.last_sample.get("utilization")
+        return {
+            "predicted_peak_bytes": (
+                None if self.predicted is None else self.predicted.peak_bytes
+            ),
+            "achieved_peak_bytes": peak,
+            "utilization": util,
+            "breaches": self.breaches,
+        }
+
+
+def kv_pool_fields(*, num_blocks: int, free_blocks: int, block_size: int,
+                   live_tokens: int,
+                   peak_used_blocks: Optional[int] = None) -> dict:
+    """KV block-pool occupancy + internal fragmentation as
+    ``kind="memory"`` record fields (jax-free; the serving engine calls
+    this from ``tick()``).
+
+    ``live_tokens`` is the sum of in-flight sequence positions;
+    fragmentation is the fraction of RESERVED pool capacity holding no
+    live token (tail waste of partially-filled blocks) — the number the
+    prefix-aware placer needs to distinguish "full" from "fragmented".
+    """
+    used = num_blocks - free_blocks
+    if used < 0:
+        raise ValueError(
+            f"free_blocks {free_blocks} exceeds num_blocks {num_blocks}"
+        )
+    reserved_tokens = used * block_size
+    fragmentation = 0.0
+    if reserved_tokens:
+        fragmentation = max(0.0, 1.0 - live_tokens / reserved_tokens)
+    fields = {
+        "scope": "kv_pool",
+        "num_blocks": num_blocks,
+        "used_blocks": used,
+        "free_blocks": free_blocks,
+        "occupancy": used / num_blocks if num_blocks else 0.0,
+        "live_tokens": live_tokens,
+        "fragmentation": fragmentation,
+    }
+    if peak_used_blocks is not None:
+        fields["kv_pool_peak_blocks"] = peak_used_blocks
+    return fields

@@ -1,0 +1,214 @@
+"""OOM forensics: turn ``RESOURCE_EXHAUSTED`` into one explainable record.
+
+An OOM without context is the worst failure mode in the fleet: the
+process dies with an allocator stack trace and no statement of WHAT was
+resident. This module catches the error at the blessed compile/execute
+boundaries (:func:`oom_guard` — the examples' ``--xray-hbm`` step loop
+and the hbm report path) and emits exactly ONE ``kind="oom"``
+incident-bundle-style record carrying:
+
+- the analytic component breakdown (``model.HbmBreakdown``) that
+  predicted the step's footprint,
+- the differ's largest-buffers table (HLO entry-param attribution),
+- concrete knob suggestions naming REAL repo knobs (``--micro-batch``,
+  remat policy, ``param_gather_buckets``, serving ``num_blocks``),
+  ranked by which component dominates the prediction.
+
+jax-free by design: the record reader (:func:`read_oom_records`) must
+run on the analysis box that holds only the jsonl, and the record
+builder itself allocates nothing — it is called while the device is
+full. Timestamps come from ``router.make_record`` (the blessed clock).
+"""
+
+import contextlib
+import dataclasses
+import json
+import logging
+from typing import Iterable, List, Optional
+
+from apex_tpu.monitor.router import make_record
+
+__all__ = [
+    "OOM_MARKERS",
+    "is_oom_error",
+    "suggest_knobs",
+    "oom_record",
+    "OomIncident",
+    "read_oom_records",
+    "oom_guard",
+]
+
+logger = logging.getLogger(__name__)
+
+#: substrings that identify an allocator exhaustion in the error text —
+#: XLA raises ``XlaRuntimeError("RESOURCE_EXHAUSTED: ...")``; matching
+#: on text keeps the detector importable without jax.
+OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True when ``exc`` reads as a device-memory exhaustion."""
+    text = f"{type(exc).__name__}: {exc}"
+    return any(marker in text for marker in OOM_MARKERS)
+
+
+def suggest_knobs(breakdown=None) -> List[dict]:
+    """Concrete remediation knobs, dominant component first.
+
+    Every ``knob`` names something that exists in this repo: the
+    examples' ``--micro-batch`` flag, the remat policy of the analytic
+    stash model, ``distributed_fused_adam(param_gather_buckets=...)``,
+    ``ServingConfig.num_blocks``, and tensor parallelism. With a
+    breakdown the list is ranked by the component actually dominating
+    the predicted peak; without one it falls back to the generic
+    ordering (microbatch first — the cheapest knob).
+    """
+    generic = [
+        {
+            "knob": "--micro-batch",
+            "action": "halve the per-device microbatch size",
+            "component": "activation_stash",
+        },
+        {
+            "knob": "remat",
+            "action": "deepen rematerialization "
+                      "(remat='selective' -> 'full')",
+            "component": "activation_stash",
+        },
+        {
+            "knob": "param_gather_buckets",
+            "action": "raise distributed_fused_adam param_gather_buckets "
+                      "so gathers stream in smaller buckets",
+            "component": "optimizer_state",
+        },
+        {
+            "knob": "num_blocks",
+            "action": "shrink the serving KV pool (ServingConfig.num_blocks)",
+            "component": "kv_pool",
+        },
+        {
+            "knob": "tensor_model_parallel_size",
+            "action": "shard weights wider (raise tp)",
+            "component": "weights",
+        },
+    ]
+    if breakdown is None:
+        return generic
+    ranked = sorted(
+        breakdown.components, key=lambda c: c.bytes, reverse=True
+    )
+    order = {c.name: i for i, c in enumerate(ranked)}
+    return sorted(
+        generic, key=lambda s: order.get(s["component"], len(order))
+    )
+
+
+def oom_record(step: int, error, *, phase: str = "execute",
+               breakdown=None, largest_buffers=None,
+               capacity_bytes: Optional[int] = None) -> dict:
+    """The ONE ``kind="oom"`` incident bundle for a memory exhaustion.
+
+    ``breakdown`` is the analytic ``HbmBreakdown`` (optional — an OOM
+    with no prediction still gets generic knob suggestions);
+    ``largest_buffers`` is the differ's attribution table
+    (``[{"name", "bytes"}, ...]``, largest first).
+    """
+    fields = {
+        "phase": phase,
+        "error": str(error)[:500],
+        "suggestions": suggest_knobs(breakdown),
+        "capacity_bytes": capacity_bytes,
+        "predicted_peak_bytes": (
+            None if breakdown is None else breakdown.peak_bytes
+        ),
+        "components": (
+            {} if breakdown is None
+            else {c.name: int(c.bytes) for c in breakdown.components}
+        ),
+        "largest_buffers": list(largest_buffers or ()),
+    }
+    return make_record("oom", step, **fields)
+
+
+@dataclasses.dataclass(frozen=True)
+class OomIncident:
+    """A parsed ``kind="oom"`` record (the jax-free reader's view)."""
+
+    step: int
+    phase: str
+    error: str
+    predicted_peak_bytes: Optional[int]
+    capacity_bytes: Optional[int]
+    components: dict
+    largest_buffers: tuple
+    suggestions: tuple
+
+    @property
+    def dominant_component(self) -> Optional[str]:
+        if not self.components:
+            return None
+        return max(self.components, key=self.components.get)
+
+    def suggested_knobs(self) -> List[str]:
+        return [s.get("knob", "") for s in self.suggestions]
+
+
+def read_oom_records(records: Iterable) -> List[OomIncident]:
+    """Parse ``kind="oom"`` records out of a record/jsonl-line stream.
+
+    Accepts dicts or json strings mixed with other kinds (hand it a
+    whole jsonl file's lines); anything that is not an oom record is
+    skipped. jax-free — pin-tested with jax poisoned out of
+    ``sys.modules``.
+    """
+    out: List[OomIncident] = []
+    for rec in records:
+        if isinstance(rec, (str, bytes)):
+            line = rec.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+        if not isinstance(rec, dict) or rec.get("kind") != "oom":
+            continue
+        out.append(
+            OomIncident(
+                step=int(rec.get("step", -1)),
+                phase=rec.get("phase", ""),
+                error=rec.get("error", ""),
+                predicted_peak_bytes=rec.get("predicted_peak_bytes"),
+                capacity_bytes=rec.get("capacity_bytes"),
+                components=dict(rec.get("components") or {}),
+                largest_buffers=tuple(rec.get("largest_buffers") or ()),
+                suggestions=tuple(rec.get("suggestions") or ()),
+            )
+        )
+    return out
+
+
+@contextlib.contextmanager
+def oom_guard(router, step: int, *, phase: str = "execute",
+              breakdown=None, largest_buffers=None,
+              capacity_bytes: Optional[int] = None):
+    """Wrap a blessed compile/execute boundary: on a resource
+    exhaustion, emit exactly one ``kind="oom"`` record through
+    ``router`` and re-raise (the guard explains the failure; it never
+    swallows it). Non-OOM exceptions pass through untouched."""
+    try:
+        yield
+    except Exception as exc:
+        if is_oom_error(exc):
+            rec = oom_record(
+                step, exc, phase=phase, breakdown=breakdown,
+                largest_buffers=largest_buffers,
+                capacity_bytes=capacity_bytes,
+            )
+            router.emit(rec)
+            logger.error(
+                "OOM at step %d (%s): %s — suggestions: %s",
+                step, phase, str(exc)[:120],
+                ", ".join(s["knob"] for s in rec["suggestions"][:3]),
+            )
+        raise

@@ -1,0 +1,134 @@
+"""XLA memory reports: one source of truth for the lower/compile dance.
+
+``compiled.memory_analysis()`` is XLA's own account of a program's HBM:
+argument buffers, output buffers, the live-temporary high-water mark
+(the quantity an OOM is about), and generated code. Everything in the
+repo that wants it — the pipeline-memory benchmark, the
+``--xray-report`` startup banner, the ``hlo-memory`` differ, tests
+asserting memory bounds — goes through :func:`memory_report` /
+:func:`report_from_compiled` instead of hand-rolling
+``.lower().compile().memory_analysis()``; this module is the one
+blessed ``memory_analysis()`` call site (fenced by ``lint.memory-api``;
+``xray/memory.py`` is the compat re-export).
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax
+
+from apex_tpu.monitor.xray.hbm.live import device_memory_limit
+
+__all__ = ["MemoryReport", "memory_report", "report_from_compiled"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryReport:
+    """HBM breakdown of one compiled program (bytes, from XLA).
+
+    ``device_memory_bytes`` is the chip's capacity (None off-TPU), and
+    ``headroom_bytes`` what remains after this program's peak footprint —
+    negative means the compile will not fit and the run dies at the first
+    step, which is exactly what the startup banner exists to say BEFORE
+    the step runs.
+    """
+
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    generated_code_bytes: int
+    alias_bytes: int = 0
+    device_memory_bytes: Optional[int] = None
+
+    @property
+    def total_bytes(self) -> int:
+        """Peak footprint: args + outputs + temps + code, minus buffers
+        XLA aliases between args and outputs (donation)."""
+        return (
+            self.argument_bytes + self.output_bytes + self.temp_bytes
+            + self.generated_code_bytes - self.alias_bytes
+        )
+
+    @property
+    def headroom_bytes(self) -> Optional[int]:
+        if self.device_memory_bytes is None:
+            return None
+        return self.device_memory_bytes - self.total_bytes
+
+    def fields(self) -> dict:
+        """Flat payload for a ``kind="memory"`` MetricRouter record."""
+        return {
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "alias_bytes": self.alias_bytes,
+            "total_bytes": self.total_bytes,
+            "device_memory_bytes": self.device_memory_bytes,
+            "headroom_bytes": self.headroom_bytes,
+        }
+
+    def format(self) -> str:
+        mib = 2**20
+
+        def f(v):
+            return "?" if v is None else f"{v / mib:.2f} MiB"
+
+        lines = [
+            "memory report (per device):",
+            f"  arguments:      {f(self.argument_bytes)}",
+            f"  outputs:        {f(self.output_bytes)}",
+            f"  temporaries:    {f(self.temp_bytes)}",
+            f"  generated code: {f(self.generated_code_bytes)}",
+            f"  aliased (args<->outputs): {f(self.alias_bytes)}",
+            f"  peak total:     {f(self.total_bytes)}",
+        ]
+        if self.device_memory_bytes is not None:
+            lines.append(
+                f"  device memory:  {f(self.device_memory_bytes)} "
+                f"(headroom {f(self.headroom_bytes)})"
+            )
+        return "\n".join(lines)
+
+
+def report_from_compiled(compiled, device=None) -> Optional[MemoryReport]:
+    """The HBM breakdown of an already-compiled executable, or None on
+    backends whose compiler reports no memory analysis. This is the one
+    ``memory_analysis()`` call in the repo — reuse a shared compile
+    (e.g. ``StepContext.aot()``) instead of paying a second one."""
+    analysis = compiled.memory_analysis()
+    if analysis is None:
+        return None
+    return MemoryReport(
+        argument_bytes=int(analysis.argument_size_in_bytes),
+        output_bytes=int(analysis.output_size_in_bytes),
+        temp_bytes=int(analysis.temp_size_in_bytes),
+        generated_code_bytes=int(analysis.generated_code_size_in_bytes),
+        alias_bytes=int(getattr(analysis, "alias_size_in_bytes", 0) or 0),
+        device_memory_bytes=device_memory_limit(device),
+    )
+
+
+def memory_report(fn, *args, device=None, **kwargs) -> MemoryReport:
+    """Compile ``fn(*args, **kwargs)`` and return its HBM breakdown.
+
+    ``fn`` may be a plain function (it is jitted here) or an
+    already-jitted one. COST: this pays a real XLA compile — and on jax
+    0.4.x the AOT ``.lower().compile()`` result does NOT land in the jit
+    dispatch cache, so a subsequent ordinary call compiles the same
+    program again (measured on 0.4.37; newer jax shares more of the
+    pipeline). The breakdown is usually worth one extra compile at
+    startup — it is the banner that says the step will not fit BEFORE
+    the run dies — but budget for it on large models. Raises
+    RuntimeError on backends whose compiler reports no memory analysis.
+    """
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    report = report_from_compiled(
+        jfn.lower(*args, **kwargs).compile(), device=device
+    )
+    if report is None:
+        raise RuntimeError(
+            "this backend's compiled executable reports no "
+            "memory_analysis(); xray.memory_report cannot run here"
+        )
+    return report

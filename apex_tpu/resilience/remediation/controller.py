@@ -86,9 +86,12 @@ __all__ = [
 #: ``slo`` is the serving burn-rate monitor's stream (trace/slo.py):
 #: only records with ``alert=True`` open a case — the monitor emits
 #: window summaries continuously, and a healthy window is evidence the
-#: check ran, not a finding.
+#: check ran, not a finding. ``memory`` is the HBM x-ray's watermark
+#: stream (monitor.xray.hbm.live) under the same contract: only
+#: ``headroom_breach=True`` rows open a case.
 DETECTOR_KINDS = frozenset({
     "fleet", "stall", "skip", "rollback", "halt", "divergence", "slo",
+    "memory",
 })
 
 #: evidence records kept verbatim per case (the rest are counted — a
@@ -303,6 +306,14 @@ class RemediationController:
                 if not record.get("alert"):
                     return None
                 case_kind, suspect = "slo", None
+            elif kind == "memory":
+                # per-interval watermark rows flow continuously (the
+                # HBM x-ray's live monitor); only a headroom breach —
+                # the watermark inside the guard band of capacity — is
+                # a finding, and repeat breaches attach as evidence
+                if not record.get("headroom_breach"):
+                    return None
+                case_kind, suspect = "memory", None
             else:  # divergence: the bisector's forensic verdict
                 if not record.get("found"):
                     return None

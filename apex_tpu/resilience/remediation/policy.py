@@ -27,6 +27,7 @@ incident       exit-43 adoption (supervisor ``pending``)     restart
 preemption     SIGTERM termination (``on_preemption``)       restart
 halt           ``kind="halt"`` (escalation ladder exhausted) escalate
 slo            ``kind="slo"`` ``alert=True`` (burn monitor)  observe
+memory         ``kind="memory"`` ``headroom_breach=True``    observe
 =============  ============================================  ==========
 
 Responses:
@@ -81,7 +82,7 @@ __all__ = [
 #: every detector finding the controller opens a case for
 CASE_KINDS = (
     "straggler", "corruption", "stall", "sentinel", "sdc",
-    "incident", "preemption", "halt", "slo",
+    "incident", "preemption", "halt", "slo", "memory",
 )
 
 #: the closed response vocabulary (module docstring)
@@ -160,6 +161,12 @@ _DEFAULT_RESPONSES: Dict[str, str] = {
     # clears — restarting replicas on a demand spike would convert
     # badput into MORE badput
     "slo": "observe",
+    # an HBM headroom breach (the x-ray watermark monitor,
+    # monitor.xray.hbm.live) is likewise a symptom: restarting cannot
+    # shrink a footprint the config books — the case tracks whether
+    # the watermark recedes, and the FIX is a knob change (the OOM
+    # forensics' suggestions), a human decision
+    "memory": "observe",
 }
 
 

@@ -112,6 +112,9 @@ class ServingConfig:
     compiled sort/cumsum); per-request ``temperature`` is traced.
     ``collect_logits`` keeps each request's per-step next-token logits
     on the host (tests/debug; a per-tick vocab-sized fetch).
+    ``memory_interval_ticks`` is the cadence of the HBM x-ray's
+    ``kind="memory"`` KV-pool records (occupancy + fragmentation,
+    monitor.xray.hbm.live.kv_pool_fields); None disables them.
     """
 
     lanes: int = 4
@@ -127,6 +130,7 @@ class ServingConfig:
     top_p: Optional[float] = None
     seed: int = 0
     collect_logits: bool = False
+    memory_interval_ticks: Optional[int] = 50
 
     def __post_init__(self):
         if self.lanes < 1:
@@ -152,6 +156,11 @@ class ServingConfig:
             raise ValueError(
                 f"max_prefills_per_tick must be >= 1, got "
                 f"{self.max_prefills_per_tick}")
+        if (self.memory_interval_ticks is not None
+                and self.memory_interval_ticks < 1):
+            raise ValueError(
+                f"memory_interval_ticks must be >= 1 or None, got "
+                f"{self.memory_interval_ticks}")
         buckets = self.prefill_buckets
         if buckets is None:
             buckets, b = [], self.block_size
@@ -683,6 +692,23 @@ class ServingEngine:
                     "serving steady-state compile at tick %d — a shape "
                     "escaped the AOT buckets", t,
                 )
+        interval = self.config.memory_interval_ticks
+        if (self.router is not None and interval is not None
+                and t % interval == 0):
+            # the HBM x-ray's serving half: KV-pool occupancy +
+            # fragmentation on the same kind="memory" stream the
+            # training watermark monitor writes (hbm/live.py)
+            from apex_tpu.monitor.xray.hbm.live import kv_pool_fields
+
+            self.router.event("memory", t, **kv_pool_fields(
+                num_blocks=self.allocator.num_blocks,
+                free_blocks=self.allocator.free_blocks,
+                block_size=self.config.block_size,
+                live_tokens=sum(
+                    int(self._positions[lane]) for lane in self._active
+                ),
+                peak_used_blocks=self.allocator.peak_used_blocks,
+            ))
         self._tick += 1
         return t
 
@@ -1044,4 +1070,5 @@ class ServingEngine:
             "ticks": self._tick,
             "steady_state_compiles": self._steady_compiles,
             "free_blocks": self.allocator.free_blocks,
+            "kv_pool_peak_blocks": self.allocator.peak_used_blocks,
         }

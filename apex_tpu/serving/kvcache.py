@@ -67,6 +67,10 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
         self._allocated: set = set()
+        #: high-water mark of simultaneously-booked blocks over the
+        #: allocator's lifetime — the serving half of the HBM x-ray's
+        #: footprint accounting (``kv_pool_peak_blocks`` bench twin)
+        self.peak_used_blocks = 0
 
     @property
     def free_blocks(self) -> int:
@@ -88,6 +92,8 @@ class BlockAllocator:
             return None
         ids = tuple(self._free.pop() for _ in range(n))
         self._allocated.update(ids)
+        self.peak_used_blocks = max(self.peak_used_blocks,
+                                    self.used_blocks)
         return ids
 
     def free(self, ids) -> None:

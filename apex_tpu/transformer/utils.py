@@ -105,15 +105,18 @@ def get_ltor_masks_and_position_ids(
 
 
 def report_memory(name: str) -> str:
-    """(ref :253) — per-device live/peak bytes from jax memory stats."""
+    """(ref :253) — per-device live/peak bytes via the blessed
+    ``xray.hbm.live`` watermark probe (CPU reports no stats -> 0.0)."""
+    from apex_tpu.monitor.xray.hbm.live import device_watermarks
+
     mb = 1024.0 * 1024.0
     parts = [f"{name} memory (MB)"]
     for d in jax.local_devices():
-        stats = d.memory_stats() or {}
+        wm = device_watermarks(d) or {}
         parts.append(
             f"| {d.platform}:{d.id} in_use: "
-            f"{stats.get('bytes_in_use', 0) / mb:.1f} peak: "
-            f"{stats.get('peak_bytes_in_use', 0) / mb:.1f}"
+            f"{(wm.get('bytes_in_use') or 0) / mb:.1f} peak: "
+            f"{(wm.get('peak_bytes_in_use') or 0) / mb:.1f}"
         )
     s = " ".join(parts)
     print(s, flush=True)

@@ -53,6 +53,7 @@ CPU smoke (8 virtual devices, synthetic corpus):
 """
 
 import argparse
+import contextlib
 import os
 import tempfile
 import time
@@ -233,6 +234,13 @@ def parse_args():
                    help="startup banner: XLA memory breakdown of the "
                         "compiled step vs device headroom (kind='memory' "
                         "record)")
+    p.add_argument("--xray-hbm", action="store_true",
+                   help="HBM x-ray (monitor.xray.hbm): analytic "
+                        "per-device breakdown banner reconciled against "
+                        "XLA's memory_analysis at startup, live "
+                        "kind='memory' watermark records on the metrics "
+                        "cadence, and kind='oom' forensics on resource "
+                        "exhaustion")
     p.add_argument("--xray-comms", action="store_true",
                    help="startup banner + periodic kind='comms' records: "
                         "per-axis collective bytes/step and ICI roofline "
@@ -314,8 +322,10 @@ def main():
             sinks.append(tb)
     # in-process window of the stream so the end-of-run goodput summary
     # accounts THIS run without re-reading (or requiring) a jsonl file;
-    # kinds-filtered so metrics/timer traffic doesn't evict the spans
-    goodput_mem = monitor.MemorySink(kinds=("run", "span"))
+    # kinds-filtered so metrics/timer traffic doesn't evict the spans.
+    # "memory" (the HBM x-ray's interval watermarks, light traffic) rides
+    # in the same window so tests can read the records back in-process
+    goodput_mem = monitor.MemorySink(kinds=("run", "span", "memory"))
     # unfiltered short window for the incident ladder's forensic bundle:
     # the record tail a kind="incident" dump quotes (what the run looked
     # like as it wedged — metrics, spans, anomalies alike). Only wired
@@ -621,6 +631,60 @@ def main():
         report = monitor.xray.memory_report(train_step, *step_args)
         print(report.format(), flush=True)
         router.event("memory", step0, **report.fields())
+    hbm_mon = None
+    hbm_predicted = None
+    if args.xray_hbm:
+        # HBM x-ray (monitor.xray.hbm, docs/observability.md "HBM
+        # x-ray"): the analytic ledger's closed-form per-device
+        # breakdown first — an infeasible config is explained in
+        # arithmetic before any compile — then XLA's own account of the
+        # compiled step joined against it (pays the same extra AOT
+        # compile --xray-report does; combine the flags freely, each
+        # compile is independent)
+        from apex_tpu.monitor.xray import hbm as xhbm
+
+        hbm_predicted = xhbm.predict_train_memory(
+            xhbm.TransformerDims.from_config(training.transformer_config),
+            tp=args.tp,
+            microbatch_size=args.micro_batch,
+            seq_len=args.seq_len,
+            optimizer=("distributed_fused_adam" if args.zero
+                       else "fused_adam"),
+            zero_axis_size=dp if args.zero else None,
+            error_feedback=args.zero and args.compression != "none",
+            grad_scaler=True,
+            remat="none",
+            compression_wire_dtype=(
+                None if args.compression == "none"
+                else {"int8": "int8", "fp8": "float8_e4m3fn"}[
+                    args.compression]
+            ),
+            label="gpt-pretrain",
+        )
+        print(hbm_predicted.format(), flush=True)
+        try:
+            hbm_report = monitor.xray.memory_report(train_step, *step_args)
+        except RuntimeError as e:
+            # the flag exists to VERIFY; a backend with no memory
+            # analysis must not print ok (the --audit-comms hardening)
+            raise SystemExit(f"hbm x-ray failed: {e}")
+        achieved = hbm_report.total_bytes
+        print(
+            f"hbm x-ray: predicted peak "
+            f"{hbm_predicted.peak_bytes / 2**20:.1f} MiB vs compiled "
+            f"total {achieved / 2**20:.1f} MiB "
+            f"(x{achieved / max(1, hbm_predicted.peak_bytes):.2f})",
+            flush=True,
+        )
+        router.event(
+            "memory", step0, scope="compiled",
+            predicted_peak_bytes=hbm_predicted.peak_bytes,
+            **hbm_report.fields(),
+        )
+        hbm_mon = xhbm.HbmWatermarkMonitor(
+            router, interval_steps=args.log_interval,
+            predicted=hbm_predicted,
+        )
     audit_lowered = audit_compiled = audit_module = None
     if args.audit_donation or args.audit_comms:
         # ONE AOT compile + ONE HLO text/parse shared by both audits
@@ -772,6 +836,18 @@ def main():
     steps_since_emit = 0
     last_emit_t = time.perf_counter()
     step_i = step0
+    # OOM forensics (monitor.xray.hbm.oom): the step call is the blessed
+    # execute boundary — a RESOURCE_EXHAUSTED surfaces as ONE kind="oom"
+    # incident bundle (analytic breakdown + ranked knob suggestions) and
+    # re-raises; inert when --xray-hbm is off
+    if hbm_mon is not None:
+        from apex_tpu.monitor.xray.hbm.oom import oom_guard as _oom_guard
+
+        def step_oom_guard(step):
+            return _oom_guard(router, step, breakdown=hbm_predicted)
+    else:
+        def step_oom_guard(step):
+            return contextlib.nullcontext()
     while step_i < args.steps:
         # host blocked on the input pipeline = data_wait badput; the
         # robust loader skips-and-counts flaky loads inside the span
@@ -791,7 +867,7 @@ def main():
         # later iterations are the goodput numerator. The barrier inside
         # step_annotation makes the span cover completed device work.
         with goodput.span("compile" if steps_run == 0 else "step",
-                          step=step_i):
+                          step=step_i), step_oom_guard(step_i):
             # step marker: every profiler window carries a span the
             # timeline analyzer can segment on; the barrier inside keeps
             # the step's device tail out of the next step's span
@@ -930,6 +1006,11 @@ def main():
         if step_i % args.log_interval == 0 or step_i == args.steps - 1:
             # ONE device-to-host metrics fetch per interval (the packed
             # MetricBag vector); everything else in the record is host math
+            if hbm_mon is not None:
+                # kind="memory" watermark record on the metrics cadence
+                # (device.memory_stats via the blessed hbm.live probe;
+                # CPU reports none — fields stay None, never faked)
+                hbm_mon.sample(step_i)
             vals = monitor.read_bag(bag)
             secs = max(time.perf_counter() - last_emit_t, 1e-9)
             sec_per_step = secs / steps_since_emit
@@ -956,6 +1037,11 @@ def main():
                 # CSV resumes survive the schema growth
                 **(controller.metrics_fields()
                    if controller is not None else {}),
+                # HBM watermark gauges (peak_hbm_bytes/hbm_utilization);
+                # empty on CPU, and both in CsvSink.TOLERATED_EXTRA_KEYS
+                # like the remediation gauges above
+                **(hbm_mon.metrics_fields()
+                   if hbm_mon is not None else {}),
             )
             # interval-mean step timer as a kind='timer' record; reset=True
             # (the write-parity fix) so each write covers ITS interval only
@@ -1145,6 +1231,19 @@ def main():
     report = goodput.account(recs, run_id=run_id)
     print(report.summary(), flush=True)
     router.event("goodput", step_i, **report.fields())
+    if hbm_mon is not None:
+        # achieved-vs-predicted closing banner (None = CPU, not zero)
+        hs = hbm_mon.summary()
+        fmt = lambda b: ("n/a" if b is None else f"{b / 2**20:.1f} MiB")  # noqa: E731
+        util = ("n/a" if hs["utilization"] is None
+                else f"{hs['utilization']:.2f}")
+        print(
+            f"hbm x-ray: predicted peak "
+            f"{fmt(hs['predicted_peak_bytes'])}, achieved "
+            f"{fmt(hs['achieved_peak_bytes'])}, utilization {util}, "
+            f"headroom breaches {hs['breaches']}",
+            flush=True,
+        )
     router.close()
     # the remediation exit-code contract (resilience/exit_codes.py): 0
     # done, 44 restart-me-with-the-persisted-plan, 45 escalated halt —

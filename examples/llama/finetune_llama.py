@@ -13,6 +13,7 @@ test for the import -> shard -> train pipeline.
 """
 
 import argparse
+import contextlib
 import functools
 import time
 
@@ -42,6 +43,13 @@ def parse_args():
                    help="diff the optimized HLO's collectives against the "
                         "xray ledger's prediction (apex_tpu.analysis.hlo) "
                         "before running")
+    p.add_argument("--xray-hbm", action="store_true",
+                   help="HBM x-ray (monitor.xray.hbm): analytic "
+                        "per-device breakdown (weights off the real param "
+                        "tree, ZeRO state in closed form) reconciled "
+                        "against XLA's memory_analysis, a kind='memory' "
+                        "watermark record after the scan, and kind='oom' "
+                        "forensics on the compiled call")
     p.add_argument("--profile-analyze", action="store_true",
                    help="after training, capture a jax.profiler trace of a "
                         "few single-step calls (each under a step "
@@ -134,7 +142,9 @@ def main():
     sinks = [monitor.StdoutSink()]
     if args.metrics_jsonl:
         sinks.append(monitor.JsonlSink(args.metrics_jsonl))
-    goodput_mem = monitor.MemorySink(kinds=("run", "span"))
+    # "memory" (the HBM x-ray's watermark rows) rides in the window so
+    # tests can read the records back in-process
+    goodput_mem = monitor.MemorySink(kinds=("run", "span", "memory"))
     router = monitor.MetricRouter(sinks + [goodput_mem])
     # backend init BEFORE the header so it resolves the same host index
     # as every later record (the gpt example's multi-process caveat)
@@ -245,6 +255,39 @@ def main():
         return params, opt_state, losses
 
     opt_state = init_opt(variables)
+    hbm_predicted = None
+    if args.xray_hbm:
+        # HBM x-ray (docs/observability.md "HBM x-ray"): no GPT closed
+        # form fits llama's gated-MLP/GQA parametrization, so the
+        # breakdown is COMPOSED from the ledger's primitives — weights
+        # counted off the real param tree (exact by construction), ZeRO
+        # optimizer state in the model's closed form (the flat-buffer
+        # chunk/axis padding included)
+        from apex_tpu.monitor.xray import hbm as xhbm
+
+        leaves = jax.tree_util.tree_leaves(variables)
+        p_elems = sum(int(l.size) for l in leaves)
+        p_bytes = sum(int(l.size) * l.dtype.itemsize for l in leaves)
+        hbm_predicted = xhbm.HbmBreakdown(
+            components=(
+                xhbm.Component("weights", p_bytes,
+                               detail=f"{p_elems} elements, real tree"),
+                xhbm.Component("grads", p_bytes, transient=True,
+                               detail="one grad per param, same dtypes"),
+                xhbm.Component(
+                    "optimizer_state",
+                    xhbm.distributed_adam_state_bytes(p_elems, n_dev),
+                    detail=f"ZeRO-2 shard over dp={n_dev}",
+                ),
+                xhbm.Component(
+                    "batch_data", 2 * args.batch * args.seq_len * 4,
+                    detail=f"tokens+labels: {args.batch}x{args.seq_len} "
+                           f"int32 per device",
+                ),
+            ),
+            label="llama-finetune",
+        )
+        print(hbm_predicted.format(), flush=True)
     step0 = 0
     ar = None
     if args.save:
@@ -322,6 +365,33 @@ def main():
             audit_compiled = train.lower(
                 variables, opt_state, tokens, labels
             ).compile()
+    hbm_mon = None
+    if args.xray_hbm:
+        # reconcile the composed prediction against XLA's own account of
+        # the compiled scan (via the compat re-export — one blessed
+        # memory_analysis home, hbm/report.py)
+        from apex_tpu.monitor.xray.memory import report_from_compiled
+
+        hbm_report = report_from_compiled(audit_compiled)
+        if hbm_report is None:
+            # the flag exists to VERIFY; a backend with no memory
+            # analysis must not print ok (the --audit-* hardening)
+            raise SystemExit("hbm x-ray failed: backend reports no "
+                             "memory_analysis for the compiled scan")
+        achieved = hbm_report.total_bytes
+        print(
+            f"hbm x-ray: predicted peak "
+            f"{hbm_predicted.peak_bytes / 2**20:.1f} MiB vs compiled "
+            f"total {achieved / 2**20:.1f} MiB "
+            f"(x{achieved / max(1, hbm_predicted.peak_bytes):.2f})",
+            flush=True,
+        )
+        router.event(
+            "memory", step0, scope="compiled",
+            predicted_peak_bytes=hbm_predicted.peak_bytes,
+            **hbm_report.fields(),
+        )
+        hbm_mon = xhbm.HbmWatermarkMonitor(router, predicted=hbm_predicted)
     init_span.close()
     # auto-remediation adoption (docs/resilience.md "Auto-remediation"):
     # the scan-shaped run cannot verify/quarantine mid-run (one compiled
@@ -362,7 +432,13 @@ def main():
     # scanned runs, utils/timers.py): all args.steps steps are inside it,
     # and the np.asarray fetch is the barrier that closes it on
     # completed device work
-    with goodput.span("step", step=args.steps):
+    # OOM forensics: the one compiled call is the blessed execute
+    # boundary — a RESOURCE_EXHAUSTED emits ONE kind="oom" incident
+    # bundle (composed breakdown + ranked knob suggestions) and re-raises
+    step_guard = (contextlib.nullcontext() if hbm_mon is None
+                  else xhbm.oom_guard(router, step0,
+                                      breakdown=hbm_predicted))
+    with goodput.span("step", step=args.steps), step_guard:
         params, opt_state, losses = audit_compiled(
             variables, opt_state, tokens, labels
         )
@@ -376,6 +452,19 @@ def main():
     print(f"final loss {losses[-1]:.4f}; {args.steps} steps in {dt:.2f}s "
           f"on {jax.devices()[0].platform}")
     assert np.isfinite(losses).all()
+    if hbm_mon is not None:
+        # one kind="memory" watermark record on the far side of the scan
+        # (CPU reports no stats — fields land None, never a fake zero)
+        hbm_mon.sample(step0 + args.steps)
+        hs = hbm_mon.summary()
+        achieved_s = ("n/a" if hs["achieved_peak_bytes"] is None
+                      else f"{hs['achieved_peak_bytes'] / 2**20:.1f} MiB")
+        util_s = ("n/a" if hs["utilization"] is None
+                  else f"{hs['utilization']:.2f}")
+        print(f"hbm x-ray: predicted peak "
+              f"{hs['predicted_peak_bytes'] / 2**20:.1f} MiB, achieved "
+              f"{achieved_s}, utilization {util_s}, headroom breaches "
+              f"{hs['breaches']}", flush=True)
     if controller is not None:
         # the scan landed with finite losses: the adopted incident
         # case's probation is satisfied by the run as a unit

@@ -1,0 +1,401 @@
+"""Live HBM watermarks + OOM forensics (monitor.xray.hbm.live / .oom).
+
+The load-bearing contracts:
+
+- NONE IS NEVER FORGED: a backend with no allocator stats (CPU) yields
+  None watermarks, None utilization, and EMPTY metric gauges — records
+  still flow so the join's absence is visible in the stream;
+- the breach detector fires exactly when the watermark crosses the
+  ``(1 - headroom_fraction) * capacity`` guard band, the record carries
+  ``headroom_breach=True``, and the remediation controller opens ONE
+  ``memory`` case on it (plain watermark rows open nothing);
+- ``oom_guard`` emits exactly ONE ``kind="oom"`` incident bundle per
+  exhaustion and ALWAYS re-raises — it explains failures, never
+  swallows them; non-OOM exceptions pass through untouched;
+- KV-pool occupancy/fragmentation arithmetic is pinned by hand, the
+  serving engine emits pool rows on its tick cadence, and the
+  allocator's high-water mark survives frees;
+- the router schema holds: StdoutSink skips the memory/oom firehose,
+  CsvSink tolerates the watermark gauges.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from apex_tpu.monitor import MemorySink, MetricRouter, StdoutSink
+from apex_tpu.monitor.router import CsvSink
+from apex_tpu.monitor.xray.hbm.live import (
+    HbmWatermarkMonitor,
+    device_memory_limit,
+    device_watermarks,
+    kv_pool_fields,
+)
+from apex_tpu.monitor.xray.hbm.model import Component, HbmBreakdown
+from apex_tpu.monitor.xray.hbm.oom import oom_guard, read_oom_records
+
+
+class _TpuLikeDevice:
+    """A device whose allocator reports stats (the TPU/GPU shape)."""
+
+    def __init__(self, in_use=800, peak=900, limit=1000):
+        self.stats = {
+            "bytes_in_use": in_use, "peak_bytes_in_use": peak,
+            "bytes_limit": limit,
+        }
+
+    def memory_stats(self):
+        return self.stats
+
+
+class _CpuLikeDevice:
+    """Host backends report no stats at all."""
+
+    def memory_stats(self):
+        return None
+
+
+class _LegacyDevice:
+    """Backends predating the stats API raise NotImplementedError."""
+
+    def memory_stats(self):
+        raise NotImplementedError
+
+
+def _bd(n, capacity=None):
+    return HbmBreakdown(
+        components=(Component("weights", n),), capacity_bytes=capacity
+    )
+
+
+# ---------------------------------------------------------------------------
+# watermark probes
+
+
+class TestDeviceWatermarks:
+    def test_stats_pass_through(self):
+        wm = device_watermarks(_TpuLikeDevice())
+        assert wm == {
+            "bytes_in_use": 800, "peak_bytes_in_use": 900,
+            "bytes_limit": 1000,
+        }
+
+    def test_cpu_reports_none_not_zeros(self):
+        assert device_watermarks(_CpuLikeDevice()) is None
+        assert device_watermarks(_LegacyDevice()) is None
+
+    def test_memory_limit(self):
+        assert device_memory_limit(_TpuLikeDevice()) == 1000
+        assert device_memory_limit(_CpuLikeDevice()) is None
+
+
+# ---------------------------------------------------------------------------
+# the watermark monitor
+
+
+class TestWatermarkMonitor:
+    def _mon(self, device, **kw):
+        mem = MemorySink()
+        router = MetricRouter([mem])
+        mon = HbmWatermarkMonitor(router, device=device, **kw)
+        return mon, mem
+
+    def test_sample_joins_against_prediction(self):
+        mon, mem = self._mon(_TpuLikeDevice(), predicted=_bd(1000))
+        fields = mon.sample(5)
+        assert fields["scope"] == "device"
+        assert fields["peak_bytes_in_use"] == 900
+        assert fields["predicted_peak_bytes"] == 1000
+        assert fields["utilization"] == 0.9
+        (rec,) = mem.records
+        assert rec["kind"] == "memory" and rec["step"] == 5
+        assert rec["utilization"] == 0.9
+
+    def test_cpu_path_is_none_not_fake(self):
+        """The docs/observability.md caveat: records still flow, every
+        watermark field is None, and the metric gauges stay EMPTY —
+        a forged 0.0 would poison the sentinel's baselines."""
+        mon, mem = self._mon(_CpuLikeDevice(), predicted=_bd(1000))
+        fields = mon.sample(1)
+        assert fields["peak_bytes_in_use"] is None
+        assert fields["utilization"] is None
+        assert fields["headroom_breach"] is False
+        assert len(mem.records) == 1
+        assert mon.metrics_fields() == {}
+        s = mon.summary()
+        assert s["achieved_peak_bytes"] is None
+        assert s["utilization"] is None
+        assert s["predicted_peak_bytes"] == 1000
+
+    def test_breach_fires_inside_the_guard_band(self):
+        # watermark 900 vs capacity 1000 at 10% headroom: 900 > 900 is
+        # False — exactly ON the band is NOT a breach
+        mon, mem = self._mon(_TpuLikeDevice(peak=900), capacity_bytes=1000)
+        assert not mon.sample(1)["headroom_breach"]
+        assert mon.breaches == 0
+        # one byte past the band breaches
+        mon2, mem2 = self._mon(_TpuLikeDevice(peak=901), capacity_bytes=1000)
+        fields = mon2.sample(2)
+        assert fields["headroom_breach"] is True
+        assert mon2.breaches == 1
+        (rec,) = mem2.records
+        assert rec["headroom_breach"] is True
+
+    def test_allocator_limit_is_the_default_capacity(self):
+        mon, _ = self._mon(_TpuLikeDevice(peak=950, limit=1000))
+        assert mon.sample(1)["headroom_breach"] is True
+
+    def test_metrics_fields_expose_the_csv_gauges(self):
+        mon, _ = self._mon(_TpuLikeDevice(), predicted=_bd(1000))
+        mon.sample(1)
+        assert mon.metrics_fields() == {
+            "peak_hbm_bytes": 900, "hbm_utilization": 0.9,
+        }
+
+    def test_maybe_sample_anchors_then_paces(self):
+        mon, mem = self._mon(_TpuLikeDevice(), interval_steps=10)
+        assert mon.maybe_sample(0) is None      # anchor, no sample
+        assert mon.maybe_sample(5) is None      # inside the interval
+        assert mon.maybe_sample(10) is not None
+        assert mon.maybe_sample(11) is None     # re-anchored at 10
+        assert len(mem.records) == 1
+
+    def test_validation(self):
+        router = MetricRouter([MemorySink()])
+        with pytest.raises(ValueError, match="interval_steps"):
+            HbmWatermarkMonitor(router, interval_steps=0)
+        with pytest.raises(ValueError, match="headroom_fraction"):
+            HbmWatermarkMonitor(router, headroom_fraction=1.0)
+
+
+# ---------------------------------------------------------------------------
+# KV-pool occupancy arithmetic
+
+
+class TestKvPoolFields:
+    def test_pins(self):
+        # 6 of 8 blocks used, 4 slots each = 24 reserved token slots;
+        # 18 live -> fragmentation 6/24 = 0.25
+        f = kv_pool_fields(num_blocks=8, free_blocks=2, block_size=4,
+                           live_tokens=18)
+        assert f["scope"] == "kv_pool"
+        assert f["used_blocks"] == 6 and f["occupancy"] == 0.75
+        assert abs(f["fragmentation"] - 0.25) < 1e-12
+        assert "kv_pool_peak_blocks" not in f
+
+    def test_empty_pool_is_zero_not_nan(self):
+        f = kv_pool_fields(num_blocks=8, free_blocks=8, block_size=4,
+                           live_tokens=0)
+        assert f["occupancy"] == 0.0 and f["fragmentation"] == 0.0
+
+    def test_peak_rides_when_given(self):
+        f = kv_pool_fields(num_blocks=8, free_blocks=4, block_size=4,
+                           live_tokens=16, peak_used_blocks=7)
+        assert f["kv_pool_peak_blocks"] == 7
+        # fully-packed blocks: zero tail waste
+        assert f["fragmentation"] == 0.0
+
+    def test_overfull_free_list_refused(self):
+        with pytest.raises(ValueError, match="exceeds"):
+            kv_pool_fields(num_blocks=4, free_blocks=5, block_size=4,
+                           live_tokens=0)
+
+
+class TestAllocatorPeak:
+    def test_high_water_mark_survives_frees(self):
+        from apex_tpu.serving.kvcache import BlockAllocator
+
+        alloc = BlockAllocator(8)
+        assert alloc.peak_used_blocks == 0
+        a = alloc.alloc(5)
+        assert alloc.peak_used_blocks == 5
+        alloc.free(a)
+        assert alloc.used_blocks == 0
+        assert alloc.peak_used_blocks == 5     # the mark does not recede
+        alloc.alloc(3)
+        assert alloc.peak_used_blocks == 5     # below the mark: unchanged
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics at the boundary
+
+
+class TestOomGuard:
+    def test_exactly_one_record_and_reraise(self):
+        mem = MemorySink()
+        router = MetricRouter([mem])
+        bd = _bd(500, capacity=400)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            with oom_guard(router, 9, breakdown=bd, capacity_bytes=400):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating 640 bytes"
+                )
+        ooms = [r for r in mem.records if r["kind"] == "oom"]
+        assert len(ooms) == 1
+        (inc,) = read_oom_records(mem.records)
+        assert inc.step == 9 and inc.phase == "execute"
+        assert inc.predicted_peak_bytes == 500
+        assert inc.capacity_bytes == 400
+        assert inc.components == {"weights": 500}
+        # every suggestion names a REAL repo knob
+        knobs = inc.suggested_knobs()
+        assert "--micro-batch" in knobs and "num_blocks" in knobs
+        # the dominant component's knob ranks first
+        assert inc.suggestions[0]["component"] == "weights"
+
+    def test_non_oom_exceptions_pass_untouched(self):
+        mem = MemorySink()
+        router = MetricRouter([mem])
+        with pytest.raises(KeyError):
+            with oom_guard(router, 1):
+                raise KeyError("not a memory problem")
+        assert not mem.records
+
+    def test_clean_body_emits_nothing(self):
+        mem = MemorySink()
+        router = MetricRouter([mem])
+        with oom_guard(router, 1):
+            pass
+        assert not mem.records
+
+
+# ---------------------------------------------------------------------------
+# router schema: the new kinds and gauges
+
+
+class TestRouterSchema:
+    def test_stdout_sink_skips_the_firehose(self):
+        buf = io.StringIO()
+        router = MetricRouter([StdoutSink(stream=buf)])
+        router.event("memory", 1, scope="device", bytes_in_use=5)
+        router.event("oom", 1, phase="execute", error="x")
+        router.metrics(1, loss=0.5)
+        out = buf.getvalue()
+        assert "memory" not in out and "oom" not in out
+        assert "step     1" in out
+
+    def test_csv_sink_tolerates_the_watermark_gauges(self, tmp_path, caplog):
+        """A CSV whose header froze before the x-ray existed must
+        resume cleanly when the schema grows the gauges — silently
+        dropped, not surfaced through the router's isolation log."""
+        import logging
+
+        path = tmp_path / "m.csv"
+        sink = CsvSink(str(path))
+        router = MetricRouter([sink])
+        with caplog.at_level(logging.WARNING, "apex_tpu.monitor.router"):
+            router.metrics(1, loss=0.5)        # header frozen: t,step,loss
+            router.metrics(
+                2, loss=0.4, peak_hbm_bytes=900, hbm_utilization=0.9
+            )
+        router.close()
+        rows = path.read_text().strip().splitlines()
+        assert len(rows) == 3                   # header + 2 records
+        assert "peak_hbm_bytes" not in rows[0]
+        assert not caplog.records                # dropped, not isolated
+
+
+# ---------------------------------------------------------------------------
+# remediation: the memory case
+
+
+class TestRemediationMemoryCase:
+    def _controller(self):
+        from apex_tpu.resilience.remediation import (
+            RemediationController, RemediationPolicy,
+        )
+
+        return RemediationController(
+            policy=RemediationPolicy(), router=None, save_dir=None,
+            world_devices=8,
+        )
+
+    def test_plain_watermark_rows_open_nothing(self):
+        from apex_tpu.monitor.router import make_record
+
+        ctrl = self._controller()
+        rec = make_record("memory", 5, scope="device",
+                          headroom_breach=False)
+        assert ctrl.observe(rec) is None
+        assert not ctrl.open_cases
+
+    def test_breach_opens_one_observe_case(self):
+        from apex_tpu.monitor.router import make_record
+        from apex_tpu.resilience.remediation import RemediationPolicy
+
+        ctrl = self._controller()
+        rec = make_record("memory", 5, scope="device", headroom_breach=True,
+                          bytes_in_use=901, capacity_bytes=1000)
+        case = ctrl.observe(rec)
+        assert case is not None and case["kind"] == "memory"
+        # a repeat breach attaches as evidence, not a second case
+        ctrl.observe(make_record("memory", 6, headroom_breach=True))
+        assert len(ctrl.open_cases) == 1
+        assert len(case["evidence"]) == 2
+        # restarting cannot shrink a footprint: the response is observe
+        assert RemediationPolicy().response_for("memory") == "observe"
+
+
+# ---------------------------------------------------------------------------
+# the serving engine's pool rows (tick-cadence integration)
+
+
+def test_engine_emits_kv_pool_rows_and_peak():
+    """End to end through a REAL engine: ``memory_interval_ticks=1``
+    lands one scope="kv_pool" record per tick, the occupancy matches
+    the allocator, and ``stats()`` exposes the pool high-water mark."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.models import GPTModel
+    from apex_tpu.serving import ServingConfig, ServingEngine
+    from apex_tpu.transformer import TransformerConfig
+
+    tcfg = TransformerConfig(
+        num_layers=1, hidden_size=32, num_attention_heads=4,
+        vocab_size=37, max_position_embeddings=0,
+        position_embedding_type="rope", hidden_dropout=0.0,
+        attention_dropout=0.0,
+    )
+    model = GPTModel(config=tcfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 4), jnp.int32))
+    mem = MemorySink()
+    router = MetricRouter([mem])
+    cfg = ServingConfig(lanes=2, block_size=8, num_blocks=4,
+                        max_seq_len=16, prefill_buckets=(8,), seed=0,
+                        memory_interval_ticks=1)
+    eng = ServingEngine(model, variables, cfg, router=router)
+    eng.start()
+    try:
+        eng.submit(np.array([1, 2, 3], np.int32), max_new_tokens=6)
+        n = 0
+        while not eng.idle and n < 60:
+            eng.tick()
+            n += 1
+    finally:
+        router.close()
+    rows = [r for r in mem.records
+            if r["kind"] == "memory" and r.get("scope") == "kv_pool"]
+    assert rows, "no kv_pool rows on a 1-tick cadence"
+    for r in rows:
+        assert r["used_blocks"] + r["free_blocks"] == cfg.num_blocks
+        assert 0.0 <= r["fragmentation"] <= 1.0
+        assert r["kv_pool_peak_blocks"] >= r["used_blocks"]
+    # the request reserved blocks at some point, and stats carries the mark
+    stats = eng.stats()
+    assert stats["kv_pool_peak_blocks"] >= 1
+    assert max(r["used_blocks"] for r in rows) >= 1
+
+
+def test_memory_interval_validation():
+    from apex_tpu.serving import ServingConfig
+
+    with pytest.raises(ValueError, match="memory_interval_ticks"):
+        ServingConfig(lanes=1, block_size=8, num_blocks=4, max_seq_len=16,
+                      prefill_buckets=(8,), memory_interval_ticks=0)
+    # None disables the cadence entirely
+    cfg = ServingConfig(lanes=1, block_size=8, num_blocks=4, max_seq_len=16,
+                        prefill_buckets=(8,), memory_interval_ticks=None)
+    assert cfg.memory_interval_ticks is None

@@ -1,0 +1,177 @@
+"""Compiled-memory differ (analysis/hlo/memory_diff): the confirm leg.
+
+The load-bearing contracts:
+
+- POSITIVE CONFIRMATION: on the real dp2tp2 GPT audit target the differ
+  returns ``memory.reconciled`` carrying the exact component table —
+  every resident component matches the analytic ledger DIGIT FOR DIGIT
+  against XLA's ``memory_analysis()``, and temps sit inside the band;
+- SEEDED DEFECTS ARE CAUGHT: a ledger whose weights arithmetic is off
+  by four bytes, an unclaimed argument buffer, or a temp band squeezed
+  below the real ratio each produce ``memory.unpredicted`` (error) with
+  largest-buffer attribution;
+- HEADROOM: a capacity just above the measured peak warns
+  (``memory.headroom``); ample capacity stays silent;
+- HONESty ABOUT LIMITS: no prediction / no parsed module each downgrade
+  to ``memory.unverifiable`` (info) — never a silent pass;
+- the real findings survive ``repo_allowlist()`` (the gate wiring).
+
+One AOT compile is shared module-wide (the StepContext discipline —
+the compile is the only non-tracing cost here).
+"""
+
+import dataclasses
+
+import pytest
+
+from apex_tpu.analysis import StepContext
+from apex_tpu.analysis.hlo.memory_diff import audit_memory
+from apex_tpu.analysis.targets import dp2tp2_mesh, gpt_step_target
+
+
+@pytest.fixture(scope="module")
+def gpt_ctx():
+    """(target, compiled, module): ONE shared AOT compile + HLO parse."""
+    tgt = gpt_step_target(dp2tp2_mesh())
+    ctx = StepContext(tgt)
+    _, compiled = ctx.aot()
+    return tgt, compiled, ctx.hlo_module()
+
+
+def _audit(gpt_ctx, **kw):
+    tgt, compiled, module = gpt_ctx
+    kw.setdefault("predicted", tgt.hbm)
+    return audit_memory(
+        tgt.fn, *tgt.args,
+        donate_argnums=tgt.donate_argnums, target=tgt.name,
+        compiled=compiled, module=module, **kw,
+    )
+
+
+def _rules(fins):
+    return sorted(f.rule for f in fins)
+
+
+class TestReconciled:
+    def test_real_target_reconciles_exactly(self, gpt_ctx):
+        """The tentpole acceptance: the analytic ledger and XLA agree
+        on every resident component of the dp2tp2 GPT step, byte for
+        byte, and the proof (the component table) rides in the finding
+        data — the gate's jsonl carries it."""
+        tgt, _, _ = gpt_ctx
+        fins = _audit(gpt_ctx)
+        assert not [f for f in fins if f.severity == "error"], [
+            f.format() for f in fins
+        ]
+        (rec,) = [f for f in fins if f.rule == "memory.reconciled"]
+        table = rec.data["components"]
+        for comp, row in table.items():
+            assert row["predicted"] == row["measured"], (comp, row)
+        # the table's resident rows ARE the ledger's resident components
+        assert set(table) == {
+            c.name for c in tgt.hbm.components if not c.transient
+        }
+        assert table["weights"]["measured"] == 15168
+        assert table["optimizer_state"]["measured"] == 30340
+        assert rec.data["predicted_peak_bytes"] == tgt.hbm.peak_bytes
+        assert 0 < rec.data["temp_ratio"] <= 4.0
+
+    def test_real_findings_survive_the_repo_allowlist(self, gpt_ctx):
+        from apex_tpu.analysis.allowlist import repo_allowlist
+
+        res = repo_allowlist().apply(_audit(gpt_ctx), check_stale=False)
+        assert res.ok, [f.format() for f in res.kept]
+
+    def test_registered_in_the_gate(self):
+        from apex_tpu.analysis.passes import JAXPR_PASSES
+
+        assert "hlo-memory" in JAXPR_PASSES
+
+
+class TestSeededDefects:
+    def test_wrong_weights_arithmetic_is_unpredicted(self, gpt_ctx):
+        """Four bytes of ledger error -> error finding naming the
+        component, the delta, and the largest-buffer attribution."""
+        tgt, _, _ = gpt_ctx
+        bad_comps = tuple(
+            dataclasses.replace(c, bytes=c.bytes + 4)
+            if c.name == "weights" else c
+            for c in tgt.hbm.components
+        )
+        bad = dataclasses.replace(tgt.hbm, components=bad_comps)
+        fins = _audit(gpt_ctx, predicted=bad)
+        bad_fins = [f for f in fins if f.rule == "memory.unpredicted"]
+        assert bad_fins and all(f.severity == "error" for f in bad_fins)
+        (w,) = [f for f in bad_fins if f.data.get("component") == "weights"]
+        assert w.data["predicted"] - w.data["measured"] == 4
+        assert w.data["largest_buffers"][0]["bytes"] > 0
+        assert "memory.reconciled" not in _rules(fins)
+
+    def test_missing_component_orphans_argument_bytes(self, gpt_ctx):
+        """Dropping batch_data from the ledger leaves the token buffers
+        attributable (they fall through to nothing) -> unpredicted."""
+        tgt, _, _ = gpt_ctx
+        slim = dataclasses.replace(
+            tgt.hbm,
+            components=tuple(
+                c for c in tgt.hbm.components if c.name != "batch_data"
+            ),
+        )
+        fins = _audit(gpt_ctx, predicted=slim)
+        assert any(
+            f.rule == "memory.unpredicted"
+            and "unattributed_bytes" in (f.data or {})
+            for f in fins
+        ), [f.format() for f in fins]
+
+    def test_squeezed_temp_band_breaches(self, gpt_ctx):
+        """The band is a DECLARED tolerance: squeezing it below the
+        real temp ratio must flip the verdict (proves the band is
+        actually enforced, not decorative)."""
+        fins = _audit(gpt_ctx, temp_band=0.01)
+        (f,) = [
+            f for f in fins
+            if f.rule == "memory.unpredicted" and "temp_bytes" in f.data
+        ]
+        assert f.severity == "error"
+        assert f.data["temp_bytes"] > 0
+        assert "memory.reconciled" not in _rules(fins)
+
+
+class TestHeadroom:
+    def test_tight_capacity_warns(self, gpt_ctx):
+        fins = _audit(gpt_ctx, capacity_bytes=70_000)
+        (f,) = [f for f in fins if f.rule == "memory.headroom"]
+        assert f.severity == "warning"
+        assert f.data["capacity_bytes"] == 70_000
+
+    def test_ample_capacity_is_silent(self, gpt_ctx):
+        fins = _audit(gpt_ctx, capacity_bytes=2 ** 30)
+        assert "memory.headroom" not in _rules(fins)
+
+    def test_breakdown_capacity_is_the_fallback(self, gpt_ctx):
+        """A capacity declared on the breakdown itself (virtual-topology
+        rehearsal) is honored when the caller and device offer none."""
+        tgt, _, _ = gpt_ctx
+        virt = dataclasses.replace(tgt.hbm, capacity_bytes=70_000)
+        fins = _audit(gpt_ctx, predicted=virt)
+        assert "memory.headroom" in _rules(fins)
+
+
+class TestUnverifiable:
+    def test_no_prediction_downgrades_honestly(self, gpt_ctx):
+        fins = _audit(gpt_ctx, predicted=None)
+        (f,) = [f for f in fins if f.rule == "memory.unverifiable"]
+        assert f.severity == "info"
+        # the measured breakdown still rides along for the record
+        assert f.data["measured"]["total_bytes"] > 0
+
+    def test_no_parsed_module_downgrades_honestly(self, gpt_ctx):
+        tgt, compiled, _ = gpt_ctx
+        fins = audit_memory(
+            tgt.fn, *tgt.args,
+            donate_argnums=tgt.donate_argnums, target=tgt.name,
+            compiled=compiled, module=None, predicted=tgt.hbm,
+        )
+        assert any(f.rule == "memory.unverifiable" for f in fins)
+        assert not [f for f in fins if f.severity == "error"]
