@@ -30,6 +30,21 @@ Flags: ``--verbose`` also prints suppressed findings with their reasons;
 a jsonl (the shared MetricRouter schema); ``--skip-jaxpr`` /
 ``--skip-lint`` / ``--skip-timeline`` run part of the gate;
 ``--target gpt|bert`` restricts the jaxpr half.
+
+``--fix`` runs the AUTOFIX mode instead (analysis/autofix): for every
+builder in ``targets.FIXABLE_TARGETS`` (library steps whose specs are
+data — deliberately NOT part of the default gate, the seeded one would
+fail it) it derives prescriptions from the pass findings, applies the
+auto-appliable ones by rebuilding the target with injected specs /
+donate tuples, and re-audits to a bounded fixpoint. User-code
+prescriptions print as unified diffs, never edits. Exit 0 only when
+every fixed target audits clean, nothing remains unapplied, AND the
+apply is proven idempotent (the final round derives zero patches — a
+second apply is a no-op). With ``--json`` each prescription is appended
+as a ``kind="analysis"`` record carrying the machine-applicable
+``fix=`` payload, plus a sentinel-gated ``kind="bench"`` twin of the
+fixed target's predicted dp-axis wire bytes (``_bytes`` suffix =
+lower-is-better for ``python -m apex_tpu.monitor.goodput --check``).
 """
 
 import argparse
@@ -68,9 +83,16 @@ def main(argv=None) -> int:
                         choices=("gpt", "gpt-compressed", "bert", "gpt-pp"),
                         default=None,
                         help="audit only one step builder")
+    parser.add_argument("--fix", action="store_true",
+                        help="autofix mode: derive + apply prescriptions "
+                             "for the fixable step builders to a bounded "
+                             "fixpoint (see module docstring)")
     args = parser.parse_args(argv)
 
     _ensure_cpu_mesh_env()
+
+    if args.fix:
+        return _run_fix(args)
 
     from apex_tpu.analysis import allowlist as allowlist_mod
     from apex_tpu.analysis import lint as lint_mod
@@ -126,6 +148,66 @@ def main(argv=None) -> int:
             sink.emit(rec)
         sink.close()
     return 0 if result.ok else 1
+
+
+def _run_fix(args) -> int:
+    """The ``--fix`` leg: autofix every FIXABLE_TARGETS builder to its
+    audit fixpoint. Exit contract (the idempotence gate): 0 iff every
+    target ends clean with no unapplied prescriptions and the final
+    round proved a second apply is a no-op."""
+    from apex_tpu.analysis import allowlist as allowlist_mod
+    from apex_tpu.analysis import targets as targets_mod
+    from apex_tpu.analysis.autofix import apply_fixes, render_user_diff
+
+    allow = allowlist_mod.repo_allowlist()
+    mesh = targets_mod.dp2tp2_mesh()
+    ok = True
+    records = []
+    for name, builder in targets_mod.FIXABLE_TARGETS.items():
+        target = builder(mesh)
+        print(f"autofixing step target {target.name!r} "
+              f"(mesh {dict(target.mesh.shape)})", flush=True)
+        report = apply_fixes(target, allowlist=allow)
+        for line in report.describe():
+            print(line, flush=True)
+        diff = render_user_diff(report.manual)
+        if diff:
+            print(diff, flush=True)
+        if not report.ok or report.manual:
+            ok = False
+            why = report.reason or (
+                f"{len(report.manual)} prescription(s) remain unapplied"
+                if report.manual else
+                ("apply did not reach a clean fixpoint"
+                 if not report.idempotent else "residual findings")
+            )
+            print(f"[autofix] {name}: FAILED — {why}", flush=True)
+        if args.json:
+            fins = [p.to_finding()
+                    for p in report.applied + report.manual]
+            result = allow.apply(fins, check_stale=False)
+            records.extend(result.to_records())
+            if report.axis and report.ledger_after:
+                from apex_tpu.monitor.router import make_record
+
+                # the sentinel gates "_bytes" lower-is-better: a
+                # regression that re-replicates the weight update shows
+                # up as this number doubling
+                records.append(make_record(
+                    "bench", 0,
+                    metric=(f"autofix_{target.name.replace('-', '_')}_"
+                            f"{report.axis}_ici_bytes"),
+                    value=float(report.ledger_after.get("ici_bytes", 0)),
+                    unit="B", platform="cpu",
+                ))
+    if args.json and records:
+        from apex_tpu.monitor.router import JsonlSink
+
+        sink = JsonlSink(args.json)
+        for rec in records:
+            sink.emit(rec)
+        sink.close()
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

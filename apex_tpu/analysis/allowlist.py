@@ -8,7 +8,8 @@ loudly when the construct they document disappears.
 
 Organization: precision entries first (why each wide-dtype island in a
 bf16 step is intentional), then collective-safety, then the compiled-HLO
-comms entries, then the source-lint entries.
+comms entries, then the sharding/autofix entries, then the source-lint
+entries.
 When the precision auditor flags a NEW site, the choice is binary: fix
 the promotion, or add an entry HERE with the reason a reviewer can
 check. See docs/analysis.md.
@@ -264,6 +265,37 @@ _COMMS = [
     # EVERY vanished bucket for the target, not one known case.
 ]
 
+_SHARDING = [
+    AllowlistEntry(
+        rule="sharding.unverifiable",
+        match="<hlo:*",
+        reason=(
+            "CPU jit compiles leave the entry ROOT without sharding "
+            "annotations (GSPMD only stamps result shardings when a "
+            "device assignment forces them), so output replication is "
+            "honestly NOT audited on the CPU gate — recorded instead of "
+            "silently skipped (degrade-loudly). The PARAM half of the "
+            "audit still runs (entry parameters always carry shardings) "
+            "and tests/test_autofix.py pins that the rule fires on the "
+            "seeded naive target, so suppressing the info record cannot "
+            "hide the auditor going blind"
+        ),
+    ),
+    AllowlistEntry(
+        rule="autofix.prescription",
+        match="*",
+        reason=(
+            "a prescription is the FIX, not a defect: the defect it "
+            "fixes (sharding.replicated-param, donation.missed, "
+            "comms.reshard) is already on the stream under its own "
+            "rule, and --fix exits nonzero itself when prescriptions "
+            "remain unapplied or the apply is not idempotent — the "
+            "info record exists so the jsonl carries the machine-"
+            "applicable fix= payload"
+        ),
+    ),
+]
+
 _HBM = [
     AllowlistEntry(
         rule="memory.reconciled",
@@ -293,10 +325,10 @@ _HBM = [
         rule="memory.unverifiable",
         match="<step:*",
         reason=(
-            "the bert and pipeline targets carry no analytic ledger yet "
-            "(StepTarget.hbm is None — their closed forms are ROADMAP "
-            "follow-ups); the differ says so honestly instead of "
-            "skipping. The gpt targets DO reconcile, and the examples' "
+            "the bert, pipeline and autofix (gpt-zero-naive) targets "
+            "carry no analytic ledger yet (StepTarget.hbm is None — "
+            "their closed forms are ROADMAP follow-ups); the differ "
+            "says so honestly instead of skipping. The gpt targets DO reconcile, and the examples' "
             "--xray-hbm treats unverifiable as NOT ok, so this cannot "
             "mask a platform that stops reporting memory_analysis()"
         ),
@@ -576,7 +608,9 @@ _LINT = [
     ),
 ]
 
-REPO_ALLOWLIST = Allowlist(_PRECISION + _COLLECTIVE + _COMMS + _HBM + _LINT)
+REPO_ALLOWLIST = Allowlist(
+    _PRECISION + _COLLECTIVE + _COMMS + _SHARDING + _HBM + _LINT
+)
 
 
 def repo_allowlist() -> Allowlist:

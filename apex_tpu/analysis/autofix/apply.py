@@ -1,0 +1,309 @@
+"""The autofix applier: rebuild, re-audit, repeat until clean.
+
+Library ``StepTarget``s are auto-fixable because their specs are data:
+the step builders in ``targets.py`` take injected in/out specs and
+donate tuples, so applying a :class:`~.patches.Patch` is a builder
+re-invocation with merged kwargs — never a source edit. Each round:
+
+1. run the full pass suite over the current target (one shared
+   ``StepContext`` — one compile — feeds the passes, the derivation,
+   and the ledger),
+2. derive prescriptions from the unsuppressed findings,
+3. apply every AUTO patch (one with a builder slot) by rebuilding the
+   target with merged overrides,
+
+until a round derives zero auto patches (the fixpoint — which is also
+the idempotence proof: re-applying the final patch set changes no
+override) or :data:`MAX_ROUNDS` is hit, at which point the applier
+REFUSES rather than loops (conflicting spec prescriptions for one slot
+refuse immediately). Non-auto patches — user code — are rendered as a
+unified diff (:func:`render_user_diff`) and left to the user.
+
+The :class:`FixReport` carries the before/after ``predict_comms``
+per-axis ledger numbers so the CLI (and tests) can show the predicted
+weight-update wire-byte drop the prescriptions bought.
+"""
+
+import dataclasses
+import difflib
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.analysis.autofix.derive import derive_patches, update_axis
+from apex_tpu.analysis.autofix.patches import KIND_DONATE, KIND_SPEC, Patch
+from apex_tpu.analysis.findings import Allowlist, Finding, merge_findings
+from apex_tpu.analysis.passes import JAXPR_PASSES, StepContext
+
+__all__ = ["MAX_ROUNDS", "FixReport", "apply_fixes", "render_user_diff"]
+
+#: fixpoint bound — a prescription set that has not converged after this
+#: many rebuild-and-reaudit rounds is refused, not looped (each round is
+#: a fresh compile; a healthy fix lands in round 1 and proves itself in
+#: round 2)
+MAX_ROUNDS = 4
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+)))
+
+
+@dataclasses.dataclass
+class FixReport:
+    """What one ``apply_fixes`` run did to one target."""
+
+    target: str
+    #: every auto patch applied, in application order across rounds
+    applied: List[Patch] = dataclasses.field(default_factory=list)
+    #: prescriptions the applier may not touch (user code / no slot)
+    manual: List[Patch] = dataclasses.field(default_factory=list)
+    #: unsuppressed findings before round 1 and after the last rebuild
+    findings_before: List[Finding] = dataclasses.field(default_factory=list)
+    findings_after: List[Finding] = dataclasses.field(default_factory=list)
+    #: ``predict_comms(...).per_axis()[axis]`` before/after, for the
+    #: weight-update axis ({} when the ledger predicts no traffic there)
+    axis: str = ""
+    ledger_before: Dict = dataclasses.field(default_factory=dict)
+    ledger_after: Dict = dataclasses.field(default_factory=dict)
+    rounds: int = 0
+    #: the fixpoint proof: the final round derived zero auto patches,
+    #: i.e. applying the patch set again would change nothing
+    idempotent: bool = False
+    refused: bool = False
+    reason: str = ""
+    #: the fixed target (rebuilt) — callers re-audit or reuse it
+    final_target: object = None
+
+    @property
+    def clean(self) -> bool:
+        """No non-info findings survive on the fixed target."""
+        return all(f.severity == "info" for f in self.findings_after)
+
+    @property
+    def ok(self) -> bool:
+        """The CLI exit-0 condition for this target: every pass clean,
+        nothing auto-appliable left undone, and the apply is proven
+        idempotent. Manual (user-code) prescriptions do NOT fail a
+        library target — they are advice, printed as diffs."""
+        return self.clean and self.idempotent and not self.refused
+
+    def describe(self) -> List[str]:
+        lines = [
+            f"[autofix] {self.target}: {len(self.applied)} patch(es) "
+            f"applied over {self.rounds} round(s); "
+            f"{len(self.manual)} manual prescription(s); "
+            + ("idempotent" if self.idempotent else "NOT idempotent")
+            + (f"; REFUSED: {self.reason}" if self.refused else "")
+        ]
+        for p in self.applied:
+            lines.append(f"  applied: {p.describe()}")
+        for p in self.manual:
+            lines.append(f"  manual:  {p.describe()}")
+        if self.axis and self.ledger_before:
+            b = self.ledger_before
+            a = self.ledger_after or {}
+            lines.append(
+                f"  predicted {self.axis!r}-axis wire bytes/step: "
+                f"{b.get('ici_bytes', 0)} -> {a.get('ici_bytes', 0)} "
+                f"(payload {b.get('bytes', 0)} -> {a.get('bytes', 0)})"
+            )
+        n_err = sum(1 for f in self.findings_after if f.severity != "info")
+        lines.append(
+            f"  residual non-info findings: {n_err} "
+            f"({'clean' if self.clean else 'NOT clean'})"
+        )
+        return lines
+
+
+def _run_suite(target, passes: Optional[Sequence[str]],
+               allowlist: Optional[Allowlist]):
+    """One audit round sharing a single StepContext (= one compile)
+    between the passes and the derivation inputs. Returns
+    ``(kept_findings, ctx, ledger)``."""
+    names = list(passes) if passes is not None else sorted(JAXPR_PASSES)
+    ctx = StepContext(target)
+    raw: List[Finding] = []
+    for name in names:
+        raw.extend(JAXPR_PASSES[name](ctx))
+    merged = merge_findings(raw)
+    kept = (
+        allowlist.apply(merged, check_stale=False).findings
+        if allowlist is not None else merged
+    )
+    from apex_tpu.monitor.xray.ledger import predict_comms
+
+    try:
+        ledger = predict_comms(target.fn, *target.args)
+    except Exception:
+        ledger = None
+    return kept, ctx, ledger
+
+
+def _axis_totals(ledger, axis: str) -> Dict:
+    if ledger is None or not axis:
+        return {}
+    return dict(ledger.per_axis().get(axis, {}))
+
+
+def _merge_overrides(target, patches: Sequence[Patch]):
+    """Fold auto patches into the builder kwargs. Returns
+    ``(overrides, applied, conflict_reason)`` — ``applied`` holds only
+    the patches that actually CHANGE an override (the no-progress
+    guard), ``conflict_reason`` is non-empty when two prescriptions
+    disagree about one slot (the refuse-immediately case)."""
+    overrides = dict(target.build_overrides)
+    applied: List[Patch] = []
+    want_spec: Dict[str, Patch] = {}
+    for p in patches:
+        if p.kind == KIND_SPEC and p.slot:
+            prev = want_spec.get(p.slot)
+            if prev is not None and tuple(prev.spec) != tuple(p.spec):
+                return overrides, [], (
+                    f"conflicting specs for builder slot {p.slot!r}: "
+                    f"{prev.spec} vs {p.spec}"
+                )
+            want_spec[p.slot] = p
+    for slot, p in want_spec.items():
+        cur = overrides.get(slot)
+        if cur is None or tuple(cur) != tuple(p.spec):
+            overrides[slot] = p.spec
+            applied.append(p)
+    donate_adds = [p for p in patches if p.kind == KIND_DONATE and p.slot]
+    if donate_adds:
+        slot = donate_adds[0].slot
+        cur = tuple(overrides.get(slot) or ())
+        new = tuple(sorted(set(cur) | {p.argnum for p in donate_adds}))
+        if new != cur:
+            overrides[slot] = new
+            applied.extend(
+                p for p in donate_adds if p.argnum not in cur
+            )
+    return overrides, applied, ""
+
+
+def apply_fixes(
+    target,
+    *,
+    passes: Optional[Sequence[str]] = None,
+    allowlist: Optional[Allowlist] = None,
+    max_rounds: int = MAX_ROUNDS,
+) -> FixReport:
+    """Drive one target to its audit fixpoint; see the module docstring.
+
+    The target must carry a ``builder`` to be auto-fixable; without one
+    every derived patch lands in ``report.manual`` and the (unchanged)
+    target is re-reported as-is."""
+    report = FixReport(target=target.name)
+    kept, ctx, ledger = _run_suite(target, passes, allowlist)
+    report.findings_before = list(kept)
+    report.axis = update_axis(target.mesh, ledger) or ""
+    report.ledger_before = _axis_totals(ledger, report.axis)
+    report.findings_after = list(kept)
+    report.ledger_after = dict(report.ledger_before)
+    report.final_target = target
+
+    for round_no in range(1, max_rounds + 1):
+        try:
+            module = ctx.hlo_module()
+        except ValueError:
+            module = None
+        patches = derive_patches(
+            target, kept, module=module, mesh=target.mesh, ledger=ledger
+        )
+        auto = [p for p in patches if p.auto and target.builder is not None]
+        manual = [p for p in patches if not (p.auto and target.builder)]
+        _merge_manual(report, manual)
+        if not auto:
+            # fixpoint: nothing auto-appliable derives from the current
+            # target — by construction a second apply is a no-op
+            report.idempotent = True
+            break
+        report.rounds = round_no
+        overrides, applied, conflict = _merge_overrides(target, auto)
+        if conflict:
+            report.refused, report.reason = True, conflict
+            break
+        if not applied:
+            # prescriptions derive but change no builder kwarg: applying
+            # again would spin forever — refuse, don't loop
+            report.refused, report.reason = True, (
+                f"{len(auto)} auto prescription(s) change no builder "
+                f"override — the flagged defect survives its own fix"
+            )
+            break
+        target = target.builder(target.mesh, **overrides)
+        report.applied.extend(applied)
+        report.final_target = target
+        kept, ctx, ledger = _run_suite(target, passes, allowlist)
+        report.findings_after = list(kept)
+        report.ledger_after = _axis_totals(ledger, report.axis)
+    else:
+        report.refused = True
+        report.reason = (
+            f"no fixpoint within {max_rounds} rounds — prescriptions "
+            f"keep deriving after every rebuild"
+        )
+    return report
+
+
+def _merge_manual(report: FixReport, manual: Sequence[Patch]):
+    seen = {
+        (p.kind, p.argnum, p.site, str(p.spec)) for p in report.manual
+    }
+    for p in manual:
+        key = (p.kind, p.argnum, p.site, str(p.spec))
+        if key not in seen:
+            seen.add(key)
+            report.manual.append(p)
+
+
+def render_user_diff(patches: Sequence[Patch],
+                     root: Optional[str] = None) -> str:
+    """A unified diff inserting each constraint prescription at its HLO
+    provenance site (``file.py:line``) — printed for the user, NEVER
+    written back: user code is theirs. Patches whose site is not a
+    resolvable source location fall back to a comment-only hunk header
+    describing the prescription."""
+    root = root or _REPO_ROOT
+    out: List[str] = []
+    by_file: Dict[str, List[Patch]] = {}
+    for p in patches:
+        if p.slot is not None:
+            continue  # auto patches apply through the builder, no diff
+        path, _, line = p.site.rpartition(":")
+        if path and line.isdigit() and os.path.isfile(
+            os.path.join(root, path)
+        ):
+            by_file.setdefault(path, []).append(p)
+        else:
+            out.append(f"# unapplied prescription (no source site): "
+                       f"{p.describe()}")
+    for path, plist in sorted(by_file.items()):
+        with open(os.path.join(root, path)) as f:
+            src = f.readlines()
+        patched = list(src)
+        # bottom-up so earlier insertion points stay valid
+        for p in sorted(plist, key=lambda q: -int(p_site_line(q))):
+            line_no = min(p_site_line(p), len(patched))
+            indent = ""
+            if line_no >= 1 and line_no <= len(patched):
+                ref = patched[line_no - 1]
+                indent = ref[: len(ref) - len(ref.lstrip())]
+            spec_src = (
+                p.payload()["spec"] or "PartitionSpec()"
+            )
+            patched.insert(line_no - 1, (
+                f"{indent}# autofix: {p.reason}\n"
+                f"{indent}# x = jax.lax.with_sharding_constraint(\n"
+                f"{indent}#     x, NamedSharding(mesh, {spec_src}))\n"
+            ))
+        out.extend(difflib.unified_diff(
+            src, patched, fromfile=f"a/{path}", tofile=f"b/{path}"
+        ))
+    return "".join(
+        ln if ln.endswith("\n") else ln + "\n" for ln in out
+    )
+
+
+def p_site_line(p: Patch) -> int:
+    _, _, line = p.site.rpartition(":")
+    return int(line) if line.isdigit() else 1

@@ -1,0 +1,250 @@
+"""Prescription derivation: findings -> concrete Patches.
+
+The derivation leg of the autofix loop. Input is what the pass suite
+already computed and shares per target — the parsed ``HloModule``
+(entry-param shardings + ``metadata.source_file/line`` provenance), the
+mesh, and the ``predict_comms`` ledger — plus the unsuppressed findings.
+Output is a list of typed :class:`~.patches.Patch` records:
+
+- ``sharding.replicated-param`` -> a ``PartitionSpec`` over the weight-
+  update axis (the mesh axis carrying the gradient-reduction traffic in
+  the ledger — arXiv:2004.13336's dp axis), sharding the first dimension
+  the axis size divides. The ZeRO flat-buffer convention guarantees
+  divisibility for flat opt state (``flatten_pytree`` pads to a chunk
+  multiple); a buffer with no divisible dim gets a non-auto constraint
+  prescription instead (refuse, don't guess).
+- ``sharding.replicated-output`` -> the same spec, resolved to the entry
+  argument whose shape/dtype the output mirrors (functional step
+  updates return their state).
+- ``donation.missed``            -> a ``donate_argnums`` addition.
+- ``comms.reshard``              -> a ``with_sharding_constraint``
+  insertion at the finding's HLO-provenance site, seeded from the
+  finding's ``suggestion`` field (never auto-applied: that is user
+  code).
+
+Whether a patch is AUTO-appliable is the target's call, not ours: a
+``StepTarget`` whose builder exposes the flagged argument through
+``spec_slots``/``donate_slot`` gets the builder kwarg recorded in
+``Patch.slot``; everything else stays a printed prescription.
+"""
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from apex_tpu.analysis.autofix.patches import (
+    KIND_CONSTRAINT, KIND_DONATE, KIND_SPEC, Patch,
+)
+from apex_tpu.analysis.findings import Finding
+
+__all__ = ["derive_patches", "update_axis"]
+
+
+def update_axis(mesh, ledger=None) -> Optional[str]:
+    """The weight-update (gradient-sync) axis: among the mesh's >1-sized
+    axes, the one moving the most allreduce-class bytes in the ledger's
+    prediction — per arXiv:2004.13336 the axis whose update replication
+    is worth sharding. Falls back to the largest axis (ties: first in
+    mesh order) when no ledger traffic distinguishes them."""
+    if mesh is None:
+        return None
+    shape = dict(mesh.shape)
+    live = [n for n in mesh.axis_names if shape[n] > 1]
+    if not live:
+        return None
+    reduce_bytes = {n: 0 for n in live}
+    if ledger is not None:
+        for e in ledger.entries:
+            if e.axis in reduce_bytes and e.op in (
+                "psum", "pmean", "psum_scatter"
+            ):
+                reduce_bytes[e.axis] += e.bytes * e.count
+    return max(live, key=lambda n: (reduce_bytes[n], shape[n]))
+
+
+def _leaf_owners(args: Sequence[Any], fn=None) -> List[Tuple[int, str]]:
+    """Flat leaf index -> (argnum, human label), the donation auditor's
+    labeling (keep_unused=True makes HLO params map 1:1 onto these)."""
+    names = None
+    if fn is not None:
+        import inspect
+
+        try:
+            names = list(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            names = None
+    owners: List[Tuple[int, str]] = []
+    for i, arg in enumerate(args):
+        name = names[i] if names and i < len(names) else f"arg{i}"
+        flat, _ = jax.tree_util.tree_flatten_with_path(arg)
+        for path, _leaf in flat:
+            owners.append((i, name + jax.tree_util.keystr(path)))
+    return owners
+
+
+def _shard_spec_for(shape: Tuple[int, ...], axis: str, axis_size: int):
+    """P(..., axis, ...) over the first dimension ``axis_size`` divides,
+    or None when no dimension is divisible (the refusal case)."""
+    from jax.sharding import PartitionSpec as P
+
+    for dim, extent in enumerate(shape):
+        if extent and extent % axis_size == 0:
+            return P(*([None] * dim + [axis]))
+    return None
+
+
+def _ici_delta(nbytes: int, n: int) -> int:
+    """Wire-byte saving of sharding a replicated weight update over an
+    ``n``-sized axis, ledger ici convention (monitor/xray/ledger.py):
+    the full-payload grad allreduce (``2(n-1)B/n``) becomes a
+    reduce-scatter (``(n-1)B/n``) — the update's all-gather replaces
+    the resync traffic replicated updates need, so the reduction-half
+    saving is the per-step delta."""
+    if n <= 1:
+        return 0
+    return (
+        math.ceil(2 * (n - 1) * nbytes / n)
+        - math.ceil((n - 1) * nbytes / n)
+    )
+
+
+def derive_patches(
+    target,
+    findings: Sequence[Finding],
+    *,
+    module=None,
+    mesh=None,
+    ledger=None,
+) -> List[Patch]:
+    """Turn one target's unsuppressed findings into Patches; see the
+    module docstring for the per-rule derivation. ``module``/``mesh``/
+    ``ledger`` are the pass suite's shared products (the parsed
+    ``HloModule``, the audit mesh, the ``predict_comms`` ledger) —
+    None degrades gracefully (axis falls back to mesh shape, labels to
+    arg flattening)."""
+    mesh = mesh if mesh is not None else getattr(target, "mesh", None)
+    axis = update_axis(mesh, ledger)
+    if axis is None:
+        return []
+    axis_size = int(dict(mesh.shape)[axis])
+    owners = _leaf_owners(target.args, getattr(target, "fn", None))
+    in_leaves = jax.tree_util.tree_leaves(target.args)
+    spec_slots = dict(getattr(target, "spec_slots", None) or {})
+    donate_slot = getattr(target, "donate_slot", None)
+    out_leaves = None  # lazily built for replicated-output resolution
+
+    patches: List[Patch] = []
+    seen = set()
+
+    def emit(p: Patch):
+        key = (p.kind, p.slot, p.argnum, p.spec, p.site)
+        if key not in seen:
+            seen.add(key)
+            patches.append(p)
+
+    for f in findings:
+        if f.rule == "sharding.replicated-param":
+            idx = f.data.get("index")
+            if idx is None or idx >= len(owners):
+                continue
+            argnum, label = owners[idx]
+            shape = tuple(in_leaves[idx].shape)
+            nbytes = int(f.data.get("bytes", 0))
+            spec = _shard_spec_for(shape, axis, axis_size)
+            if spec is None:
+                emit(Patch(
+                    kind=KIND_CONSTRAINT, target=target.name,
+                    argnum=argnum, leaf=label, spec=None, site=f.site,
+                    axis=axis, reason=(
+                        f"refused: no dimension of {shape} divisible by "
+                        f"{axis!r}={axis_size} — repad or reshape before "
+                        f"sharding"
+                    ),
+                ))
+                continue
+            slot = spec_slots.get(argnum)
+            emit(Patch(
+                kind=KIND_SPEC if slot else KIND_CONSTRAINT,
+                target=target.name, argnum=argnum, leaf=label, spec=spec,
+                site=(f"<builder:{slot}>" if slot else f.site),
+                axis=axis, wire_delta=_ici_delta(nbytes, axis_size),
+                hbm_delta=nbytes - nbytes // axis_size,
+                slot=slot,
+                reason=(
+                    f"{nbytes} B replicated {axis_size}x over {axis!r} — "
+                    f"ZeRO weight-update sharding (arXiv:2004.13336)"
+                ),
+            ))
+        elif f.rule == "sharding.replicated-output":
+            # a functional step returns its state: resolve the output to
+            # the spec-slot argument it mirrors (shape+dtype), so the
+            # in/out specs move together through the one builder kwarg
+            oi = f.data.get("output")
+            if oi is None:
+                continue
+            if out_leaves is None:
+                try:
+                    out_leaves = jax.tree_util.tree_leaves(
+                        jax.eval_shape(target.fn, *target.args)
+                    )
+                except Exception:
+                    out_leaves = []
+            if oi >= len(out_leaves):
+                continue
+            out = out_leaves[oi]
+            for idx, (argnum, label) in enumerate(owners):
+                leaf = in_leaves[idx]
+                if (argnum in spec_slots
+                        and tuple(leaf.shape) == tuple(out.shape)
+                        and leaf.dtype == out.dtype):
+                    spec = _shard_spec_for(tuple(out.shape), axis, axis_size)
+                    if spec is None:
+                        break
+                    nbytes = int(f.data.get("bytes", 0))
+                    emit(Patch(
+                        kind=KIND_SPEC, target=target.name, argnum=argnum,
+                        leaf=label, spec=spec,
+                        site=f"<builder:{spec_slots[argnum]}>",
+                        axis=axis, slot=spec_slots[argnum],
+                        wire_delta=_ici_delta(nbytes, axis_size),
+                        hbm_delta=nbytes - nbytes // axis_size,
+                        reason=(
+                            f"output #{oi} mirrors arg {argnum} ({label}) "
+                            f"— shard the state spec, in and out move "
+                            f"together"
+                        ),
+                    ))
+                    break
+        elif f.rule == "donation.missed":
+            label = f.data.get("leaf", "")
+            argnum = next(
+                (a for a, lb in owners if lb == label), None
+            )
+            if argnum is None:
+                continue
+            emit(Patch(
+                kind=KIND_DONATE, target=target.name, argnum=argnum,
+                leaf=label,
+                site=(f"<builder:{donate_slot}>" if donate_slot else f.site),
+                slot=donate_slot,
+                hbm_delta=int(f.data.get("bytes", 0)),
+                reason="output of same shape/dtype has no alias",
+            ))
+        elif f.rule == "comms.reshard":
+            suggestion = f.data.get("suggestion") or (
+                f"insert with_sharding_constraint(..., NamedSharding(mesh, "
+                f"PartitionSpec({f.data.get('axis', axis)!r}))) at the "
+                f"reshard site"
+            )
+            from jax.sharding import PartitionSpec as P
+
+            emit(Patch(
+                kind=KIND_CONSTRAINT, target=target.name, argnum=None,
+                leaf="(entry param)", spec=P(f.data.get("axis", axis)),
+                site=f.site, axis=f.data.get("axis", axis),
+                wire_delta=int(np.int64(f.data.get("hlo_bytes", 0))),
+                reason=suggestion,
+            ))
+    return patches

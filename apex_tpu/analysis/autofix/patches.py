@@ -1,0 +1,133 @@
+"""Typed prescription records: what the autofix derivation emits.
+
+A :class:`Patch` is one concrete, checkable fix for one flagged entry
+buffer — a ``PartitionSpec`` in the GSPMD ``NamedSharding`` idiom, a
+``with_sharding_constraint`` insertion site, or a ``donate_argnums``
+addition. Patches are *data about code*, never code edits: the applier
+(apply.py) injects them into library step builders whose specs are data
+(``targets.py``), and renders a unified diff for user code instead of
+mutating it.
+
+Every prescription carries the predicted dp-axis wire-byte delta under
+the xray ledger's ici convention (``monitor/xray/ledger.py``): sharding
+a replicated weight update turns the full-payload grad allreduce into a
+reduce-scatter, saving ``ici(psum, B) - ici(psum_scatter, B)`` wire
+bytes per step for a buffer of ``B`` bytes — the arXiv:2004.13336
+accounting the sharding auditor cites.
+
+Patches export as ``kind="analysis"`` findings with the ``fix=``
+payload (``to_finding``), so prescriptions ride the same jsonl stream
+as the defects they fix.
+"""
+
+import dataclasses
+from typing import Optional, Tuple
+
+from apex_tpu.analysis.findings import Finding, SEV_INFO
+
+__all__ = ["Patch", "KIND_SPEC", "KIND_DONATE", "KIND_CONSTRAINT"]
+
+KIND_SPEC = "shard-spec"
+KIND_DONATE = "donate"
+KIND_CONSTRAINT = "constraint"
+_KINDS = (KIND_SPEC, KIND_DONATE, KIND_CONSTRAINT)
+
+
+@dataclasses.dataclass(frozen=True)
+class Patch:
+    """One prescription.
+
+    - ``kind``: ``shard-spec`` (inject a PartitionSpec for an entry
+      arg), ``donate`` (add an argnum to the donate tuple), or
+      ``constraint`` (insert ``with_sharding_constraint`` at ``site`` —
+      user code, rendered as a diff, never auto-applied).
+    - ``target``: the StepTarget name the prescription belongs to.
+    - ``argnum``/``leaf``: the flagged entry argument / its human label.
+    - ``spec``: the prescribed ``jax.sharding.PartitionSpec`` (None for
+      ``donate``).
+    - ``site``: where to apply — a builder slot (``<builder:kwarg>``)
+      for library targets, a ``file.py:line`` insertion site for user
+      code.
+    - ``slot``: the builder kwarg the applier injects into; None means
+      not auto-appliable (user code, or no builder hook).
+    - ``wire_delta``: predicted per-step wire-byte saving on ``axis``
+      under the ledger's ici convention (0 for donation — that saving
+      is HBM, carried in ``hbm_delta``).
+    """
+
+    kind: str
+    target: str
+    argnum: Optional[int]
+    leaf: str
+    spec: Optional[Tuple] = None
+    site: str = ""
+    reason: str = ""
+    axis: str = ""
+    wire_delta: int = 0
+    hbm_delta: int = 0
+    slot: Optional[str] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"patch kind must be one of {_KINDS}, "
+                             f"got {self.kind!r}")
+
+    @property
+    def auto(self) -> bool:
+        """Auto-appliable: the target's builder exposes a slot for it."""
+        return self.slot is not None
+
+    def payload(self) -> dict:
+        """The ``fix=`` payload: JSON-safe, spec rendered as source."""
+        return {
+            "kind": self.kind,
+            "argnum": self.argnum,
+            "leaf": self.leaf,
+            "spec": _spec_src(self.spec) if self.spec is not None else None,
+            "site": self.site,
+            "reason": self.reason,
+            "axis": self.axis,
+            "wire_delta_bytes": self.wire_delta,
+            "hbm_delta_bytes": self.hbm_delta,
+            "auto": self.auto,
+        }
+
+    def to_finding(self) -> Finding:
+        """The prescription as a ``kind="analysis"`` finding (info: a
+        prescription is the fix, not a defect — the defect it fixes is
+        already on the stream)."""
+        return Finding(
+            rule="autofix.prescription",
+            message=self.describe(),
+            site=self.site or f"<fix:{self.target}>",
+            severity=SEV_INFO,
+            target=self.target,
+            data={"kind": self.kind, "leaf": self.leaf},
+            fix=self.payload(),
+        )
+
+    def describe(self) -> str:
+        if self.kind == KIND_DONATE:
+            return (
+                f"add argnum {self.argnum} ({self.leaf}) to donate_argnums "
+                f"— frees {self.hbm_delta} B of double-buffered HBM "
+                f"({self.reason})"
+            )
+        spec_src = _spec_src(self.spec)
+        how = (
+            f"inject via builder kwarg {self.slot!r}" if self.auto
+            else f"insert with_sharding_constraint at {self.site}"
+        )
+        return (
+            f"shard {self.leaf} (arg {self.argnum}) as NamedSharding(mesh, "
+            f"{spec_src}) — {how}; predicted {self.axis!r}-axis wire delta "
+            f"{self.wire_delta} B/step ({self.reason})"
+        )
+
+
+def _spec_src(spec) -> str:
+    """A PartitionSpec as the source text users would write."""
+    if spec is None:
+        return "PartitionSpec()"
+    parts = ", ".join(repr(a) for a in tuple(spec))
+    return f"PartitionSpec({parts})"

@@ -243,6 +243,9 @@ def donation_pass(ctx) -> Iterable[Finding]:
         hlo_module = None  # audit_donation reports unverifiable itself
     return audit_donation(
         ctx.fn, *ctx.args, donate_argnums=ctx.donate_argnums,
+        min_donatable_bytes=(
+            ctx.target.donation_min_bytes or DEFAULT_MIN_DONATABLE_BYTES
+        ),
         arg_names=names, target=ctx.name,
         lowered=lowered, compiled=compiled, hlo_module=hlo_module,
     )

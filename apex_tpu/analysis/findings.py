@@ -62,6 +62,11 @@ class Finding:
     target: str = ""
     count: int = 1
     data: dict = dataclasses.field(default_factory=dict)
+    #: a machine-applicable prescription attached to the finding (the
+    #: autofix Patch serialized: kind, argnum/leaf, spec, site, reason,
+    #: predicted wire-byte delta). None for plain diagnostics; consumers
+    #: that only read ``data`` are unaffected.
+    fix: Optional[dict] = None
 
     def __post_init__(self):
         if self.severity not in _SEVERITIES:
@@ -72,10 +77,12 @@ class Finding:
     @property
     def key(self) -> Tuple:
         """Aggregation identity: same rule at the same site with the same
-        structured data is the same finding (counts add)."""
+        structured data (and fix payload) is the same finding (counts
+        add)."""
         return (
             self.rule, self.site, self.target,
             tuple(sorted((k, str(v)) for k, v in self.data.items())),
+            str(self.fix) if self.fix else "",
         )
 
     def format(self) -> str:
@@ -192,16 +199,20 @@ class AnalysisResult:
 
         records = []
         for f in self.findings:
+            extra = {f"data_{k}": v for k, v in f.data.items()}
+            if f.fix is not None:
+                extra["fix"] = f.fix
             records.append(make_record(
                 "analysis", step, rule=f.rule, site=f.site, target=f.target,
                 severity=f.severity, message=f.message, count=f.count,
-                allowed=False, **{f"data_{k}": v for k, v in f.data.items()},
+                allowed=False, **extra,
             ))
         for f, entry in self.suppressed:
+            extra = {"fix": f.fix} if f.fix is not None else {}
             records.append(make_record(
                 "analysis", step, rule=f.rule, site=f.site, target=f.target,
                 severity=f.severity, message=f.message, count=f.count,
-                allowed=True, reason=entry.reason,
+                allowed=True, reason=entry.reason, **extra,
             ))
         return records
 

@@ -208,6 +208,31 @@ def _entry_sharding_summary(
     return out
 
 
+def _reshard_suggestion(module: hlo_parser.HloModule, u) -> str:
+    """Name the entry-param spec whose absence most plausibly caused a
+    partitioner-inserted reshard: the largest fully-replicated entry
+    param whose element count the moved payload divides into (the
+    all-gather/all-reduce XLA inserts to materialize a replica moves
+    the buffer, or a tile of it). The autofix derivation leg consumes
+    this; ``--audit-comms`` users see it without ``--fix``."""
+    candidates = []
+    for p in module.entry_params:
+        if p.sharding is None or not p.sharding.fully_replicated:
+            continue
+        n = int(np.prod(p.shape, dtype=np.int64)) if p.shape else 1
+        if n >= u.elements and (u.elements == 0 or n % max(u.elements, 1) == 0):
+            candidates.append((n, p))
+    if not candidates:
+        return ""
+    _, p = max(candidates, key=lambda c: c[0])
+    return (
+        f"suggest annotating entry param {p.label or p.name} "
+        f"({p.shape}) with NamedSharding(mesh, PartitionSpec({u.axis!r})) "
+        f"(in_shardings= or with_sharding_constraint) so the partitioner "
+        f"stops materializing a replica"
+    )
+
+
 def audit_comms(
     fn,
     *args,
@@ -338,6 +363,7 @@ def audit_comms(
             data["channel_id"] = instr.channel_id
         if is_reshard:
             shardings = _entry_sharding_summary(module)
+            suggestion = _reshard_suggestion(module, u)
             findings.append(Finding(
                 rule="comms.reshard",
                 message=(
@@ -346,10 +372,12 @@ def audit_comms(
                     f"ledger prediction: XLA reshards at a jit/shard_map "
                     f"boundary; non-replicated entry shardings: "
                     f"{'; '.join(shardings) or '(none annotated)'}"
+                    f"{'; ' + suggestion if suggestion else ''}"
                 ),
                 site=_site(instr, target), severity=SEV_ERROR,
                 target=target,
-                data=dict(data, entry_shardings=shardings),
+                data=dict(data, entry_shardings=shardings,
+                          suggestion=suggestion),
             ))
         else:
             why = (

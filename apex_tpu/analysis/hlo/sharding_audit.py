@@ -13,8 +13,12 @@ HLO (hlo/parser.py) and flags:
   >= ``min_bytes`` (default 1 MiB) left fully replicated although the
   mesh has a >1-sized axis to shard it over;
 - ``sharding.replicated-output`` (warning) — same for entry results
-  (only when the ROOT carries sharding annotations; an unannotated
-  ROOT is simply not reported — absence of evidence, no guessing).
+  (only when the ROOT carries sharding annotations);
+- ``sharding.unverifiable``     (info) — the ROOT carries NO sharding
+  annotations while at least one entry result is >= ``min_bytes``:
+  output replication was NOT audited. Degrade-loudly, the comms and
+  donation passes' convention — "nobody looked" must be
+  distinguishable from "clean" (no guessing either way).
 
 Small buffers are exempt on purpose (a replicated layernorm bias is
 correct engineering, not a leak), and a mesh with no >1 axis has
@@ -25,7 +29,7 @@ is exactly what the reason-carrying allowlist is for.
 
 from typing import List
 
-from apex_tpu.analysis.findings import Finding, SEV_WARNING
+from apex_tpu.analysis.findings import Finding, SEV_INFO, SEV_WARNING
 from apex_tpu.analysis.hlo import parser as hlo_parser
 from apex_tpu.analysis.passes import jaxpr_pass
 
@@ -83,7 +87,22 @@ def audit_entry_shardings(
                       "index": p.index},
             ))
     shardings = module.entry_root_shardings
-    if shardings:
+    if not shardings:
+        outs = module.entry_root_shapes
+        big = [o for o in outs if o.nbytes >= min_bytes]
+        if big:
+            findings.append(Finding(
+                rule="sharding.unverifiable",
+                message=(
+                    f"entry ROOT carries no sharding annotations — "
+                    f"{len(big)} result(s) >= {min_bytes} B NOT audited "
+                    f"for replication (outputs unverified, not clean)"
+                ),
+                site=f"<hlo:{target or module.name}>",
+                severity=SEV_INFO, target=target,
+                data={"outputs": len(big)},
+            ))
+    else:
         outs = module.entry_root_shapes
         # a single sharding annotation on a tuple ROOT applies to all
         if len(shardings) == 1 and len(outs) > 1:
@@ -116,4 +135,8 @@ def hlo_sharding_pass(ctx) -> List[Finding]:
         module = ctx.hlo_module()
     except ValueError:
         return []  # the comms differ reports the parse failure
-    return audit_entry_shardings(module, ctx.mesh, target=ctx.name)
+    return audit_entry_shardings(
+        module, ctx.mesh,
+        min_bytes=ctx.target.sharding_min_bytes or DEFAULT_MIN_BYTES,
+        target=ctx.name,
+    )

@@ -183,6 +183,23 @@ class StepTarget:
     #: ``memory_analysis()``; None disables exact reconciliation for the
     #: target (the pass reports ``memory.unverifiable`` instead)
     hbm: Optional[Any] = None
+    #: per-target floors for the sharding/donation auditors; None uses
+    #: each auditor's 1 MiB default. The tiny CLI targets sit far below
+    #: that on purpose — the seeded autofix target lowers the floors so
+    #: its deliberately replicated flat opt-state buffers are flagged
+    sharding_min_bytes: Optional[int] = None
+    donation_min_bytes: Optional[int] = None
+    #: autofix hooks (analysis/autofix): ``builder(mesh, **overrides)``
+    #: rebuilds this target with injected specs/donations ("specs are
+    #: data"); ``build_overrides`` records what this instance was built
+    #: with. ``spec_slots`` maps an argnum to the builder kwarg naming
+    #: that argument's PartitionSpec; ``donate_slot`` names the builder
+    #: kwarg taking the donate tuple. A target with no builder is not
+    #: auto-fixable — the applier prints prescriptions instead.
+    builder: Optional[Callable] = None
+    build_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    spec_slots: Dict[int, str] = dataclasses.field(default_factory=dict)
+    donate_slot: Optional[str] = None
 
 
 class StepContext:

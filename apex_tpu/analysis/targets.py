@@ -30,8 +30,10 @@ __all__ = [
     "gpt_step_target",
     "gpt_compressed_step_target",
     "gpt_pp_step_target",
+    "gpt_zero_naive_step_target",
     "bert_step_target",
     "all_targets",
+    "FIXABLE_TARGETS",
 ]
 
 
@@ -85,7 +87,8 @@ def _tiny_cfg(**overrides):
     return TransformerConfig(**base)
 
 
-def gpt_step_target(mesh=None, compression=None) -> StepTarget:
+def gpt_step_target(mesh=None, compression=None, *, in_specs=None,
+                    out_specs=None, donate_argnums=(0, 1, 2)) -> StepTarget:
     """The GPT dp2xtp2 train step: bf16 + SP over tp, GradScaler, fused
     Adam, dp grad allreduce, donated (params, opt_state, scaler_state).
 
@@ -95,7 +98,11 @@ def gpt_step_target(mesh=None, compression=None) -> StepTarget:
     int8 wire bytes and the hlo-comms differ must confirm the emitted
     pattern (``gpt_compressed_step_target`` registers it with the CLI
     gate). Stateless here (no error-feedback residual): the auditors
-    trace one step; EF only matters across steps."""
+    trace one step; EF only matters across steps.
+
+    Specs are data (the autofix contract): ``in_specs``/``out_specs``
+    override the boundary PartitionSpecs and ``donate_argnums`` the
+    donation intent — None keeps the flagship layout below."""
     import optax
 
     from apex_tpu.amp import GradScaler
@@ -130,8 +137,8 @@ def gpt_step_target(mesh=None, compression=None) -> StepTarget:
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P(), P("dp"), P("dp")),
-        out_specs=(P(), P(), P(), P()),
+        in_specs=in_specs or (P(), P(), P(), P("dp"), P("dp")),
+        out_specs=out_specs or (P(), P(), P(), P()),
         check_vma=False,
     )
     def gpt_train_step(params, opt_state, scaler_state, tokens, labels):
@@ -156,7 +163,7 @@ def gpt_step_target(mesh=None, compression=None) -> StepTarget:
         fn=gpt_train_step,
         args=(params, opt_state, scaler_state, tokens, tokens),
         mesh=mesh,
-        donate_argnums=(0, 1, 2),
+        donate_argnums=tuple(donate_argnums) if donate_argnums else None,
         hbm=_gpt_hbm_prediction(cfg, b=b, s=s, tp=2, dp=2),
     )
 
@@ -302,9 +309,12 @@ def gpt_pp_step_target(mesh=None) -> StepTarget:
     )
 
 
-def bert_step_target(mesh=None) -> StepTarget:
+def bert_step_target(mesh=None, *, in_specs=None, out_specs=None,
+                     donate_argnums=(0, 1)) -> StepTarget:
     """The BERT masked-LM step on the same mesh: bf16, tp2 vocab/tensor
-    parallel heads, fused Adam, donated (params, opt_state)."""
+    parallel heads, fused Adam, donated (params, opt_state). Specs are
+    data, as in :func:`gpt_step_target`: ``in_specs``/``out_specs``/
+    ``donate_argnums`` inject boundary layouts (None = defaults)."""
     import optax
 
     from apex_tpu.compat import shard_map
@@ -334,8 +344,8 @@ def bert_step_target(mesh=None) -> StepTarget:
 
     @functools.partial(
         shard_map, mesh=mesh,
-        in_specs=(P(), P(), P("dp"), P("dp")),
-        out_specs=(P(), P(), P()),
+        in_specs=in_specs or (P(), P(), P("dp"), P("dp")),
+        out_specs=out_specs or (P(), P(), P()),
         check_vma=False,
     )
     def bert_train_step(params, opt_state, tokens, labels):
@@ -356,8 +366,143 @@ def bert_step_target(mesh=None) -> StepTarget:
         fn=bert_train_step,
         args=(params, opt_state, tokens, tokens),
         mesh=mesh,
-        donate_argnums=(0, 1),
+        donate_argnums=tuple(donate_argnums) if donate_argnums else None,
     )
+
+
+def _flat_adam(p, m, v, g, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+    """One Adam step over a flat fp32 buffer (no bias correction: the
+    auditors trace a single step, there is no step counter to carry)."""
+    import jax.numpy as jnp
+
+    new_m = b1 * m + (1.0 - b1) * g
+    new_v = b2 * v + (1.0 - b2) * (g * g)
+    return p - lr * new_m / (jnp.sqrt(new_v) + eps), new_m, new_v
+
+
+def gpt_zero_naive_step_target(mesh=None, *, state_spec=None,
+                               donate_argnums=()) -> StepTarget:
+    """The DELIBERATELY naively-sharded GPT step — the autofix proof
+    target (ROADMAP item 2a, arXiv:2004.13336's baseline anti-pattern).
+
+    The optimizer state is the ZeRO flat-buffer convention (one padded
+    fp32 buffer each for Adam's m and v, laid out by ``flatten_pytree``),
+    but in the seeded configuration (``state_spec=None`` -> ``P()``)
+    that state crosses the step boundary FULLY REPLICATED and the weight
+    update runs replicated on every dp rank: a full-payload grad
+    allreduce, a full-buffer Adam on all ranks, and the defensive param
+    resync allreduce replicated updates drag along (replicas drift under
+    nondeterministic reduction order, so naive codebases re-broadcast).
+    Nothing is donated either. The auditors flag all of it:
+    ``sharding.replicated-param`` on m/v, ``donation.missed`` on m/v.
+
+    With ``state_spec=P("dp")`` — exactly what the autofix derivation
+    prescribes — the SAME builder composes the proper ZeRO-2 update
+    (the ``distributed_fused_adam`` shape): reduce-scatter the flat
+    grads, Adam on this rank's param shard against the LOCAL m/v shards,
+    all-gather the updated params. The gather is the sync, so the
+    resync allreduce disappears structurally and the predicted dp-axis
+    weight-update wire bytes drop by exactly the dp factor
+    (tests/test_autofix.py pins the ledger totals digit-for-digit).
+
+    Specs are data: the step body branches on whether the injected spec
+    shards the state, so a ``Patch`` is literally a PartitionSpec (and
+    donate-tuple) change — same args, same global shapes, same name.
+    """
+    from apex_tpu.compat import shard_map
+    from apex_tpu.models import GPTModel, gpt_loss_fn
+    from apex_tpu.monitor.xray import ledger as xlax
+    from apex_tpu.ops import flatten_pytree, unflatten_pytree
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh or dp2tp2_mesh()
+    spec = state_spec if state_spec is not None else P()
+    sharded = bool(tuple(spec))
+    dp = int(dict(mesh.shape)["dp"])
+    donate = tuple(donate_argnums or ())
+    cfg = _tiny_cfg()
+    model = GPTModel(config=cfg)
+    b, s = 2, cfg.max_position_embeddings
+    tokens = jnp.zeros((b, s), jnp.int32)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    )
+    def init(tokens):
+        return model.init(jax.random.PRNGKey(0), tokens)
+
+    # abstract state, as in gpt_step_target: avals only, no execution
+    params = jax.eval_shape(init, tokens)
+    flat = jax.eval_shape(
+        lambda p: flatten_pytree(p, dtype=jnp.float32)[0], params
+    )
+    if flat.shape[0] % dp:
+        raise ValueError(
+            f"flat buffer length {flat.shape[0]} not divisible by dp={dp} "
+            f"— the ZeRO flat-buffer convention pads to a chunk multiple, "
+            f"keep dp a divisor of the chunk size"
+        )
+    m = jax.ShapeDtypeStruct(flat.shape, jnp.float32)
+    v = jax.ShapeDtypeStruct(flat.shape, jnp.float32)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(), spec, spec, P("dp"), P("dp")),
+        out_specs=(P(), spec, spec, P()),
+        check_vma=False,
+    )
+    def gpt_zero_naive_train_step(params, m, v, tokens, labels):
+        def loss_fn(p):
+            return gpt_loss_fn(model.apply(p, tokens, labels=labels))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        gflat, _ = flatten_pytree(grads, dtype=jnp.float32)
+        pflat, pspec = flatten_pytree(params, dtype=jnp.float32)
+        if sharded:
+            # ZeRO-2: the reduce-scatter IS the grad sync, the update
+            # touches 1/dp of the state, the all-gather IS the resync
+            gshard = xlax.psum_scatter(
+                gflat, "dp", scatter_dimension=0, tiled=True
+            ) / dp
+            shard_len = pflat.shape[0] // dp
+            idx = jax.lax.axis_index("dp")
+            pshard = jax.lax.dynamic_slice(
+                pflat, (idx * shard_len,), (shard_len,)
+            )
+            new_pshard, new_m, new_v = _flat_adam(pshard, m, v, gshard)
+            new_pflat = xlax.all_gather(new_pshard, "dp", tiled=True)
+        else:
+            # seeded anti-pattern: full-payload allreduce, replicated
+            # full-buffer update, defensive full-payload param resync
+            gmean = xlax.pmean(gflat, "dp")
+            new_pflat, new_m, new_v = _flat_adam(pflat, m, v, gmean)
+            new_pflat = xlax.pmean(new_pflat, "dp")
+        new_params = unflatten_pytree(new_pflat, pspec)
+        return new_params, new_m, new_v, xlax.pmean(loss, "dp")
+
+    return StepTarget(
+        name="gpt-zero-naive",
+        fn=gpt_zero_naive_train_step,
+        args=(params, m, v, tokens, tokens),
+        mesh=mesh,
+        donate_argnums=donate,
+        # the tiny config's flat buffers are 256 KiB — far under the
+        # auditors' 1 MiB production floors; the target-level floors
+        # keep the seeded defects visible without a slow big model
+        sharding_min_bytes=1 << 16,
+        donation_min_bytes=1 << 16,
+        builder=gpt_zero_naive_step_target,
+        build_overrides={"state_spec": spec, "donate_argnums": donate},
+        spec_slots={1: "state_spec", 2: "state_spec"},
+        donate_slot="donate_argnums",
+    )
+
+
+#: step builders the autofix applier may rebuild with injected specs
+#: (``python -m apex_tpu.analysis --fix`` iterates exactly these)
+FIXABLE_TARGETS = {
+    "gpt-zero-naive": gpt_zero_naive_step_target,
+}
 
 
 def all_targets(mesh=None) -> List[StepTarget]:

@@ -753,6 +753,11 @@ def main():
                   "prediction)", flush=True)
         else:
             print(audit.format(verbose=True), flush=True)
+            # reshard findings carry a concrete prescription (the entry
+            # param whose missing spec makes the partitioner move data)
+            for f in fins:
+                if f.rule == "comms.reshard" and f.data.get("suggestion"):
+                    print(f"  fix: {f.data['suggestion']}", flush=True)
             raise SystemExit("comms audit failed")
     # warm the interval-emission path's eager host ops (bag pack/reset)
     # NOW: their one-off compiles must land before the recompile

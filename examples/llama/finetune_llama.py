@@ -353,6 +353,11 @@ def main():
                   "prediction)")
         else:
             print(res.format(verbose=True))
+            # reshard findings carry a concrete prescription (the entry
+            # param whose missing spec makes the partitioner move data)
+            for f in fins:
+                if f.rule == "comms.reshard" and f.data.get("suggestion"):
+                    print(f"  fix: {f.data['suggestion']}")
             raise SystemExit("comms audit failed")
 
     if audit_compiled is None:

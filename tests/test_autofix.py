@@ -1,0 +1,345 @@
+"""Autofix subsystem (apex_tpu.analysis.autofix): prescriptions derived
+from pass findings, applied to library step builders, audited to a
+fixpoint.
+
+The seeded fixture is ``targets.gpt_zero_naive_step_target()`` — the
+arXiv:2004.13336 baseline anti-pattern (fully replicated flat Adam
+state, full-payload grad allreduce, defensive param-resync allreduce,
+nothing donated). The pins here are the PR's acceptance criteria:
+
+- derived PartitionSpecs leaf-for-leaf on the seeded target,
+- ``apply_fixes`` reaches a clean fixpoint in one round and applying
+  twice changes nothing (idempotence),
+- the clean gpt target derives ZERO prescriptions (negative control),
+- the predict_comms dp-axis ledger numbers digit-for-digit: the naive
+  weight-update wire bytes drop by exactly the dp (ZeRO) factor,
+- the CLI ``--fix`` wrapper (exit 0, allowlisted prescription records
+  with machine-applicable fix= payloads, sentinel-gated bench twin).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.analysis.allowlist import repo_allowlist
+from apex_tpu.analysis.autofix import (
+    KIND_CONSTRAINT,
+    KIND_DONATE,
+    KIND_SPEC,
+    Patch,
+    apply_fixes,
+    derive_patches,
+    render_user_diff,
+    update_axis,
+)
+from apex_tpu.analysis.autofix.apply import _merge_overrides, _run_suite
+from apex_tpu.analysis.targets import (
+    FIXABLE_TARGETS,
+    dp2tp2_mesh,
+    gpt_step_target,
+    gpt_zero_naive_step_target,
+)
+from apex_tpu.monitor.xray.ledger import predict_comms
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the seeded target's flat Adam buffers: 65536 f32 elements (the
+# flatten_pytree chunk multiple), 262144 B each — every ledger pin below
+# is arithmetic over this one number plus the 4-byte loss pmean
+FLAT_BYTES = 65536 * 4
+LOSS_BYTES = 4
+DP = 2
+
+
+@pytest.fixture(autouse=True)
+def _dp2tp2_parallel_state():
+    """conftest's autouse reset destroys the global parallel_state after
+    EVERY test, but the module-scoped cached targets' flax modules read
+    tp sizes from it at trace time — re-establish the audit topology
+    before each test (cheap: no compile, just the mesh bookkeeping)."""
+    dp2tp2_mesh()
+    yield
+
+
+@pytest.fixture(scope="module")
+def naive_audit():
+    """One audited seeded target, shared: (target, findings, ledger)."""
+    target = gpt_zero_naive_step_target(dp2tp2_mesh())
+    kept, _ctx, ledger = _run_suite(target, None, repo_allowlist())
+    return target, kept, ledger
+
+
+@pytest.fixture(scope="module")
+def naive_report(naive_audit):
+    # module-scoped fixtures instantiate BEFORE the function-scoped
+    # autouse topology fixture, i.e. right after the previous test's
+    # parallel_state teardown — re-establish it here too
+    dp2tp2_mesh()
+    target, _, _ = naive_audit
+    return apply_fixes(target, allowlist=repo_allowlist())
+
+
+# ---------------------------------------------------------------------------
+# derivation: findings -> Patches, leaf for leaf
+
+
+class TestDerivation:
+    def test_seeded_target_prescriptions_leaf_for_leaf(self, naive_audit):
+        target, kept, ledger = naive_audit
+        patches = derive_patches(
+            target, kept, mesh=target.mesh, ledger=ledger
+        )
+        by_key = {(p.kind, p.argnum): p for p in patches}
+        # exactly m and v, each flagged twice (replication + donation):
+        # nothing else in the target derives a prescription
+        assert set(by_key) == {
+            (KIND_SPEC, 1), (KIND_SPEC, 2),
+            (KIND_DONATE, 1), (KIND_DONATE, 2),
+        }
+        for argnum, leaf in ((1, "m"), (2, "v")):
+            sp = by_key[(KIND_SPEC, argnum)]
+            assert sp.leaf == leaf
+            assert tuple(sp.spec) == tuple(P("dp"))
+            assert sp.axis == "dp"
+            assert sp.slot == "state_spec"
+            assert sp.auto
+            # ici convention: allreduce 2(n-1)B/n -> reduce-scatter
+            # (n-1)B/n, n=2 -> the saving is B/2 per buffer
+            assert sp.wire_delta == FLAT_BYTES // 2 == 131072
+            assert sp.hbm_delta == FLAT_BYTES - FLAT_BYTES // DP
+            dn = by_key[(KIND_DONATE, argnum)]
+            assert dn.leaf == leaf
+            assert dn.slot == "donate_argnums"
+            assert dn.hbm_delta == FLAT_BYTES
+            assert dn.auto
+
+    def test_clean_target_zero_prescriptions(self):
+        """Negative control: the properly sharded gpt target derives
+        nothing — no prescription may exist without a finding."""
+        target = gpt_step_target(dp2tp2_mesh())
+        kept, _ctx, ledger = _run_suite(target, None, repo_allowlist())
+        assert derive_patches(
+            target, kept, mesh=target.mesh, ledger=ledger
+        ) == []
+
+    def test_update_axis_prefers_reduction_traffic(self, naive_audit):
+        target, _, ledger = naive_audit
+        # dp carries the grad allreduce + resync; tp is bigger traffic-
+        # free axes must not win on size alone when the ledger speaks
+        assert update_axis(target.mesh, ledger) == "dp"
+        assert update_axis(None) is None
+
+    def test_patch_kind_validated(self):
+        with pytest.raises(ValueError, match="kind"):
+            Patch(kind="rewrite-everything", target="t", argnum=0, leaf="x")
+
+    def test_prescription_finding_carries_fix_payload(self):
+        p = Patch(
+            kind=KIND_SPEC, target="t", argnum=1, leaf="m", spec=P("dp"),
+            site="<builder:state_spec>", axis="dp", wire_delta=131072,
+            hbm_delta=131072, slot="state_spec", reason="seeded",
+        )
+        f = p.to_finding()
+        assert f.rule == "autofix.prescription"
+        assert f.severity == "info"
+        assert f.fix["spec"] == "PartitionSpec('dp')"
+        assert f.fix["wire_delta_bytes"] == 131072
+        assert f.fix["auto"] is True
+        # the fix payload participates in the finding identity: two
+        # different prescriptions at one site must not merge
+        assert str(f.fix) in f.key[-1]
+
+
+# ---------------------------------------------------------------------------
+# apply: fixpoint, idempotence, refusal
+
+
+class TestApplyFixpoint:
+    def test_one_round_clean_idempotent(self, naive_report):
+        rep = naive_report
+        assert rep.rounds == 1
+        assert rep.idempotent and not rep.refused
+        assert rep.clean and rep.ok
+        assert [f for f in rep.findings_after if f.severity != "info"] == []
+        assert rep.manual == []
+
+    def test_final_overrides_are_the_prescription(self, naive_report):
+        ov = naive_report.final_target.build_overrides
+        assert tuple(ov["state_spec"]) == tuple(P("dp"))
+        assert tuple(ov["donate_argnums"]) == (1, 2)
+
+    def test_apply_twice_is_noop(self, naive_report):
+        """The idempotence gate: autofixing the already-fixed target
+        derives nothing, rebuilds nothing, and stays clean."""
+        rep2 = apply_fixes(
+            naive_report.final_target, allowlist=repo_allowlist()
+        )
+        assert rep2.applied == [] and rep2.rounds == 0
+        assert rep2.idempotent and rep2.ok
+        assert rep2.final_target is naive_report.final_target
+
+    def test_conflicting_specs_refuse(self, naive_audit):
+        target, _, _ = naive_audit
+        mk = lambda spec: Patch(
+            kind=KIND_SPEC, target=target.name, argnum=1, leaf="m",
+            spec=spec, slot="state_spec",
+        )
+        _, applied, conflict = _merge_overrides(target, [mk(P("dp")),
+                                                         mk(P("tp"))])
+        assert applied == []
+        assert "conflicting specs" in conflict
+
+    def test_no_progress_patches_refuse(self, naive_audit):
+        """A prescription equal to what the target was already built
+        with changes no override — the applier must refuse rather than
+        rebuild-and-rederive forever."""
+        target, _, _ = naive_audit
+        fixed = dataclasses.replace(
+            target,
+            build_overrides={"state_spec": P("dp"),
+                             "donate_argnums": (1, 2)},
+        )
+        p = Patch(kind=KIND_SPEC, target=target.name, argnum=1, leaf="m",
+                  spec=P("dp"), slot="state_spec")
+        _, applied, conflict = _merge_overrides(fixed, [p])
+        assert applied == [] and conflict == ""
+
+
+# ---------------------------------------------------------------------------
+# the ledger pins: the ZeRO byte-drop arithmetic, digit for digit
+
+
+class TestLedgerPins:
+    def _dp(self, target):
+        return predict_comms(target.fn, *target.args).per_axis()["dp"]
+
+    def test_naive_dp_totals(self):
+        """Seeded: grad pmean (262144) + defensive param-resync pmean
+        (262144) + loss pmean (4), every byte on the wire (allreduce
+        ici = 2(n-1)B/n = B at n=2)."""
+        t = gpt_zero_naive_step_target(dp2tp2_mesh())
+        assert self._dp(t) == {
+            "bytes": 2 * FLAT_BYTES + LOSS_BYTES,      # 524292
+            "ici_bytes": 2 * FLAT_BYTES + LOSS_BYTES,  # 524292
+            "calls": 3,
+            "axis_size": DP,
+        }
+
+    def test_fixed_dp_totals(self):
+        """Fixed (state_spec=P('dp')): reduce-scatter the grads
+        (payload 262144, ici 131072), all-gather the updated shard
+        (payload = the 131072 local shard, ici 131072), loss pmean."""
+        t = gpt_zero_naive_step_target(
+            dp2tp2_mesh(), state_spec=P("dp"), donate_argnums=(1, 2)
+        )
+        assert self._dp(t) == {
+            "bytes": FLAT_BYTES + FLAT_BYTES // DP + LOSS_BYTES,  # 393220
+            "ici_bytes": FLAT_BYTES + LOSS_BYTES,                 # 262148
+            "calls": 3,
+            "axis_size": DP,
+        }
+
+    def test_weight_update_wire_bytes_drop_by_dp_factor(self, naive_report):
+        """THE acceptance pin: subtract the (identical) 4-byte loss
+        telemetry and the predicted dp-axis weight-update wire bytes
+        drop by exactly the dp (ZeRO) factor — 524288 == 2 * 262144."""
+        before = naive_report.ledger_before
+        after = naive_report.ledger_after
+        assert before["ici_bytes"] == 524292
+        assert after["ici_bytes"] == 262148
+        assert (before["ici_bytes"] - LOSS_BYTES) == DP * (
+            after["ici_bytes"] - LOSS_BYTES
+        )
+        assert (before["ici_bytes"] - LOSS_BYTES) == 524288
+        assert DP * (after["ici_bytes"] - LOSS_BYTES) == 2 * 262144
+
+
+# ---------------------------------------------------------------------------
+# user-code prescriptions render as diffs, never edits
+
+
+class TestUserDiff:
+    def test_constraint_patch_renders_unified_diff(self, tmp_path):
+        src = tmp_path / "user_step.py"
+        src.write_text(
+            "def step(params, grads):\n"
+            "    grads = psum(grads, 'dp')\n"
+            "    return params - grads\n"
+        )
+        p = Patch(
+            kind=KIND_CONSTRAINT, target="user", argnum=None,
+            leaf="(entry param)", spec=P("dp"), site="user_step.py:2",
+            axis="dp", reason="reshard at the grad sync",
+        )
+        diff = render_user_diff([p], root=str(tmp_path))
+        assert "--- a/user_step.py" in diff
+        assert "+++ b/user_step.py" in diff
+        assert "with_sharding_constraint" in diff
+        assert "PartitionSpec('dp')" in diff
+        # render only — the user's file is untouched
+        assert "with_sharding_constraint" not in src.read_text()
+
+    def test_siteless_patch_prints_prescription(self):
+        p = Patch(kind=KIND_CONSTRAINT, target="user", argnum=None,
+                  leaf="x", spec=P("dp"), site="<hlo:user>", axis="dp")
+        out = render_user_diff([p])
+        assert "unapplied prescription" in out
+
+    def test_auto_patches_render_no_diff(self):
+        p = Patch(kind=KIND_SPEC, target="t", argnum=1, leaf="m",
+                  spec=P("dp"), slot="state_spec")
+        assert render_user_diff([p]) == ""
+
+
+# ---------------------------------------------------------------------------
+# the CLI wrapper: python -m apex_tpu.analysis --fix (tier-1)
+
+
+def test_fix_cli_subprocess(tmp_path):
+    """``--fix`` as CI runs it: fresh process, exit 0 (clean fixpoint +
+    idempotence proven), every analysis record an allowlisted
+    prescription carrying its machine-applicable fix= payload, plus the
+    sentinel-gated bench twin of the fixed dp-axis wire bytes."""
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    out = str(tmp_path / "fix.jsonl")
+    proc = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.analysis", "--fix", "--json", out],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=570,
+    )
+    assert proc.returncode == 0, (
+        f"--fix CLI failed\nstdout tail: {proc.stdout[-2000:]}\n"
+        f"stderr tail: {proc.stderr[-800:]}"
+    )
+    assert "idempotent" in proc.stdout
+    records = [json.loads(l) for l in open(out)]
+    analysis = [r for r in records if r["kind"] == "analysis"]
+    bench = [r for r in records if r["kind"] == "bench"]
+    assert analysis, "--fix emitted no prescription records"
+    for rec in analysis:
+        assert rec["rule"] == "autofix.prescription"
+        assert rec["allowed"] is True
+        assert rec["reason"].strip()
+        assert rec["fix"]["kind"] in ("shard-spec", "donate", "constraint")
+    (tw,) = bench
+    assert tw["metric"] == "autofix_gpt_zero_naive_dp_ici_bytes"
+    assert tw["value"] == 262148.0
+    assert tw["unit"] == "B"
+
+
+def test_fixable_targets_registry():
+    # the CLI iterates exactly this registry; every entry must be a
+    # builder producing a target that knows how to rebuild itself
+    assert "gpt-zero-naive" in FIXABLE_TARGETS
+    t = FIXABLE_TARGETS["gpt-zero-naive"](dp2tp2_mesh())
+    assert t.builder is not None
+    assert t.spec_slots and t.donate_slot
