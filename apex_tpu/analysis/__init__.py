@@ -25,6 +25,10 @@ shared telemetry schema (``kind="analysis"`` via monitor.MetricRouter):
 - ``lint``        — raw-collective + registered-taps (migrated from the
   tier-1 tests) + jit-donate + float64 + hlo-text source rules
   (lint.py)
+- ``concurrency`` — the static race/deadlock analyzer over the threaded
+  host runtime (concurrency/): thread-root inventory, unguarded
+  cross-root writes, lock-order cycles + blocking-under-lock,
+  signal/atexit handler safety — pure AST, no jax import
 
 CLI: ``python -m apex_tpu.analysis`` runs the AST rules over the tree
 and the jaxpr passes over the in-repo GPT/BERT step builders on a CPU
@@ -64,6 +68,9 @@ _EXPORTS = {
     "run_lint": "lint",
     "collect_sources": "lint",
     "LEDGERED_OPS": "lint",
+    # concurrency passes (jax-free)
+    "run_concurrency": "concurrency",
+    "CONCURRENCY_PASSES": "concurrency",
     # repo allowlist + CLI targets
     "REPO_ALLOWLIST": "allowlist",
     "repo_allowlist": "allowlist",
@@ -76,7 +83,7 @@ _EXPORTS = {
 
 __all__ = sorted(_EXPORTS) + [
     "findings", "passes", "precision", "donation", "collectives",
-    "host_sync", "lint", "allowlist", "targets", "hlo",
+    "host_sync", "lint", "allowlist", "targets", "hlo", "concurrency",
 ]
 
 _SUBMODULES = frozenset(__all__) - frozenset(_EXPORTS)

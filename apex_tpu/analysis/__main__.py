@@ -7,8 +7,12 @@ hlo-sharding replication auditor) over the in-repo GPT and BERT step
 builders on a CPU dp2xtp2 mesh, PLUS the profiler trace-schema smoke
 (a tiny real capture through the timeline analyzer,
 analysis/trace_smoke.py — loud failure when a jax upgrade drifts
-XProf's export), then applies the documented allowlist
-(analysis/allowlist.py). Exit status:
+XProf's export), PLUS the concurrency passes (the static race/deadlock
+analyzer over the threaded host runtime, analysis/concurrency — thread
+roots, unguarded cross-root writes, lock-order cycles,
+blocking-under-lock, signal/atexit handler safety; pure AST, no jax),
+then applies the documented allowlist (analysis/allowlist.py). Exit
+status:
 
 - 0 — clean: every finding suppressed by a reason-carrying entry and no
   entry gone stale;
@@ -28,8 +32,8 @@ fails fast.
 Flags: ``--verbose`` also prints suppressed findings with their reasons;
 ``--json PATH`` appends every finding as a ``kind="analysis"`` record to
 a jsonl (the shared MetricRouter schema); ``--skip-jaxpr`` /
-``--skip-lint`` / ``--skip-timeline`` run part of the gate;
-``--target gpt|bert`` restricts the jaxpr half.
+``--skip-lint`` / ``--skip-timeline`` / ``--skip-concurrency`` run part
+of the gate; ``--target gpt|bert`` restricts the jaxpr half.
 
 ``--fix`` runs the AUTOFIX mode instead (analysis/autofix): for every
 builder in ``targets.FIXABLE_TARGETS`` (library steps whose specs are
@@ -79,6 +83,9 @@ def main(argv=None) -> int:
                         help="skip the jaxpr passes over the step targets")
     parser.add_argument("--skip-timeline", action="store_true",
                         help="skip the profiler trace-schema smoke check")
+    parser.add_argument("--skip-concurrency", action="store_true",
+                        help="skip the static race/deadlock passes over "
+                             "the threaded host runtime")
     parser.add_argument("--target",
                         choices=("gpt", "gpt-compressed", "bert", "gpt-pp"),
                         default=None,
@@ -100,6 +107,17 @@ def main(argv=None) -> int:
     findings = []
     if not args.skip_lint:
         findings.extend(lint_mod.run_lint())
+    if not args.skip_concurrency:
+        # static race/deadlock passes (analysis/concurrency): pure AST
+        # over the whole package — thread-root inventory, shared-state
+        # audit, lock-order graph, handler safety. No jax import, no
+        # execution; runs before the jaxpr half so a host-runtime race
+        # reports even when tracing fails.
+        from apex_tpu.analysis.concurrency import run_concurrency
+
+        print("concurrency passes (static race/deadlock analyzer)",
+              flush=True)
+        findings.extend(run_concurrency())
     if not args.skip_jaxpr:
         from apex_tpu.analysis import passes as passes_mod
         from apex_tpu.analysis import targets as targets_mod
@@ -134,10 +152,12 @@ def main(argv=None) -> int:
         print("timeline trace-schema smoke (2-step capture)", flush=True)
         findings.extend(timeline_smoke_findings())
 
-    # stale-entry detection needs the full lint scan (a require_hit entry
-    # trivially suppresses nothing when its rule never ran)
+    # stale-entry detection needs the full complete-scan halves (a
+    # require_hit entry trivially suppresses nothing when its rule never
+    # ran) — both the lint rules and the concurrency passes are
+    # whole-package scans with require_hit entries
     result = allowlist_mod.repo_allowlist().apply(
-        findings, check_stale=not args.skip_lint
+        findings, check_stale=not (args.skip_lint or args.skip_concurrency)
     )
     print(result.format(verbose=args.verbose), flush=True)
     if args.json:

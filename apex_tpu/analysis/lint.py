@@ -86,6 +86,21 @@ Plus the new rules this framework exists to host:
   each other — the last registration wins the whole process — and break
   the SIG_DFL-precedence contract those two homes coordinate on (PR 7);
   a third registrant must route through one of them.
+- ``lint.thread-create`` — no raw ``threading.Thread(...)`` /
+  ``threading.Timer(...)`` construction outside the three blessed
+  homes: ``monitor/watchdog.py`` (the heartbeat/deadline monitor that
+  OWNS thread lifecycle — named daemon threads, join-on-close, the
+  ProfilerTrigger handshake), ``resilience/health/responder.py`` (the
+  hard-exit escalation timer) and ``utils/checkpoint.py`` (the async
+  checkpoint finalizer whose thread handle the autoresume handshake
+  tracks). Every thread is a concurrency ROOT the static analyzer
+  (``apex_tpu.analysis.concurrency``) must inventory and audit; a
+  scattered ``Thread(target=...)`` adds an unaudited root with no
+  join/daemon discipline and no allowlist proof. New background work
+  routes through the watchdog's monitor loop or the checkpoint
+  writer's finalize_async. ``from threading import Thread/Timer`` is
+  flagged too (it hides the construction from the attribute match);
+  locks, events and ``threading.current_thread`` reads are fine.
 - ``lint.silent-except`` — no bare ``except:`` and no broad
   ``except Exception/BaseException:`` whose body does NOTHING (only
   ``pass``/``...``/``continue``) in library code. A silent broad swallow
@@ -549,6 +564,79 @@ def signal_handlers(ctx: LintContext) -> Iterable[Finding]:
                         "contract the two blessed homes coordinate on; "
                         "route through AutoResume (preemption) or the "
                         "router teardown (span flush) instead"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                )
+
+
+#: the threading constructors that create a new concurrency ROOT (locks,
+#: events, barriers merely coordinate existing ones and are fine)
+_THREAD_CTORS = frozenset({"Thread", "Timer"})
+
+
+@lint_rule("lint.thread-create", scopes=("apex_tpu/",))
+def thread_create(ctx: LintContext) -> Iterable[Finding]:
+    """Raw thread construction outside the blessed homes.
+
+    AST-based: flags ``threading.Thread(...)`` / ``threading.Timer(...)``
+    calls (including the repo's ``import threading as _threading`` alias
+    spelling) and ``from threading import Thread/Timer`` (which would
+    hide the construction sites from the attribute match). Every thread
+    is a concurrency root the static analyzer inventories; the three
+    homes that may mint one — monitor/watchdog.py,
+    resilience/health/responder.py, utils/checkpoint.py — carry
+    require_hit allowlist entries naming their lifecycle discipline.
+    Lock/Event/Condition construction is coordination, not a root, and
+    is not flagged."""
+    for rel, src in sorted(ctx.files.items()):
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as e:
+            yield Finding(
+                rule="lint.thread-create",
+                message=f"unparseable file: {e}",
+                site=f"{rel}:{e.lineno or 1}", severity=SEV_ERROR,
+            )
+            continue
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ImportFrom)
+                    and node.module == "threading"
+                    and any(a.name in _THREAD_CTORS for a in node.names)):
+                yield Finding(
+                    rule="lint.thread-create",
+                    message=(
+                        "'from threading import Thread' hides thread "
+                        "construction from review — spell it "
+                        "threading.Thread(...) in one of the blessed "
+                        "homes (monitor/watchdog.py, "
+                        "resilience/health/responder.py, "
+                        "utils/checkpoint.py)"
+                    ),
+                    site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
+                )
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _THREAD_CTORS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("threading", "_threading")
+            ):
+                yield Finding(
+                    rule="lint.thread-create",
+                    message=(
+                        f"raw threading.{func.attr}(...) outside the "
+                        "blessed homes (monitor/watchdog.py, "
+                        "resilience/health/responder.py, "
+                        "utils/checkpoint.py) — every thread is a "
+                        "concurrency root the static analyzer must "
+                        "inventory and audit; scattered construction "
+                        "adds an unaudited root with no join/daemon "
+                        "discipline. Route background work through the "
+                        "watchdog monitor loop or the checkpoint "
+                        "writer's finalize_async instead"
                     ),
                     site=f"{rel}:{node.lineno}", severity=SEV_ERROR,
                 )

@@ -261,6 +261,13 @@ class ProfilerTrigger:
         self.on_capture = on_capture
         self.router = router
         self.captures: List[dict] = []
+        # guards the _requested/_active handshake: request() is called
+        # from the watchdog thread (capture_incident arms the trigger in
+        # the escalation path) while maybe_start/maybe_stop run on the
+        # step loop — check-then-act on these two fields must be atomic.
+        # Profiler I/O never runs under this lock (claim inside, I/O
+        # outside), so a slow trace start cannot stall the watchdog.
+        self._state_lock = threading.Lock()
         self._requested: Optional[dict] = None  # {"step": int|None, "reason"}
         self._active: Optional[dict] = None
 
@@ -274,11 +281,13 @@ class ProfilerTrigger:
         --profile-step appointment for later. A capture already rolling
         is never preempted.
         """
-        if self._active is not None:
-            return
-        pending = self._requested
-        if pending is None or (step is None and pending["step"] is not None):
-            self._requested = {"step": step, "reason": reason}
+        with self._state_lock:
+            if self._active is not None:
+                return
+            pending = self._requested
+            if pending is None or (step is None
+                                   and pending["step"] is not None):
+                self._requested = {"step": step, "reason": reason}
 
     def on_verdict(self, step: int, verdict: int) -> None:
         """Arm on sentinel escalation (>= VERDICT_ROLLBACK)."""
@@ -291,44 +300,51 @@ class ProfilerTrigger:
 
     def maybe_start(self, step: int) -> bool:
         """Start the trace if a request is due at ``step``; True if so."""
-        req = self._requested
-        if req is None or self._active is not None:
-            return False
-        if req["step"] is not None and step < req["step"]:
-            return False
-        path = os.path.join(
-            self.log_dir, f"{req['reason'].replace('=', '')}-step{step:06d}"
-        )
         import jax
 
+        with self._state_lock:
+            req = self._requested
+            if req is None or self._active is not None:
+                return False
+            if req["step"] is not None and step < req["step"]:
+                return False
+            path = os.path.join(
+                self.log_dir,
+                f"{req['reason'].replace('=', '')}-step{step:06d}"
+            )
+            # claim under the lock so a concurrent request() sees the
+            # capture as rolling; the profiler I/O runs outside it
+            self._requested = None
+            self._active = {
+                "path": path, "start_step": step, "reason": req["reason"],
+            }
         try:
             os.makedirs(path, exist_ok=True)
             jax.profiler.start_trace(path)
         except Exception as e:  # pragma: no cover - backend-dependent
             logger.warning("profiler capture failed to start: %s", e)
-            self._requested = None
+            with self._state_lock:
+                self._active = None
             return False
-        self._requested = None
-        self._active = {
-            "path": path, "start_step": step, "reason": req["reason"],
-        }
         logger.info("profiler capture started: %s", path)
         return True
 
     def maybe_stop(self, step: int) -> Optional[dict]:
         """Stop after ``window_steps`` steps; returns the capture info."""
-        act = self._active
-        if act is None or step - act["start_step"] + 1 < self.window_steps:
-            return None
         import jax
 
+        with self._state_lock:
+            act = self._active
+            if act is None or \
+                    step - act["start_step"] + 1 < self.window_steps:
+                return None
+            # claim: exactly one caller stops this capture
+            self._active = None
         try:
             jax.profiler.stop_trace()
         except Exception as e:  # pragma: no cover - backend-dependent
             logger.warning("profiler capture failed to stop: %s", e)
-            self._active = None
             return None
-        self._active = None
         info = {**act, "end_step": step}
         self.captures.append(info)
         if self.router is not None:
@@ -349,11 +365,13 @@ class ProfilerTrigger:
 
     def close(self) -> None:
         """Abort any in-flight capture (end of run)."""
-        if self._active is not None:
+        with self._state_lock:
+            act = self._active
+            self._active = None
+        if act is not None:
             import jax
 
             try:
                 jax.profiler.stop_trace()
             except Exception:  # pragma: no cover
                 pass
-            self._active = None

@@ -54,20 +54,34 @@ _COUNTER_LOCK = threading.Lock()
 
 def _global_counter() -> _CompileCounter:
     global _COUNTER
+    # the import stays OUTSIDE _COUNTER_LOCK: first import runs arbitrary
+    # module init under the interpreter's per-module import lock, and
+    # holding our lock across it couples the two lock domains (the
+    # concurrency.blocking-under-lock shape — an importing thread and a
+    # counter-registering thread could deadlock via the import machinery)
+    try:
+        import jax.monitoring as _monitoring
+    except Exception as e:  # pragma: no cover - jax API drift
+        _monitoring = None
+        _monitoring_err = e
     with _COUNTER_LOCK:
         if _COUNTER is None:
             c = _CompileCounter()
-            try:
-                import jax.monitoring
-
-                jax.monitoring.register_event_duration_secs_listener(
-                    c._on_event
-                )
-                c.available = True
-            except Exception as e:  # pragma: no cover - jax API drift
+            if _monitoring is not None:
+                try:
+                    _monitoring.register_event_duration_secs_listener(
+                        c._on_event
+                    )
+                    c.available = True
+                except Exception as e:  # pragma: no cover - API drift
+                    logger.warning(
+                        "jax.monitoring unavailable (%s); CompileWatcher "
+                        "will report zero compiles", e,
+                    )
+            else:
                 logger.warning(
                     "jax.monitoring unavailable (%s); CompileWatcher will "
-                    "report zero compiles", e,
+                    "report zero compiles", _monitoring_err,
                 )
             _COUNTER = c
     return _COUNTER

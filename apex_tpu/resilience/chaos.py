@@ -112,6 +112,10 @@ def wedge(timeout_s: Optional[float] = None) -> None:
         "forever (incident ladder must end the job)"
         if timeout_s is None else f"for {timeout_s:.3f}s",
     )
+    # concurrency.unbounded-wait fires here by design (allowlisted): a
+    # fresh private Event nobody can set, so the wait is unbounded and
+    # unpreemptable from Python — only the watchdog's escalation ladder
+    # (or timeout_s in tests) ends it, exactly like the real hang
     threading.Event().wait(timeout_s)
 
 

@@ -1,0 +1,514 @@
+"""Tier-1 tests for the concurrency x-ray (apex_tpu.analysis.concurrency).
+
+Four seeded synthetic defects — an unguarded two-thread counter, an
+A/B–B/A lock-order inversion, a router fan-out under a lock, and a
+lock-taking SIGTERM handler — each pinned down to exact Finding fields,
+with the guarded/safe counterpart asserted clean. Plus the
+lint.thread-create rule, and the repo-wide no-rot contract: every
+concurrency finding over the real tree is either fixed or carries a
+reason-bearing allowlist entry, and no entry is stale.
+
+Everything here is pure AST — no jax import, no thread is ever started.
+"""
+
+import textwrap
+
+import pytest
+
+from apex_tpu.analysis.concurrency import (
+    CONCURRENCY_PASSES,
+    build_model,
+    run_concurrency,
+)
+from apex_tpu.analysis.findings import (
+    Allowlist,
+    SEV_ERROR,
+    SEV_INFO,
+    SEV_WARNING,
+)
+from apex_tpu.analysis.lint import run_lint
+
+
+def _src(body):
+    return textwrap.dedent(body)
+
+
+def _noninfo(findings):
+    return [f for f in findings if f.severity != SEV_INFO]
+
+
+class TestUnguardedWrite:
+    def test_two_thread_counter_detected(self):
+        # the canonical lost-update: __init__ spawns a poller thread
+        # that increments self.count while the public surface (the main
+        # root) also increments it, no lock anywhere
+        files = {"apex_tpu/fake_counter.py": _src("""\
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._work, daemon=True)
+
+                def _work(self):
+                    self.count += 1
+
+                def bump(self):
+                    self.count += 1
+        """)}
+        (f,) = _noninfo(run_concurrency(files=files))
+        assert f.rule == "concurrency.unguarded-write"
+        assert f.severity == SEV_ERROR
+        assert f.site == "apex_tpu/fake_counter.py:10"
+        assert f.target == "apex_tpu/fake_counter.py::Poller.count"
+        assert f.data["state"] == "apex_tpu/fake_counter.py::Poller.count"
+        assert f.data["roots"] == (
+            "main,thread:apex_tpu/fake_counter.py:7"
+        )
+        assert f.data["writes"] == 2
+
+    def test_guarded_counter_clean(self):
+        # same two roots, every write under the same lock: the must-hold
+        # intersection proves the guard and nothing fires
+        files = {"apex_tpu/fake_counter.py": _src("""\
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._work, daemon=True)
+
+                def _work(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bump(self):
+                    with self._lock:
+                        self.count += 1
+        """)}
+        assert run_concurrency(files=files) == []
+
+    def test_branch_only_lock_still_flagged(self):
+        # a lock taken on ONE write path proves nothing — intersection
+        # semantics: the unguarded bump() keeps the error alive
+        files = {"apex_tpu/fake_counter.py": _src("""\
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self.count = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._work, daemon=True)
+
+                def _work(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bump(self):
+                    self.count += 1
+        """)}
+        fins = _noninfo(run_concurrency(files=files))
+        assert [f.rule for f in fins] == ["concurrency.unguarded-write"]
+
+    def test_init_writes_exempt(self):
+        # construction happens-before the thread exists: __init__-only
+        # stores never count as a second writer
+        files = {"apex_tpu/fake_counter.py": _src("""\
+            import threading
+
+            class Poller:
+                def __init__(self):
+                    self.count = 0
+                    self._t = threading.Thread(target=self._work, daemon=True)
+
+                def _work(self):
+                    self.count += 1
+        """)}
+        assert _noninfo(run_concurrency(files=files)) == []
+
+
+class TestLockCycle:
+    def test_ab_ba_inversion_detected(self):
+        files = {"apex_tpu/fake_locks.py": _src("""\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def fwd():
+                with A:
+                    with B:
+                        pass
+
+            def rev():
+                with B:
+                    with A:
+                        pass
+        """)}
+        fins = [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.lock-cycle"]
+        (f,) = fins
+        assert f.severity == SEV_ERROR
+        # witness: the acquisition that closes the cycle (A inside B)
+        assert f.site == "apex_tpu/fake_locks.py:13"
+        assert f.target == "apex_tpu/fake_locks.py::A"
+        assert f.data["cycle"] == (
+            "apex_tpu/fake_locks.py::A -> apex_tpu/fake_locks.py::B "
+            "-> apex_tpu/fake_locks.py::A"
+        )
+
+    def test_consistent_order_clean(self):
+        # both call sites take A then B: a DAG, no finding
+        files = {"apex_tpu/fake_locks.py": _src("""\
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def one():
+                with A:
+                    with B:
+                        pass
+
+            def two():
+                with A:
+                    with B:
+                        pass
+        """)}
+        assert run_concurrency(files=files) == []
+
+    def test_nonreentrant_self_acquire_is_cycle(self):
+        # Lock (not RLock) re-acquired through an internal call:
+        # single-thread self-deadlock
+        files = {"apex_tpu/fake_self.py": _src("""\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """)}
+        fins = [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.lock-cycle"]
+        assert len(fins) == 1
+        assert "non-reentrant" in fins[0].message
+
+    def test_reentrant_self_acquire_clean(self):
+        # the router's design: RLock self-reentry is legal
+        files = {"apex_tpu/fake_self.py": _src("""\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+
+                def inner(self):
+                    with self._lock:
+                        pass
+        """)}
+        assert [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.lock-cycle"] == []
+
+
+class TestBlockingUnderLock:
+    def test_router_fanout_under_lock_detected(self):
+        files = {"apex_tpu/fake_rec.py": _src("""\
+            import threading
+
+            class Recorder:
+                def __init__(self, router):
+                    self._lock = threading.RLock()
+                    self.router = router
+
+                def record(self, step):
+                    with self._lock:
+                        self.router.event("m", step)
+        """)}
+        (f,) = _noninfo(run_concurrency(files=files))
+        assert f.rule == "concurrency.blocking-under-lock"
+        assert f.severity == SEV_WARNING
+        assert f.site == "apex_tpu/fake_rec.py:10"
+        assert f.target == "apex_tpu/fake_rec.py::Recorder._lock"
+        assert f.data["op"] == "self.router.event(...) [router fan-out]"
+        assert f.data["locks"] == "apex_tpu/fake_rec.py::Recorder._lock"
+
+    def test_fanout_outside_lock_clean(self):
+        # claim-under-lock / emit-outside-lock (the ProfilerTrigger
+        # shape): nothing fires
+        files = {"apex_tpu/fake_rec.py": _src("""\
+            import threading
+
+            class Recorder:
+                def __init__(self, router):
+                    self._lock = threading.RLock()
+                    self.router = router
+                    self._n = 0
+
+                def record(self, step):
+                    with self._lock:
+                        self._n += 1
+                    self.router.event("m", step)
+        """)}
+        assert _noninfo(run_concurrency(files=files)) == []
+
+    def test_sleep_and_import_under_lock_detected(self):
+        files = {"apex_tpu/fake_slow.py": _src("""\
+            import threading
+            import time
+
+            _LOCK = threading.Lock()
+
+            def slow():
+                with _LOCK:
+                    import json
+                    time.sleep(1.0)
+        """)}
+        fins = _noninfo(run_concurrency(files=files))
+        ops = sorted(f.data["op"] for f in fins)
+        assert ops == ["import json", "time.sleep"]
+        assert all(f.rule == "concurrency.blocking-under-lock"
+                   for f in fins)
+
+    def test_inline_event_wait_is_unbounded(self):
+        # the chaos wedge() shape: an Event nobody holds can never be
+        # set — flagged even with no lock held
+        files = {"apex_tpu/fake_wedge.py": _src("""\
+            import threading
+
+            def wedge(timeout_s=None):
+                threading.Event().wait(timeout_s)
+        """)}
+        (f,) = _noninfo(run_concurrency(files=files))
+        assert f.rule == "concurrency.unbounded-wait"
+        assert f.severity == SEV_WARNING
+        assert f.site == "apex_tpu/fake_wedge.py:4"
+        assert f.data["op"] == "Event.wait"
+
+
+class TestHandlerSafety:
+    def test_lock_taking_sigterm_handler_detected(self):
+        files = {"apex_tpu/fake_sig.py": _src("""\
+            import signal
+            import threading
+
+            _LOCK = threading.Lock()
+            _STATE = {}
+
+            def _on_term(signum, frame):
+                with _LOCK:
+                    _STATE["t"] = 1
+
+            signal.signal(signal.SIGTERM, _on_term)
+        """)}
+        fins = [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.handler-unsafe"]
+        (f,) = fins
+        assert f.severity == SEV_ERROR
+        assert f.site == "apex_tpu/fake_sig.py:8"
+        assert f.target == "signal:apex_tpu/fake_sig.py:11"
+        assert f.data == {
+            "handler": "apex_tpu/fake_sig.py::_on_term",
+            "cause": "lock",
+            "detail": "apex_tpu/fake_sig.py::_LOCK",
+        }
+
+    def test_flag_only_handler_clean(self):
+        # the async-signal-safe vocabulary: GIL-atomic stores + a
+        # monotonic timestamp
+        files = {"apex_tpu/fake_sig.py": _src("""\
+            import signal
+            import time
+
+            _FLAG = {"signaled": False, "t": None}
+
+            def _on_term(signum, frame):
+                _FLAG["signaled"] = True
+                _FLAG["t"] = time.monotonic()
+
+            signal.signal(signal.SIGTERM, _on_term)
+        """)}
+        assert [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.handler-unsafe"] == []
+
+    def test_atexit_hook_blocking_detected(self):
+        files = {"apex_tpu/fake_exit.py": _src("""\
+            import atexit
+            import time
+
+            def _teardown():
+                time.sleep(0.5)
+
+            atexit.register(_teardown)
+        """)}
+        fins = [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.handler-unsafe"]
+        (f,) = fins
+        assert f.data["cause"] == "blocking"
+        assert f.data["detail"] == "time.sleep"
+
+
+class TestRootsInventory:
+    def test_root_kinds(self):
+        files = {"apex_tpu/fake_roots.py": _src("""\
+            import atexit
+            import signal
+            import threading
+
+            def _work():
+                pass
+
+            def _tick():
+                pass
+
+            def _on_term(signum, frame):
+                pass
+
+            def _bye():
+                pass
+
+            t = threading.Thread(target=_work)
+            threading.Timer(1.0, _tick)
+            signal.signal(signal.SIGTERM, _on_term)
+            atexit.register(_bye)
+        """)}
+        model = build_model(files)
+        kinds = sorted(r.kind for r in model.roots)
+        assert kinds == ["atexit", "main", "signal", "thread", "timer"]
+        by_kind = {r.kind: r for r in model.roots}
+        assert by_kind["thread"].targets == (
+            "apex_tpu/fake_roots.py::_work",)
+        assert by_kind["timer"].targets == (
+            "apex_tpu/fake_roots.py::_tick",)
+
+    def test_dynamic_call_from_thread_reported_unresolved(self):
+        # the honesty contract: a call the resolver cannot follow from a
+        # thread root surfaces as info, never silently dropped
+        files = {"apex_tpu/fake_dyn.py": _src("""\
+            import threading
+
+            class Runner:
+                def __init__(self, fn):
+                    self._fn = fn
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    self._fn()
+        """)}
+        fins = [f for f in run_concurrency(files=files)
+                if f.rule == "concurrency.unresolved"]
+        (f,) = fins
+        assert f.severity == SEV_INFO
+        assert f.site == "apex_tpu/fake_dyn.py:9"
+        assert f.data["callee"] == "self._fn"
+
+    def test_pass_registry(self):
+        assert set(CONCURRENCY_PASSES) == {
+            "roots", "shared", "lock-order", "blocking", "handlers"}
+
+
+class TestThreadCreateLint:
+    def test_raw_thread_and_timer_flagged(self):
+        files = {
+            "apex_tpu/fake.py":
+                "import threading\nimport threading as _threading\n"
+                "t = threading.Thread(target=print)\n"
+                "u = _threading.Timer(1.0, print)\n"
+                "from threading import Thread\n",
+        }
+        fins = run_lint(rules=["lint.thread-create"], files=files)
+        assert sorted(f.site for f in fins) == [
+            "apex_tpu/fake.py:3", "apex_tpu/fake.py:4",
+            "apex_tpu/fake.py:5",
+        ]
+        assert all(f.rule == "lint.thread-create" for f in fins)
+        assert all(f.severity == SEV_ERROR for f in fins)
+
+    def test_coordination_primitives_not_flagged(self):
+        # locks/events/current_thread are coordination, not roots
+        files = {
+            "apex_tpu/fake.py":
+                "import threading\n"
+                "lk = threading.Lock()\n"
+                "rl = threading.RLock()\n"
+                "ev = threading.Event()\n"
+                "name = threading.current_thread().name\n"
+                "from threading import Event, Lock\n",
+        }
+        assert run_lint(rules=["lint.thread-create"], files=files) == []
+
+    def test_blessed_homes_are_the_only_sites(self):
+        # the three homes exist, are flagged by the raw rule, and are
+        # the ONLY apex_tpu sites (require_hit entries go stale if a
+        # thread construction moves)
+        fins = run_lint(rules=["lint.thread-create"])
+        homes = {f.site.rsplit(":", 1)[0] for f in fins}
+        assert homes == {
+            "apex_tpu/monitor/watchdog.py",
+            "apex_tpu/resilience/health/responder.py",
+            "apex_tpu/utils/checkpoint.py",
+        }
+
+
+class TestRepoScan:
+    def test_repo_concurrency_fully_explained(self):
+        """No-rot contract over the real tree: every concurrency finding
+        is suppressed by a reason-carrying entry and no entry is stale —
+        a new thread, a new unguarded write, or a removed hand-proof
+        breaks this test, not production."""
+        from apex_tpu.analysis.allowlist import REPO_ALLOWLIST
+
+        fins = run_concurrency()
+        entries = [e for e in REPO_ALLOWLIST.entries
+                   if e.rule.startswith("concurrency.")]
+        res = Allowlist(entries).apply(fins, check_stale=True)
+        unexplained = _noninfo(res.findings)
+        assert not unexplained, "\n".join(
+            f.format() for f in unexplained)
+        assert not res.stale_entries, res.stale_entries
+
+    def test_repo_scan_is_pure_ast(self):
+        """The concurrency passes must never initialize jax (the gate
+        runs them before the jaxpr half so host-runtime races report
+        even when tracing fails)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import sys\n"
+            "from apex_tpu.analysis.concurrency import run_concurrency\n"
+            "run_concurrency()\n"
+            "assert 'jax' not in sys.modules, 'concurrency scan "
+            "imported jax'\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert proc.returncode == 0, proc.stderr
+
+    @pytest.mark.slow
+    def test_gate_skip_concurrency_not_stale(self):
+        """--skip-concurrency must also disable stale checking, or the
+        concurrency require_hit entries would fail every skipped run."""
+        from apex_tpu.analysis.__main__ import main
+
+        try:
+            assert main(["--skip-jaxpr", "--skip-timeline",
+                         "--skip-concurrency"]) == 0
+        finally:
+            from apex_tpu.parallel import parallel_state
+
+            parallel_state.initialize_model_parallel()
